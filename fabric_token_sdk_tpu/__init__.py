@@ -1,0 +1,29 @@
+"""fabric_token_sdk_tpu — a TPU-native token framework.
+
+A brand-new framework with the capabilities of the Hyperledger Fabric Token SDK
+(reference: /root/reference, Go). The defining difference: zero-knowledge proof
+verification (Bulletproof-style range proofs, inner-product arguments, Sigma-protocol
+balance proofs over BN254) is a first-class batched TPU workload built on
+JAX/XLA limb-decomposed field arithmetic, exposed behind the driver Validator
+plugin boundary.
+
+Layout:
+  ops/       TPU compute kernels: limb bignum, Fp/Fr Montgomery arithmetic,
+             BN254 G1 group ops (complete formulas), batched MSM.
+  models/    batched proof-system verifiers/provers (range proof, IPA,
+             type-and-sum, same-type, audit reopen) as JAX programs.
+  parallel/  device mesh + sharded batch verification (pjit/shard_map).
+  crypto/    host-side control plane: pure-Python BN254 oracle, gnark-compatible
+             serialization, Fiat-Shamir transcripts, public parameters.
+  token/     token API (ManagementService, Request, token model, quantities).
+  driver/    driver SPI (interfaces + wire formats).
+  core/      driver registry + generic validator skeleton + drivers
+             (fabtoken, zkatdlog).
+  services/  ttx lifecycle, auditor, tokens, selector, identity, network,
+             interop/htlc, db facades.
+  sdk/       dependency wiring.
+  tokengen/  CLI for public-parameter generation.
+  utils/     codecs and helpers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Host-side cryptographic control plane.
+
+Pure-Python BN254 arithmetic (the correctness oracle and control-plane math),
+gnark/mathlib-compatible serialization, Fiat-Shamir transcripts, and the
+public-parameter model of the zkatdlog driver.
+
+The heavy algebra (batched MSM, batched proof checks) lives in
+fabric_token_sdk_tpu.ops / fabric_token_sdk_tpu.models as JAX programs; this
+package is the byte-exact boundary layer around them.
+"""

@@ -1,0 +1,321 @@
+"""Pure-Python BN254 (alt_bn128) arithmetic.
+
+This is the host-side oracle and control-plane math layer. The reference
+delegates all G1/Zr operations to github.com/IBM/mathlib which dispatches
+BN254 to consensys/gnark-crypto (see reference
+token/core/zkatdlog/nogh/v1/crypto/setup.go:14 and SURVEY.md §2.2). This
+module provides the same operation surface (G1 add/sub/mul/equals, Zr modular
+arithmetic, HashToZr, HashToG1) in pure Python integers.
+
+The TPU kernels in fabric_token_sdk_tpu.ops are validated against this module;
+the batched verifiers in fabric_token_sdk_tpu.models use it for host-side
+transcript scalars.
+
+Curve: y^2 = x^3 + 3 over Fp, order r, cofactor 1.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+# BN254 base field modulus.
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+# BN254 group order (scalar field modulus).
+R = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+# Curve equation y^2 = x^3 + B.
+B = 3
+
+# Number of bytes in a field element encoding (gnark fp.Bytes / fr.Bytes).
+FP_BYTES = 32
+FR_BYTES = 32
+
+# mathlib curve identifier for BN254 (github.com/IBM/mathlib curve registry:
+# FP256BN_AMCL=0, BN254=1, ...). Used in the ASN.1 Element framing of proofs
+# (reference token/core/common/encoding/asn1/asn1.go:95-112).
+CURVE_ID = 1
+
+
+# --------------------------------------------------------------------------
+# Scalar field Fr
+# --------------------------------------------------------------------------
+
+def fr_add(a: int, b: int) -> int:
+    return (a + b) % R
+
+
+def fr_sub(a: int, b: int) -> int:
+    return (a - b) % R
+
+
+def fr_mul(a: int, b: int) -> int:
+    return (a * b) % R
+
+
+def fr_neg(a: int) -> int:
+    return (-a) % R
+
+
+def fr_inv(a: int) -> int:
+    if a % R == 0:
+        raise ZeroDivisionError("inverse of zero in Fr")
+    return pow(a, R - 2, R)
+
+
+def fr_rand() -> int:
+    """Uniform random scalar in [0, R)."""
+    return secrets.randbelow(R)
+
+
+def hash_to_zr(data: bytes) -> int:
+    """SHA-256 digest interpreted as a big-endian integer, reduced mod r.
+
+    Mirrors mathlib Curve.HashToZr for the gnark-backed BN254 driver
+    (digest -> fr.Element.SetBytes, which reduces mod r). Used for every
+    Fiat-Shamir challenge in the reference proofs (e.g. reference
+    rp/bulletproof.go:272-282, rp/ipa.go:173, transfer/typeandsum.go:219).
+    """
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+
+
+# --------------------------------------------------------------------------
+# Base field Fp helpers
+# --------------------------------------------------------------------------
+
+def fp_sqrt(a: int) -> int | None:
+    """Square root in Fp (p ≡ 3 mod 4), or None if a is not a QR."""
+    a %= P
+    if a == 0:
+        return 0
+    s = pow(a, (P + 1) // 4, P)
+    if s * s % P != a:
+        return None
+    return s
+
+
+def fp_sgn0(a: int) -> int:
+    """RFC 9380 sgn0 for prime fields: parity of the canonical representative."""
+    return a & 1
+
+
+# --------------------------------------------------------------------------
+# G1 points
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class G1:
+    """Affine BN254 G1 point; (0, 0) with inf=True is the identity.
+
+    Frozen/hashable so points can key dicts (e.g. generator tables).
+    """
+
+    x: int
+    y: int
+    inf: bool = False
+
+    def is_identity(self) -> bool:
+        return self.inf
+
+    def on_curve(self) -> bool:
+        if self.inf:
+            return True
+        return (self.y * self.y - (self.x * self.x * self.x + B)) % P == 0
+
+    def __add__(self, other: "G1") -> "G1":
+        return g1_add(self, other)
+
+    def __sub__(self, other: "G1") -> "G1":
+        return g1_add(self, g1_neg(other))
+
+    def __mul__(self, k: int) -> "G1":
+        return g1_mul(self, k)
+
+    __rmul__ = __mul__
+
+
+G1_IDENTITY = G1(0, 0, True)
+G1_GENERATOR = G1(1, 2)
+
+
+def g1_neg(p: G1) -> G1:
+    if p.inf:
+        return p
+    return G1(p.x, (-p.y) % P)
+
+
+def g1_add(p: G1, q: G1) -> G1:
+    if p.inf:
+        return q
+    if q.inf:
+        return p
+    if p.x == q.x:
+        if (p.y + q.y) % P == 0:
+            return G1_IDENTITY
+        # doubling
+        lam = (3 * p.x * p.x) * pow(2 * p.y, P - 2, P) % P
+    else:
+        lam = (q.y - p.y) * pow(q.x - p.x, P - 2, P) % P
+    x3 = (lam * lam - p.x - q.x) % P
+    y3 = (lam * (p.x - x3) - p.y) % P
+    return G1(x3, y3)
+
+
+def g1_double(p: G1) -> G1:
+    return g1_add(p, p)
+
+
+def g1_mul(p: G1, k: int) -> G1:
+    """Scalar multiplication (double-and-add over a Jacobian accumulator)."""
+    k %= R
+    if k == 0 or p.inf:
+        return G1_IDENTITY
+    # Jacobian coordinates for speed (Python-int host path).
+    X, Y, Z = p.x, p.y, 1
+    RX, RY, RZ = 0, 1, 0  # identity
+    for bit in bin(k)[2:]:
+        RX, RY, RZ = _jac_double(RX, RY, RZ)
+        if bit == "1":
+            RX, RY, RZ = _jac_add_mixed(RX, RY, RZ, X, Y)
+    return _jac_to_affine(RX, RY, RZ)
+
+
+def _jac_double(X, Y, Z):
+    if Z == 0:
+        return X, Y, Z
+    A = X * X % P
+    Bv = Y * Y % P
+    C = Bv * Bv % P
+    D = 2 * ((X + Bv) * (X + Bv) - A - C) % P
+    E = 3 * A % P
+    F = E * E % P
+    X3 = (F - 2 * D) % P
+    Y3 = (E * (D - X3) - 8 * C) % P
+    Z3 = 2 * Y * Z % P
+    return X3, Y3, Z3
+
+
+def _jac_add_mixed(X1, Y1, Z1, x2, y2):
+    if Z1 == 0:
+        return x2, y2, 1
+    Z1Z1 = Z1 * Z1 % P
+    U2 = x2 * Z1Z1 % P
+    S2 = y2 * Z1 * Z1Z1 % P
+    H = (U2 - X1) % P
+    rr = (S2 - Y1) % P
+    if H == 0:
+        if rr == 0:
+            return _jac_double(X1, Y1, Z1)
+        return 0, 1, 0
+    HH = H * H % P
+    HHH = H * HH % P
+    V = X1 * HH % P
+    X3 = (rr * rr - HHH - 2 * V) % P
+    Y3 = (rr * (V - X3) - Y1 * HHH) % P
+    Z3 = Z1 * H % P
+    return X3, Y3, Z3
+
+
+def _jac_to_affine(X, Y, Z) -> G1:
+    if Z == 0:
+        return G1_IDENTITY
+    zinv = pow(Z, P - 2, P)
+    zinv2 = zinv * zinv % P
+    return G1(X * zinv2 % P, Y * zinv2 * zinv % P)
+
+
+def msm(points: list[G1], scalars: list[int]) -> G1:
+    """Multi-scalar multiplication (host oracle; naive)."""
+    acc = G1_IDENTITY
+    for p, s in zip(points, scalars):
+        acc = g1_add(acc, g1_mul(p, s))
+    return acc
+
+
+# --------------------------------------------------------------------------
+# Hash-to-curve (Shallue–van de Woestijne, RFC 9380) for G1.
+#
+# The reference derives range-proof generators via curve.HashToG1 (reference
+# crypto/setup.go:388-406). mathlib routes this to gnark-crypto's
+# bn254.HashToG1 (SVDW map, expand_message_xmd/SHA-256, empty DST). Generator
+# derivation only affects public-parameter *generation* — pp consumers read
+# the points from the serialized pp — so cross-stack bit-parity of this map
+# is not required for bit-identical accept/reject (pp.Validate only checks
+# points are on-curve, reference crypto/setup.go:444-489).
+# --------------------------------------------------------------------------
+
+# SVDW constants for y^2 = x^3 + 3 with Z = 1 (g(Z) = 4):
+_SVDW_Z = 1
+_SVDW_C1 = 4  # g(Z)
+_SVDW_C2 = (P - 1) * pow(2, P - 2, P) % P  # -Z / 2
+# c3 = sqrt(-g(Z) * (3 Z^2 + 4 A)) = sqrt(-12), sign chosen so sgn0(c3) == 0
+_c3 = fp_sqrt((-12) % P)
+if _c3 is None:  # pragma: no cover - fixed constant
+    raise RuntimeError("BN254 SVDW constant c3 does not exist")
+_SVDW_C3 = _c3 if fp_sgn0(_c3) == 0 else P - _c3
+# c4 = -4 g(Z) / (3 Z^2 + 4 A) = -16/3
+_SVDW_C4 = (-16) % P * pow(3, P - 2, P) % P
+
+
+def _g_of_x(x: int) -> int:
+    return (x * x * x + B) % P
+
+
+def map_to_curve_svdw(u: int) -> G1:
+    """RFC 9380 SVDW map for BN254 G1 (straight-line, non-constant-time)."""
+    tv1 = u * u % P * _SVDW_C1 % P
+    tv2 = (1 + tv1) % P
+    tv1 = (1 - tv1) % P
+    tv3 = tv1 * tv2 % P
+    tv3 = pow(tv3, P - 2, P) if tv3 else 0
+    tv4 = u * tv1 % P * tv3 % P * _SVDW_C3 % P
+    x1 = (_SVDW_C2 - tv4) % P
+    gx1 = _g_of_x(x1)
+    if fp_sqrt(gx1) is not None:
+        x, gx = x1, gx1
+    else:
+        x2 = (_SVDW_C2 + tv4) % P
+        gx2 = _g_of_x(x2)
+        if fp_sqrt(gx2) is not None:
+            x, gx = x2, gx2
+        else:
+            tv5 = tv2 * tv2 % P * tv3 % P
+            x3 = (_SVDW_Z + _SVDW_C4 * tv5 * tv5) % P
+            x, gx = x3, _g_of_x(x3)
+    y = fp_sqrt(gx)
+    assert y is not None
+    if fp_sgn0(u) != fp_sgn0(y):
+        y = P - y
+    return G1(x, y)
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, out_len: int) -> bytes:
+    """RFC 9380 expand_message_xmd with SHA-256."""
+    h = hashlib.sha256
+    b_in_bytes = 32
+    r_in_bytes = 64
+    ell = (out_len + b_in_bytes - 1) // b_in_bytes
+    if ell > 255:
+        raise ValueError("expand_message_xmd: output too long")
+    dst_prime = dst + len(dst).to_bytes(1, "big")
+    z_pad = b"\x00" * r_in_bytes
+    l_i_b_str = out_len.to_bytes(2, "big")
+    b0 = h(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    bvals = [h(b0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        tmp = bytes(x ^ y for x, y in zip(b0, bvals[-1]))
+        bvals.append(h(tmp + i.to_bytes(1, "big") + dst_prime).digest())
+    return b"".join(bvals)[:out_len]
+
+
+def hash_to_field(msg: bytes, dst: bytes, count: int) -> list[int]:
+    """RFC 9380 hash_to_field for Fp, L=48 (matches gnark bn254)."""
+    L = 48
+    uniform = expand_message_xmd(msg, dst, count * L)
+    return [int.from_bytes(uniform[i * L:(i + 1) * L], "big") % P for i in range(count)]
+
+
+def hash_to_g1(data: bytes, dst: bytes = b"") -> G1:
+    """hash_to_curve for BN254 G1 (SVDW, random-oracle variant, cofactor 1)."""
+    u0, u1 = hash_to_field(data, dst, 2)
+    return g1_add(map_to_curve_svdw(u0), map_to_curve_svdw(u1))

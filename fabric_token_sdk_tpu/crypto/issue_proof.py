@@ -1,0 +1,140 @@
+"""Host-side issue proof: same-type Σ-protocol + range correctness.
+
+Behavioral mirror of:
+  - reference token/core/zkatdlog/nogh/v1/crypto/issue/sametype.go
+  - reference token/core/zkatdlog/nogh/v1/crypto/issue/{prover,verifier}.go
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import rp as rp_mod
+from . import serialization as ser
+from .bn254 import (
+    G1,
+    fr_add,
+    fr_mul,
+    fr_rand,
+    fr_sub,
+    g1_add,
+    g1_mul,
+    g1_neg,
+    hash_to_zr,
+)
+from .rp import ProofError, RangeCorrectness
+
+
+@dataclass
+class SameTypeProof:
+    """reference sametype.go:19-29."""
+
+    type_: int = None
+    blinding_factor: int = None
+    challenge: int = None
+    commitment_to_type: G1 = None
+
+    def serialize(self) -> bytes:
+        # reference sametype.go:32-39
+        return ser.marshal_math(
+            (ser.ZR_KIND, self.type_),
+            (ser.ZR_KIND, self.blinding_factor),
+            (ser.ZR_KIND, self.challenge),
+            (ser.G1_KIND, self.commitment_to_type),
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "SameTypeProof":
+        um = ser.MathUnmarshaller(raw)
+        return cls(um.next_zr(), um.next_zr(), um.next_zr(), um.next_g1())
+
+
+def same_type_prove(token_type: str, type_bf: int, commitment_to_type: G1,
+                    ped_params: list[G1]) -> SameTypeProof:
+    """reference sametype.go:103-148."""
+    type_zr = hash_to_zr(token_type.encode())
+    r_type = fr_rand()
+    r_bf = fr_rand()
+    commitment = g1_add(g1_mul(ped_params[0], r_type), g1_mul(ped_params[2], r_bf))
+    chal = hash_to_zr(ser.g1_array_bytes([commitment_to_type, commitment]))
+    return SameTypeProof(
+        type_=fr_add(fr_mul(chal, type_zr), r_type),
+        blinding_factor=fr_add(fr_mul(chal, type_bf), r_bf),
+        challenge=chal,
+        commitment_to_type=commitment_to_type,
+    )
+
+
+def same_type_verify(proof: SameTypeProof, ped_params: list[G1]) -> None:
+    """reference sametype.go:167-183. Raises ProofError on rejection."""
+    if (proof.type_ is None or proof.blinding_factor is None
+            or proof.challenge is None or proof.commitment_to_type is None):
+        raise ProofError("invalid same type proof")
+    com = g1_add(g1_mul(ped_params[0], proof.type_),
+                 g1_mul(ped_params[2], proof.blinding_factor))
+    com = g1_add(com, g1_neg(g1_mul(proof.commitment_to_type, proof.challenge)))
+    chal = hash_to_zr(ser.g1_array_bytes([proof.commitment_to_type, com]))
+    if chal != proof.challenge:
+        raise ProofError("invalid same type proof")
+
+
+@dataclass
+class IssueProof:
+    same_type: SameTypeProof = None
+    range_correctness: RangeCorrectness = None
+
+    def serialize(self) -> bytes:
+        # reference issue/prover.go:27-29
+        return ser.marshal_serializers([
+            self.same_type.serialize(),
+            self.range_correctness.serialize() if self.range_correctness else None,
+        ])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IssueProof":
+        parts = ser.unmarshal_serializers(raw, 2)
+        st = SameTypeProof.deserialize(parts[0])
+        rc = RangeCorrectness.deserialize(parts[1]) if parts[1] else RangeCorrectness()
+        return cls(st, rc)
+
+
+def issue_prove(witness: list[tuple[str, int, int]], tokens: list[G1], pp) -> bytes:
+    """reference issue/prover.go:46-112. Witnesses are (type, value, bf)."""
+    token_type = witness[0][0]
+    type_zr = hash_to_zr(token_type.encode())
+    type_bf = fr_rand()
+    commitment_to_type = g1_add(g1_mul(pp.pedersen_generators[0], type_zr),
+                                g1_mul(pp.pedersen_generators[2], type_bf))
+    st = same_type_prove(token_type, type_bf, commitment_to_type,
+                         pp.pedersen_generators)
+
+    values = [w[1] for w in witness]
+    bfs = [fr_sub(w[2], type_bf) for w in witness]
+    coms = [g1_add(t, g1_neg(commitment_to_type)) for t in tokens]
+    rpp = pp.range_proof_params
+    rc = rp_mod.range_correctness_prove(
+        coms, values, bfs, pp.pedersen_generators[1:],
+        rpp.left_generators, rpp.right_generators, rpp.P, rpp.Q,
+        rpp.bit_length, rpp.number_of_rounds)
+    return IssueProof(same_type=st, range_correctness=rc).serialize()
+
+
+def issue_verify(proof_raw: bytes, tokens: list[G1], pp) -> None:
+    """reference issue/verifier.go:32-57. Raises ProofError on rejection."""
+    try:
+        proof = IssueProof.deserialize(proof_raw)
+    except (ValueError, ProofError) as e:
+        raise ProofError(f"invalid issue proof: {e}") from e
+    try:
+        same_type_verify(proof.same_type, pp.pedersen_generators)
+    except ProofError as e:
+        raise ProofError(f"invalid issue proof: {e}") from e
+    coms = [g1_add(t, g1_neg(proof.same_type.commitment_to_type)) for t in tokens]
+    rpp = pp.range_proof_params
+    try:
+        rp_mod.range_correctness_verify(
+            proof.range_correctness, coms, pp.pedersen_generators[1:],
+            rpp.left_generators, rpp.right_generators, rpp.P, rpp.Q,
+            rpp.bit_length, rpp.number_of_rounds)
+    except ProofError as e:
+        raise ProofError(f"invalid issue proof: {e}") from e

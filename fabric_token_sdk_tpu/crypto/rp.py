@@ -1,0 +1,417 @@
+"""Host-side range proof + inner-product argument (Bulletproof-style).
+
+Behavioral mirror of the reference zkatdlog range-proof scheme:
+  - prover/verifier:    reference token/core/zkatdlog/nogh/v1/crypto/rp/
+                        bulletproof.go:209-509
+  - inner-product arg.: reference .../rp/ipa.go:158-373
+  - batch container:    reference .../rp/rangecorrectness.go:15-162
+
+This module is the oracle + load generator. The batched TPU verification path
+lives in fabric_token_sdk_tpu.models.range_proof and is tested for exact
+accept/reject agreement with this module. Error strings intentionally match
+the reference so observable behavior is identical.
+
+The proof shows a committed value v < 2^BitLength. Commitments here are
+"value commitments" com = G^v H^bf with (G, H) = CommitmentGenerators
+(the callers pass PedersenGenerators[1:], see reference transfer/transfer.go:110
+and issue/prover.go:76-88).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import bn254
+from . import serialization as ser
+from .bn254 import (
+    G1,
+    R,
+    fr_add,
+    fr_inv,
+    fr_mul,
+    fr_rand,
+    fr_sub,
+    g1_add,
+    g1_mul,
+    hash_to_zr,
+)
+
+
+class ProofError(Exception):
+    """Raised when a proof fails verification; message mirrors the Go error."""
+
+
+# --------------------------------------------------------------------------
+# shared vector helpers (reference rp/ipa.go:358-373)
+# --------------------------------------------------------------------------
+
+def inner_product(left: list[int], right: list[int]) -> int:
+    ip = 0
+    for l, r in zip(left, right):
+        ip = fr_add(ip, fr_mul(l, r))
+    return ip
+
+
+def commit_vector(left: list[int], right: list[int],
+                  left_gen: list[G1], right_gen: list[G1]) -> G1:
+    com = bn254.G1_IDENTITY
+    for i in range(len(left)):
+        com = g1_add(com, g1_mul(left_gen[i], left[i]))
+        com = g1_add(com, g1_mul(right_gen[i], right[i]))
+    return com
+
+
+def reduce_generators(left_gen: list[G1], right_gen: list[G1],
+                      x: int, x_inv: int) -> tuple[list[G1], list[G1]]:
+    """One IPA folding round of the generator vectors (rp/ipa.go:343-356)."""
+    n = len(left_gen) // 2
+    lg, rg = [], []
+    for i in range(n):
+        lg.append(g1_add(g1_mul(left_gen[i], x_inv), g1_mul(left_gen[i + n], x)))
+        rg.append(g1_add(g1_mul(right_gen[i], x), g1_mul(right_gen[i + n], x_inv)))
+    return lg, rg
+
+
+def reduce_vectors(left: list[int], right: list[int],
+                   x: int, x_inv: int) -> tuple[list[int], list[int]]:
+    n = len(left) // 2
+    lp = [fr_add(fr_mul(left[i], x), fr_mul(left[i + n], x_inv)) for i in range(n)]
+    rp_ = [fr_add(fr_mul(right[i], x_inv), fr_mul(right[i + n], x)) for i in range(n)]
+    return lp, rp_
+
+
+# --------------------------------------------------------------------------
+# IPA (rp/ipa.go)
+# --------------------------------------------------------------------------
+
+@dataclass
+class IPA:
+    left: int = 0
+    right: int = 0
+    L: list[G1] = field(default_factory=list)
+    R: list[G1] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        # reference rp/ipa.go:33-43
+        return ser.marshal_math(
+            (ser.ZR_KIND, self.left),
+            (ser.ZR_KIND, self.right),
+            (ser.G1_ARRAY_KIND, self.L),
+            (ser.G1_ARRAY_KIND, self.R),
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IPA":
+        um = ser.MathUnmarshaller(raw)
+        return cls(um.next_zr(), um.next_zr(), um.next_g1_array(), um.next_g1_array())
+
+
+def ipa_first_challenge(left_gen: list[G1], right_gen: list[G1],
+                        Q: G1, commitment: G1, ip: int) -> int:
+    """First IPA challenge; NOTE right generators hash first (ipa.go:159-173)."""
+    array_bytes = ser.g1_array_bytes(list(right_gen) + list(left_gen) + [Q, commitment])
+    raw = ser.marshal_std_bytes_slices(
+        [array_bytes, ser.SEPARATOR, ser.zr_to_bytes(ip)])
+    return hash_to_zr(raw)
+
+
+def ipa_round_challenge(L: G1, Rp: G1) -> int:
+    return hash_to_zr(ser.g1_array_bytes([L, Rp]))
+
+
+def ipa_prove(ip: int, left: list[int], right: list[int], Q: G1,
+              left_gen: list[G1], right_gen: list[G1], commitment: G1,
+              rounds: int) -> IPA:
+    """reference rp/ipa.go:158-186,267-322."""
+    x = ipa_first_challenge(left_gen, right_gen, Q, commitment, ip)
+    X = g1_mul(Q, x)
+    L_arr: list[G1] = []
+    R_arr: list[G1] = []
+    for _ in range(rounds):
+        n = len(left_gen) // 2
+        left_ip = inner_product(left[:n], right[n:])
+        right_ip = inner_product(left[n:], right[:n])
+        L = g1_add(commit_vector(left[:n], right[n:], left_gen[n:], right_gen[:n]),
+                   g1_mul(X, left_ip))
+        Rp = g1_add(commit_vector(left[n:], right[:n], left_gen[:n], right_gen[n:]),
+                    g1_mul(X, right_ip))
+        L_arr.append(L)
+        R_arr.append(Rp)
+        xr = ipa_round_challenge(L, Rp)
+        xr_inv = fr_inv(xr)
+        left_gen, right_gen = reduce_generators(left_gen, right_gen, xr, xr_inv)
+        left, right = reduce_vectors(left, right, xr, xr_inv)
+    return IPA(left=left[0], right=right[0], L=L_arr, R=R_arr)
+
+
+def ipa_verify(proof: IPA, ip: int, Q: G1, left_gen: list[G1],
+               right_gen: list[G1], commitment: G1, rounds: int) -> None:
+    """reference rp/ipa.go:190-262. Raises ProofError on rejection."""
+    if proof.left is None or proof.right is None:
+        raise ProofError("invalid IPA proof: nil elements")
+    if len(proof.L) != len(proof.R) or len(proof.L) != rounds:
+        raise ProofError("invalid IPA proof")
+    x = ipa_first_challenge(left_gen, right_gen, Q, commitment, ip)
+    C = g1_add(g1_mul(Q, fr_mul(x, ip)), commitment)
+    X = g1_mul(Q, x)
+    for i in range(rounds):
+        if proof.L[i] is None or proof.R[i] is None:
+            raise ProofError("invalid IPA proof: nil elements")
+        xr = ipa_round_challenge(proof.L[i], proof.R[i])
+        xr_inv = fr_inv(xr)
+        x_sq = fr_mul(xr, xr)
+        x_sq_inv = fr_inv(x_sq)
+        C = g1_add(g1_add(g1_mul(proof.L[i], x_sq), C), g1_mul(proof.R[i], x_sq_inv))
+        left_gen, right_gen = reduce_generators(left_gen, right_gen, xr, xr_inv)
+    C_prime = g1_add(g1_mul(left_gen[0], proof.left), g1_mul(right_gen[0], proof.right))
+    C_prime = g1_add(C_prime, g1_mul(X, fr_mul(proof.left, proof.right)))
+    if C_prime != C:
+        raise ProofError("invalid IPA")
+
+
+# --------------------------------------------------------------------------
+# Range proof (rp/bulletproof.go)
+# --------------------------------------------------------------------------
+
+@dataclass
+class RangeProofData:
+    T1: G1 = None
+    T2: G1 = None
+    tau: int = 0
+    C: G1 = None
+    D: G1 = None
+    delta: int = 0
+    inner_product: int = 0
+
+    def serialize(self) -> bytes:
+        # reference rp/bulletproof.go:37-47
+        return ser.marshal_math(
+            (ser.G1_KIND, self.T1),
+            (ser.G1_KIND, self.T2),
+            (ser.ZR_KIND, self.tau),
+            (ser.G1_KIND, self.C),
+            (ser.G1_KIND, self.D),
+            (ser.ZR_KIND, self.delta),
+            (ser.ZR_KIND, self.inner_product),
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "RangeProofData":
+        um = ser.MathUnmarshaller(raw)
+        return cls(um.next_g1(), um.next_g1(), um.next_zr(),
+                   um.next_g1(), um.next_g1(), um.next_zr(), um.next_zr())
+
+
+@dataclass
+class RangeProof:
+    data: RangeProofData = None
+    ipa: IPA = None
+
+    def serialize(self) -> bytes:
+        # reference rp/bulletproof.go:93-95
+        return ser.marshal_serializers([self.data.serialize(), self.ipa.serialize()])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "RangeProof":
+        parts = ser.unmarshal_serializers(raw, 2)
+        return cls(RangeProofData.deserialize(parts[0]), IPA.deserialize(parts[1]))
+
+
+def challenge_x(T1: G1, T2: G1) -> int:
+    """x = HashToZr(G1Array([T1, T2]).Bytes()) (bulletproof.go:266-272)."""
+    return hash_to_zr(ser.g1_array_bytes([T1, T2]))
+
+
+def challenges_y_z(C: G1, D: G1, commitment: G1) -> tuple[int, int]:
+    """y, z from (C, D, Com) (bulletproof.go:276-282)."""
+    y = hash_to_zr(ser.g1_array_bytes([C, D, commitment]))
+    z = hash_to_zr(ser.zr_to_bytes(y))
+    return y, z
+
+
+def range_prove(commitment: G1, value: int, commitment_gen: list[G1],
+                blinding_factor: int, left_gen: list[G1], right_gen: list[G1],
+                P: G1, Q: G1, rounds: int, bit_length: int) -> RangeProof:
+    """reference rp/bulletproof.go:209-249,336-466."""
+    # -------- preprocess (bulletproof.go:336-466)
+    rho = fr_rand()
+    eta = fr_rand()
+    left = []
+    right = []
+    random_left = []
+    random_right = []
+    for i in range(bit_length):
+        b = 1 if (value >> i) & 1 else 0
+        left.append(b)
+        right.append(fr_sub(b, 1))
+        random_left.append(fr_rand())
+        random_right.append(fr_rand())
+
+    C = g1_add(commit_vector(left, right, left_gen, right_gen), g1_mul(P, rho))
+    D = g1_add(commit_vector(random_left, random_right, left_gen, right_gen),
+               g1_mul(P, eta))
+    y, z = challenges_y_z(C, D, commitment)
+    z_sq = fr_mul(z, z)
+
+    left_prime = []
+    right_prime = []
+    rand_right_prime = []
+    z_prime = []
+    y2i = 1
+    for i in range(bit_length):
+        left_prime.append(fr_sub(left[i], z))
+        if i > 0:
+            y2i = fr_mul(y, y2i)
+        right_prime.append(fr_mul(fr_add(right[i], z), y2i))
+        rand_right_prime.append(fr_mul(random_right[i], y2i))
+        z_prime.append(fr_mul(z_sq, pow(2, i, R)))
+
+    t1 = inner_product(left_prime, rand_right_prime)
+    t1 = fr_add(t1, inner_product(right_prime, random_left))
+    t1 = fr_add(t1, inner_product(z_prime, random_left))
+    tau1 = fr_rand()
+    T1 = g1_add(g1_mul(commitment_gen[0], t1), g1_mul(commitment_gen[1], tau1))
+
+    t2 = inner_product(random_left, rand_right_prime)
+    tau2 = fr_rand()
+    T2 = g1_add(g1_mul(commitment_gen[0], t2), g1_mul(commitment_gen[1], tau2))
+
+    x = challenge_x(T1, T2)
+
+    for i in range(bit_length):
+        left[i] = fr_add(left_prime[i], fr_mul(x, random_left[i]))
+        right[i] = fr_add(fr_add(right_prime[i], fr_mul(x, rand_right_prime[i])),
+                          z_prime[i])
+    tau = fr_mul(x, tau1)
+    tau = fr_add(tau, fr_mul(tau2, fr_mul(x, x)))
+    tau = fr_add(tau, fr_mul(z_sq, blinding_factor))
+    delta = fr_add(rho, fr_mul(eta, x))
+
+    proof = RangeProof(
+        data=RangeProofData(T1=T1, T2=T2, tau=tau, C=C, D=D, delta=delta),
+        ipa=None,
+    )
+
+    # -------- Prove (bulletproof.go:209-249)
+    y_inv = fr_inv(y)
+    right_gen_prime = [g1_mul(right_gen[i], pow(y_inv, i, R))
+                       for i in range(len(right_gen))]
+    com = commit_vector(left, right, left_gen, right_gen_prime)
+    proof.data.inner_product = inner_product(left, right)
+    proof.ipa = ipa_prove(proof.data.inner_product, left, right, Q,
+                          left_gen, right_gen_prime, com, rounds)
+    return proof
+
+
+def range_verify(proof: RangeProof, commitment: G1, commitment_gen: list[G1],
+                 left_gen: list[G1], right_gen: list[G1],
+                 P: G1, Q: G1, rounds: int, bit_length: int) -> None:
+    """reference rp/bulletproof.go:252-333,469-509. Raises ProofError."""
+    d = proof.data
+    if d is None or d.inner_product is None or d.C is None or d.D is None:
+        raise ProofError("invalid range proof: nil elements")
+    if d.T1 is None or d.T2 is None:
+        raise ProofError("invalid range proof: nil elements")
+    if d.tau is None or d.delta is None:
+        raise ProofError("invalid range proof: nil elements")
+    if proof.ipa is None:
+        raise ProofError("invalid range proof: nil elements")
+
+    x = challenge_x(d.T1, d.T2)
+    x_sq = fr_mul(x, x)
+    y, z = challenges_y_z(d.C, d.D, commitment)
+    z_sq = fr_mul(z, z)
+    z_cube = fr_mul(z_sq, z)
+
+    y_pow = []
+    ipy = 0
+    ip2 = 0
+    power2 = 1
+    for i in range(bit_length):
+        if i == 0:
+            y_pow.append(1)
+        else:
+            y_pow.append(fr_mul(y, y_pow[i - 1]))
+            power2 = fr_mul(2, power2)
+        ipy = fr_add(ipy, y_pow[i])
+        ip2 = fr_add(ip2, power2)
+
+    pol_eval = fr_mul(fr_sub(z, z_sq), ipy)
+    pol_eval = fr_sub(pol_eval, fr_mul(z_cube, ip2))
+
+    com = g1_mul(commitment_gen[0], d.inner_product)
+    com = g1_add(com, g1_mul(commitment_gen[1], d.tau))
+    com = g1_add(com, bn254.g1_neg(g1_mul(d.T1, x)))
+    com = g1_add(com, bn254.g1_neg(g1_mul(d.T2, x_sq)))
+
+    com_prime = g1_add(g1_mul(commitment, z_sq), g1_mul(commitment_gen[0], pol_eval))
+    if com != com_prime:
+        raise ProofError("invalid range proof")
+
+    # verifyIPA (bulletproof.go:469-509)
+    com = g1_add(g1_mul(d.D, x), d.C)
+    right_gen_prime = []
+    for i in range(len(left_gen)):
+        com = g1_add(com, bn254.g1_neg(g1_mul(left_gen[i], z)))
+        y_inv_2i = fr_inv(y_pow[i])
+        zi = fr_add(fr_mul(z, y_pow[i]), fr_mul(z_sq, pow(2, i, R)))
+        rg = g1_mul(right_gen[i], y_inv_2i)
+        right_gen_prime.append(rg)
+        com = g1_add(com, g1_mul(rg, zi))
+    com = g1_add(com, bn254.g1_neg(g1_mul(P, d.delta)))
+
+    ipa_verify(proof.ipa, d.inner_product, Q, left_gen, right_gen_prime, com, rounds)
+
+
+# --------------------------------------------------------------------------
+# RangeCorrectness batch container (rp/rangecorrectness.go)
+# --------------------------------------------------------------------------
+
+@dataclass
+class RangeCorrectness:
+    proofs: list[RangeProof] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        # reference rangecorrectness.go:19-25: Marshal(NewArray(proofs))
+        inner = ser.marshal_serializers([p.serialize() for p in self.proofs])
+        return ser.marshal_serializers([inner])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "RangeCorrectness":
+        outer = ser.unmarshal_serializers(raw, 1)
+        parts = ser.unmarshal_values(outer[0])
+        return cls([RangeProof.deserialize(p) for p in parts])
+
+
+def range_correctness_prove(commitments: list[G1], values: list[int],
+                            blinding_factors: list[int],
+                            pedersen_params: list[G1],
+                            left_gen: list[G1], right_gen: list[G1],
+                            P: G1, Q: G1, bit_length: int,
+                            rounds: int) -> RangeCorrectness:
+    proofs = [
+        range_prove(commitments[i], values[i], pedersen_params,
+                    blinding_factors[i], left_gen, right_gen, P, Q,
+                    rounds, bit_length)
+        for i in range(len(commitments))
+    ]
+    return RangeCorrectness(proofs)
+
+
+def range_correctness_verify(rc: RangeCorrectness, commitments: list[G1],
+                             pedersen_params: list[G1],
+                             left_gen: list[G1], right_gen: list[G1],
+                             P: G1, Q: G1, bit_length: int,
+                             rounds: int) -> None:
+    """Sequential per-proof loop (rangecorrectness.go:137-162) — the primary
+    batching opportunity that models.range_proof exploits on TPU."""
+    if len(rc.proofs) != len(commitments):
+        raise ProofError("invalid range proof")
+    for i, proof in enumerate(rc.proofs):
+        if proof is None:
+            raise ProofError(f"invalid range proof: nil proof at index {i}")
+        try:
+            range_verify(proof, commitments[i], pedersen_params,
+                         left_gen, right_gen, P, Q, rounds, bit_length)
+        except ProofError as e:
+            raise ProofError(f"invalid range proof at index {i}: {e}") from e

@@ -1,0 +1,279 @@
+"""Byte-exact serialization layer: gnark/mathlib element encodings + Go ASN.1.
+
+Bit-identical Fiat-Shamir across the reference Go stack and this framework
+depends on exact reproduction of three encoding layers (SURVEY.md §7 item 2):
+
+1. Element bytes: mathlib G1.Bytes() = gnark G1Affine.RawBytes() = 64 bytes,
+   x||y big-endian 32-byte each, uncompressed (flag bits 0b00 in the top two
+   bits, which are naturally zero for BN254 since p < 2^254); the point at
+   infinity encodes as 64 zero bytes. Zr.Bytes() = 32-byte big-endian of the
+   reduced scalar.
+
+2. G1 array bytes: hex-encode each element's bytes, join with the literal
+   separator "||" (reference token/core/zkatdlog/nogh/v1/crypto/common/
+   array.go:17-36).
+
+3. ASN.1 framing: Go encoding/asn1 DER of
+     Values  ::= SEQUENCE { values SEQUENCE OF OCTET STRING }
+     Element ::= SEQUENCE { curveID INTEGER, raw OCTET STRING }
+   (reference token/core/common/encoding/asn1/asn1.go:27-34,95-112), plus
+   MarshalStd([][]byte) = SEQUENCE OF OCTET STRING (asn1.go:36-38).
+"""
+
+from __future__ import annotations
+
+from . import bn254
+from .bn254 import G1, R
+
+SEPARATOR = b"||"  # reference crypto/common/array.go:19
+
+G1_BYTES_LEN = 64
+
+
+# --------------------------------------------------------------------------
+# Element encodings
+# --------------------------------------------------------------------------
+
+def g1_to_bytes(p: G1) -> bytes:
+    """mathlib G1.Bytes(): 64-byte uncompressed big-endian x||y."""
+    if p.inf:
+        return b"\x00" * G1_BYTES_LEN
+    return p.x.to_bytes(32, "big") + p.y.to_bytes(32, "big")
+
+
+def g1_from_bytes(raw: bytes) -> G1:
+    """mathlib NewG1FromBytes: parse + on-curve check (cofactor 1 => in-group)."""
+    if len(raw) != G1_BYTES_LEN:
+        raise ValueError(f"invalid G1 encoding length {len(raw)}")
+    if raw == b"\x00" * G1_BYTES_LEN:
+        return bn254.G1_IDENTITY
+    x = int.from_bytes(raw[:32], "big")
+    y = int.from_bytes(raw[32:], "big")
+    if x >= bn254.P or y >= bn254.P:
+        raise ValueError("G1 coordinate out of range")
+    p = G1(x, y)
+    if not p.on_curve():
+        raise ValueError("point not on BN254 G1")
+    return p
+
+
+def zr_to_bytes(s: int) -> bytes:
+    """mathlib Zr.Bytes(): 32-byte big-endian of the value reduced mod r."""
+    return (s % R).to_bytes(32, "big")
+
+
+def zr_from_bytes(raw: bytes) -> int:
+    """mathlib NewZrFromBytes (fr.Element.SetBytes semantics: reduce mod r)."""
+    return int.from_bytes(raw, "big") % R
+
+
+def g1_array_bytes(points: list[G1]) -> bytes:
+    """G1Array.Bytes(): hex encodings joined by '||' (array.go:25-36)."""
+    return SEPARATOR.join(g1_to_bytes(p).hex().encode("ascii") for p in points)
+
+
+# --------------------------------------------------------------------------
+# DER primitives (definite-length, matching Go encoding/asn1 output)
+# --------------------------------------------------------------------------
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    out = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(out)]) + out
+
+
+def der_octet_string(b: bytes) -> bytes:
+    return b"\x04" + _der_len(len(b)) + b
+
+
+def der_integer(v: int) -> bytes:
+    if v == 0:
+        body = b"\x00"
+    else:
+        length = (v.bit_length() // 8) + 1  # minimal two's complement (v >= 0)
+        body = v.to_bytes(length, "big", signed=True)
+        # strip redundant leading 0x00 when the high bit is clear
+        while len(body) > 1 and body[0] == 0 and body[1] < 0x80:
+            body = body[1:]
+    return b"\x02" + _der_len(len(body)) + body
+
+
+def der_sequence(*parts: bytes) -> bytes:
+    body = b"".join(parts)
+    return b"\x30" + _der_len(len(body)) + body
+
+
+class DerReader:
+    def __init__(self, raw: bytes):
+        self.raw = raw
+        self.pos = 0
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.raw)
+
+    def _read_header(self, expected_tag: int) -> int:
+        if self.pos >= len(self.raw):
+            raise ValueError("DER: truncated")
+        tag = self.raw[self.pos]
+        if tag != expected_tag:
+            raise ValueError(f"DER: expected tag {expected_tag:#x}, got {tag:#x}")
+        self.pos += 1
+        if self.pos >= len(self.raw):
+            raise ValueError("DER: truncated length")
+        first = self.raw[self.pos]
+        self.pos += 1
+        if first < 0x80:
+            return first
+        nbytes = first & 0x7F
+        if nbytes == 0 or self.pos + nbytes > len(self.raw):
+            raise ValueError("DER: truncated length")
+        body = self.raw[self.pos:self.pos + nbytes]
+        # DER requires minimal length encoding (Go encoding/asn1 rejects
+        # non-minimal forms with a syntax error).
+        if body[0] == 0 or (nbytes == 1 and body[0] < 0x80):
+            raise ValueError("DER: non-minimal length")
+        length = int.from_bytes(body, "big")
+        self.pos += nbytes
+        return length
+
+    def read_octet_string(self) -> bytes:
+        n = self._read_header(0x04)
+        out = self.raw[self.pos:self.pos + n]
+        if len(out) != n:
+            raise ValueError("DER: truncated octet string")
+        self.pos += n
+        return out
+
+    def read_integer(self) -> int:
+        n = self._read_header(0x02)
+        body = self.raw[self.pos:self.pos + n]
+        if len(body) != n:
+            raise ValueError("DER: truncated integer")
+        self.pos += n
+        return int.from_bytes(body, "big", signed=True)
+
+    def read_sequence(self) -> "DerReader":
+        n = self._read_header(0x30)
+        body = self.raw[self.pos:self.pos + n]
+        if len(body) != n:
+            raise ValueError("DER: truncated sequence")
+        self.pos += n
+        return DerReader(body)
+
+
+# --------------------------------------------------------------------------
+# Go encoding/asn1 structures used by the reference
+# --------------------------------------------------------------------------
+
+def marshal_values(values: list[bytes]) -> bytes:
+    """asn1.Marshal(Values{Values: ...}): SEQUENCE { SEQUENCE OF OCTET STRING }."""
+    return der_sequence(der_sequence(*[der_octet_string(v) for v in values]))
+
+
+def unmarshal_values(raw: bytes) -> list[bytes]:
+    outer = DerReader(raw).read_sequence()
+    inner = outer.read_sequence()
+    out = []
+    while not inner.eof():
+        out.append(inner.read_octet_string())
+    return out
+
+
+def marshal_std_bytes_slices(values: list[bytes]) -> bytes:
+    """asn1.MarshalStd([][]byte): SEQUENCE OF OCTET STRING (single level)."""
+    return der_sequence(*[der_octet_string(v) for v in values])
+
+
+def marshal_element(curve_id: int, raw: bytes) -> bytes:
+    """asn1.Marshal(Element{CurveID, Raw}): SEQUENCE { INTEGER, OCTET STRING }."""
+    return der_sequence(der_integer(curve_id), der_octet_string(raw))
+
+
+def unmarshal_element(raw: bytes) -> tuple[int, bytes]:
+    seq = DerReader(raw).read_sequence()
+    return seq.read_integer(), seq.read_octet_string()
+
+
+# "MarshalMath"-style framing: a Values wrapper of per-element Element frames
+# (asn1.go:95-112). Elements are (kind, value) where kind selects encoding.
+
+G1_KIND = "g1"
+ZR_KIND = "zr"
+G1_ARRAY_KIND = "g1array"
+ZR_ARRAY_KIND = "zrarray"
+
+
+def element_bytes(kind: str, value) -> bytes:
+    if kind == G1_KIND:
+        return g1_to_bytes(value)
+    if kind == ZR_KIND:
+        return zr_to_bytes(value)
+    if kind == G1_ARRAY_KIND:
+        return marshal_values([g1_to_bytes(p) for p in value])
+    if kind == ZR_ARRAY_KIND:
+        return marshal_values([zr_to_bytes(s) for s in value])
+    raise ValueError(f"unknown element kind {kind}")
+
+
+def marshal_math(*elements: tuple[str, object]) -> bytes:
+    """MarshalMath(values...): each element framed, then wrapped in Values."""
+    if not elements:
+        raise ValueError("cannot marshal empty values")
+    frames = [
+        marshal_element(bn254.CURVE_ID, element_bytes(kind, value))
+        for kind, value in elements
+    ]
+    return marshal_values(frames)
+
+
+class MathUnmarshaller:
+    """Mirror of asn1.NewUnmarshaller: sequential typed element extraction."""
+
+    def __init__(self, raw: bytes):
+        self.frames = unmarshal_values(raw)
+        self.index = 0
+
+    def _next(self) -> tuple[int, bytes] | None:
+        if self.index >= len(self.frames):
+            return None
+        curve_id, body = unmarshal_element(self.frames[self.index])
+        self.index += 1
+        return curve_id, body
+
+    def next_g1(self) -> G1:
+        nxt = self._next()
+        if nxt is None:
+            raise ValueError("no more elements")
+        return g1_from_bytes(nxt[1])
+
+    def next_zr(self) -> int:
+        nxt = self._next()
+        if nxt is None:
+            raise ValueError("no more elements")
+        return zr_from_bytes(nxt[1])
+
+    def next_g1_array(self) -> list[G1]:
+        nxt = self._next()
+        if nxt is None:
+            raise ValueError("no more elements")
+        return [g1_from_bytes(b) for b in unmarshal_values(nxt[1])]
+
+    def next_zr_array(self) -> list[int]:
+        nxt = self._next()
+        if nxt is None:
+            raise ValueError("no more elements")
+        return [zr_from_bytes(b) for b in unmarshal_values(nxt[1])]
+
+
+def marshal_serializers(parts: list[bytes | None]) -> bytes:
+    """asn1.Marshal[Serializer](...): Values of pre-serialized members
+    (nil members encode as empty octet strings, asn1.go:40-55)."""
+    return marshal_values([p if p is not None else b"" for p in parts])
+
+
+def unmarshal_serializers(raw: bytes, count: int) -> list[bytes]:
+    vals = unmarshal_values(raw)
+    if len(vals) != count:
+        raise ValueError(f"number of values does not match: {len(vals)} != {count}")
+    return vals

@@ -1,0 +1,372 @@
+"""zkatdlog public parameters: generation, validation, serialization.
+
+Behavioral mirror of reference token/core/zkatdlog/nogh/v1/crypto/setup.go.
+
+Wire format (setup.go:271-317): the inner message is the proto3
+nogh.PublicParameters (protos/noghpp.proto), wrapped in the driver-level
+protos.PublicParameters{identifier, raw} which is JSON-encoded
+(token/core/common/encoding/pp/pp.go:16-22; raw is base64 in JSON, matching
+Go's encoding/json treatment of []byte).
+
+This framework extends the reference pp with optional TPU batching hints
+(batch size, device-mesh shape) carried OUTSIDE the reference message so the
+byte format stays compatible; see TpuBatchParams.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from ..utils import protowire as pw
+from . import bn254
+from . import serialization as ser
+from .bn254 import G1, fr_rand, g1_mul, hash_to_g1
+
+DLOG_PUBLIC_PARAMETERS = "zkatdlog"
+VERSION = "1.0.0"
+SUPPORTED_PRECISIONS = (16, 32, 64)
+
+
+class SetupError(Exception):
+    pass
+
+
+def _log2(x: int) -> int:
+    return x.bit_length() - 1
+
+
+# --------------------------------------------------------------------------
+# proto codecs for noghmath.proto / noghpp.proto messages
+# --------------------------------------------------------------------------
+
+def _g1_msg(p: G1 | None) -> bytes:
+    if p is None:
+        return b""
+    return pw.bytes_field(1, ser.g1_to_bytes(p))
+
+
+def _g1_from_msg(raw: bytes) -> G1:
+    fields = pw.parse_fields(raw)
+    if 1 not in fields:
+        raise SetupError("invalid G1 proto: missing raw")
+    return ser.g1_from_bytes(bytes(fields[1][0]))
+
+
+def _curve_id_msg(curve_id: int) -> bytes:
+    return pw.uint64_field(1, curve_id)
+
+
+def _identity_msg(raw: bytes) -> bytes:
+    return pw.bytes_field(1, raw)
+
+
+def _identity_from_msg(raw: bytes) -> bytes:
+    fields = pw.parse_fields(raw)
+    return bytes(fields[1][0]) if 1 in fields else b""
+
+
+@dataclass
+class RangeProofParams:
+    """reference setup.go:39-46."""
+
+    left_generators: list[G1] = field(default_factory=list)
+    right_generators: list[G1] = field(default_factory=list)
+    P: G1 = None
+    Q: G1 = None
+    bit_length: int = 0
+    number_of_rounds: int = 0
+
+    def validate(self) -> None:
+        """reference setup.go:48-78."""
+        if self.bit_length == 0:
+            raise SetupError("invalid range proof parameters: bit length is zero")
+        if self.number_of_rounds == 0:
+            raise SetupError("invalid range proof parameters: number of rounds is zero")
+        if self.number_of_rounds > 64:
+            raise SetupError(
+                "invalid range proof parameters: number of rounds must be smaller or equal to 64")
+        if self.bit_length != (1 << self.number_of_rounds):
+            raise SetupError(
+                f"invalid range proof parameters: bit length should be {1 << self.number_of_rounds}")
+        if len(self.left_generators) != len(self.right_generators):
+            raise SetupError(
+                "invalid range proof parameters: the size of the left generators does not "
+                f"match the size of the right generators [{len(self.left_generators)} vs, "
+                f"{len(self.right_generators)}]")
+        for name, pt in (("Q", self.Q), ("P", self.P)):
+            if pt is None or pt.is_identity() or not pt.on_curve():
+                raise SetupError(
+                    f"invalid range proof parameters: generator {name} is invalid")
+        for gens in (self.left_generators, self.right_generators):
+            if len(gens) != self.bit_length:
+                raise SetupError("invalid range proof parameters: wrong generator count")
+            for pt in gens:
+                if pt is None or pt.is_identity() or not pt.on_curve():
+                    raise SetupError("invalid range proof parameters: invalid generator")
+
+    def to_proto(self) -> bytes:
+        out = b""
+        for g in self.left_generators:
+            out += pw.message_field(1, _g1_msg(g))
+        for g in self.right_generators:
+            out += pw.message_field(2, _g1_msg(g))
+        out += pw.message_field(3, _g1_msg(self.P), present=self.P is not None)
+        out += pw.message_field(4, _g1_msg(self.Q), present=self.Q is not None)
+        out += pw.uint64_field(5, self.bit_length)
+        out += pw.uint64_field(6, self.number_of_rounds)
+        return out
+
+    @classmethod
+    def from_proto(cls, raw: bytes) -> "RangeProofParams":
+        fields = pw.parse_fields(raw)
+        rpp = cls()
+        rpp.left_generators = [_g1_from_msg(b) for b in fields.get(1, [])]
+        rpp.right_generators = [_g1_from_msg(b) for b in fields.get(2, [])]
+        if 3 in fields:
+            rpp.P = _g1_from_msg(fields[3][0])
+        if 4 in fields:
+            rpp.Q = _g1_from_msg(fields[4][0])
+        rpp.bit_length = fields.get(5, [0])[0]
+        rpp.number_of_rounds = fields.get(6, [0])[0]
+        return rpp
+
+
+@dataclass
+class IdemixIssuerPublicKey:
+    public_key: bytes = b""
+    curve: int = 0
+
+    def to_proto(self) -> bytes:
+        return (pw.bytes_field(1, self.public_key)
+                + pw.message_field(2, _curve_id_msg(self.curve), present=True))
+
+    @classmethod
+    def from_proto(cls, raw: bytes) -> "IdemixIssuerPublicKey":
+        fields = pw.parse_fields(raw)
+        pk = bytes(fields[1][0]) if 1 in fields else b""
+        curve = 0
+        if 2 in fields:
+            sub = pw.parse_fields(fields[2][0])
+            curve = sub.get(1, [0])[0]
+        return cls(pk, curve)
+
+
+@dataclass
+class TpuBatchParams:
+    """TPU-side batching hints emitted by our tokengen (--tpu-batch flags).
+
+    This is the tokengen extension called for by BASELINE.json ("tokengen
+    gains a flag to emit TPU-batched public parameters"). Carried beside the
+    reference-compatible blob, never inside it.
+    """
+
+    batch_size: int = 1024
+    mesh_devices: int = 1
+
+    def to_dict(self) -> dict:
+        return {"batch_size": self.batch_size, "mesh_devices": self.mesh_devices}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TpuBatchParams":
+        return cls(d.get("batch_size", 1024), d.get("mesh_devices", 1))
+
+
+@dataclass
+class PublicParams:
+    """reference setup.go:158-181."""
+
+    label: str = DLOG_PUBLIC_PARAMETERS
+    version: str = VERSION
+    curve: int = bn254.CURVE_ID
+    pedersen_generators: list[G1] = field(default_factory=list)
+    range_proof_params: RangeProofParams = None
+    idemix_issuer_public_keys: list[IdemixIssuerPublicKey] = field(default_factory=list)
+    auditor: bytes = b""
+    issuer_ids: list[bytes] = field(default_factory=list)
+    max_token: int = 0
+    quantity_precision: int = 0
+    # TPU batching hints; None means "not set" and keeps serialize() output
+    # byte-identical to a reference-produced container round trip.
+    tpu_batch: TpuBatchParams | None = None
+
+    # -- reference-facade properties ------------------------------------
+    def identifier(self) -> str:
+        return self.label
+
+    def token_data_hiding(self) -> bool:
+        return True
+
+    def graph_hiding(self) -> bool:
+        return False
+
+    def max_token_value(self) -> int:
+        return self.max_token
+
+    def precision(self) -> int:
+        return self.quantity_precision
+
+    def auditors(self) -> list[bytes]:
+        return [self.auditor] if self.auditor else []
+
+    def issuers(self) -> list[bytes]:
+        return list(self.issuer_ids)
+
+    def compute_max_token_value(self) -> int:
+        return (1 << self.range_proof_params.bit_length) - 1
+
+    def add_auditor(self, identity: bytes) -> None:
+        self.auditor = identity
+
+    def add_issuer(self, identity: bytes) -> None:
+        self.issuer_ids.append(identity)
+
+    # -- generation -----------------------------------------------------
+
+    def generate_pedersen_parameters(self) -> None:
+        """Three random generators (setup.go:374-386)."""
+        self.pedersen_generators = [
+            g1_mul(bn254.G1_GENERATOR, fr_rand()) for _ in range(3)
+        ]
+
+    def generate_range_proof_parameters(self, bit_length: int) -> None:
+        """Deterministic hash-to-curve generators (setup.go:388-406)."""
+        self.range_proof_params = RangeProofParams(
+            P=hash_to_g1(b"0"),
+            Q=hash_to_g1(b"1"),
+            bit_length=bit_length,
+            number_of_rounds=_log2(bit_length),
+            left_generators=[
+                hash_to_g1(f"RangeProof.{2 * (i + 1)}".encode())
+                for i in range(bit_length)
+            ],
+            right_generators=[
+                hash_to_g1(f"RangeProof.{2 * (i + 1) + 1}".encode())
+                for i in range(bit_length)
+            ],
+        )
+
+    # -- serialization --------------------------------------------------
+
+    def to_proto(self) -> bytes:
+        out = pw.string_field(1, self.label)
+        out += pw.string_field(2, self.version)
+        out += pw.message_field(3, _curve_id_msg(self.curve), present=True)
+        for g in self.pedersen_generators:
+            out += pw.message_field(4, _g1_msg(g))
+        out += pw.message_field(5, self.range_proof_params.to_proto(),
+                                present=self.range_proof_params is not None)
+        for k in self.idemix_issuer_public_keys:
+            out += pw.message_field(6, k.to_proto())
+        out += pw.message_field(7, _identity_msg(self.auditor), present=True)
+        for issuer in self.issuer_ids:
+            out += pw.message_field(8, _identity_msg(issuer))
+        out += pw.uint64_field(9, self.max_token)
+        out += pw.uint64_field(10, self.quantity_precision)
+        return out
+
+    def serialize(self) -> bytes:
+        """Full container: JSON{identifier, raw=base64(proto)} (+ tpu hints)."""
+        raw = self.to_proto()
+        container = {
+            "identifier": self.label,
+            "raw": base64.b64encode(raw).decode("ascii"),
+        }
+        if self.tpu_batch is not None:
+            # extension key ignored by reference-style parsers
+            container["tpu_batch"] = self.tpu_batch.to_dict()
+        return json.dumps(container, separators=(",", ":"), sort_keys=False).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes, label: str = DLOG_PUBLIC_PARAMETERS) -> "PublicParams":
+        try:
+            container = json.loads(raw.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise SetupError(f"failed to deserialize public parameters: {e}") from e
+        if container.get("identifier") != label:
+            raise SetupError(
+                f"invalid identifier, expecting [{label}], got [{container.get('identifier')}]")
+        body = base64.b64decode(container.get("raw", ""))
+        fields = pw.parse_fields(body)
+        pp = cls()
+        pp.label = fields.get(1, [b""])[0].decode() if 1 in fields else ""
+        pp.version = fields.get(2, [b""])[0].decode() if 2 in fields else ""
+        if 3 not in fields:
+            raise SetupError("invalid curve id, expecting curve id, got nil")
+        pp.curve = pw.parse_fields(fields[3][0]).get(1, [0])[0]
+        pp.pedersen_generators = [_g1_from_msg(b) for b in fields.get(4, [])]
+        if 5 in fields:
+            pp.range_proof_params = RangeProofParams.from_proto(fields[5][0])
+        else:
+            pp.range_proof_params = None
+        pp.idemix_issuer_public_keys = [
+            IdemixIssuerPublicKey.from_proto(b) for b in fields.get(6, [])
+        ]
+        if 7 in fields:
+            pp.auditor = _identity_from_msg(fields[7][0])
+        pp.issuer_ids = [_identity_from_msg(b) for b in fields.get(8, [])]
+        pp.max_token = fields.get(9, [0])[0]
+        pp.quantity_precision = fields.get(10, [0])[0]
+        if "tpu_batch" in container:
+            pp.tpu_batch = TpuBatchParams.from_dict(container["tpu_batch"])
+        return pp
+
+    def compute_hash(self) -> bytes:
+        return hashlib.sha256(self.serialize()).digest()
+
+    # -- validation (setup.go:444-489) ----------------------------------
+
+    def validate(self) -> None:
+        if len(self.idemix_issuer_public_keys) != 1:
+            raise SetupError(
+                f"expected one idemix issuer public key, found [{len(self.idemix_issuer_public_keys)}]")
+        for issuer in self.idemix_issuer_public_keys:
+            if not issuer.public_key:
+                raise SetupError("expected idemix issuer public key to be non-empty")
+        if len(self.pedersen_generators) != 3:
+            raise SetupError("invalid pedersen generators")
+        for pt in self.pedersen_generators:
+            if pt is None or pt.is_identity() or not pt.on_curve():
+                raise SetupError("invalid pedersen generators")
+        if self.range_proof_params is None:
+            raise SetupError("invalid public parameters: nil range proof parameters")
+        if self.range_proof_params.bit_length not in SUPPORTED_PRECISIONS:
+            raise SetupError(
+                f"invalid bit length [{self.range_proof_params.bit_length}], "
+                f"should be one of {list(SUPPORTED_PRECISIONS)}")
+        self.range_proof_params.validate()
+        if self.quantity_precision != self.range_proof_params.bit_length:
+            raise SetupError(
+                "invalid public parameters: quantity precision should be "
+                f"[{self.range_proof_params.bit_length}] instead it is [{self.quantity_precision}]")
+        if self.compute_max_token_value() != self.max_token:
+            raise SetupError(
+                f"invalid maxt token, [{self.compute_max_token_value()}]!=[{self.max_token}]")
+
+
+def setup(bit_length: int, idemix_issuer_pk: bytes = b"\x00",
+          idemix_curve_id: int = bn254.CURVE_ID,
+          label: str = DLOG_PUBLIC_PARAMETERS,
+          tpu_batch: TpuBatchParams | None = None) -> PublicParams:
+    """reference setup.go:192-225."""
+    if bit_length > 64:
+        raise SetupError(f"invalid bit length [{bit_length}], should be smaller than 64")
+    if bit_length == 0:
+        raise SetupError("invalid bit length, should be greater than 0")
+    pp = PublicParams(
+        label=label,
+        curve=bn254.CURVE_ID,
+        version=VERSION,
+        idemix_issuer_public_keys=[
+            IdemixIssuerPublicKey(public_key=idemix_issuer_pk, curve=idemix_curve_id)
+        ],
+        quantity_precision=bit_length,
+    )
+    pp.generate_pedersen_parameters()
+    pp.generate_range_proof_parameters(bit_length)
+    pp.max_token = pp.compute_max_token_value()
+    if tpu_batch is not None:
+        pp.tpu_batch = tpu_batch
+    return pp

@@ -1,0 +1,85 @@
+"""Token commitments and openings.
+
+Behavioral mirror of reference token/core/zkatdlog/nogh/v1/crypto/token/token.go:
+a zkatdlog token is (Owner bytes, Data = g0^H(type) * g1^value * g2^bf in G1);
+metadata carries the opening (Type, Value, BlindingFactor, Issuer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import bn254
+from .bn254 import G1, fr_rand, g1_add, g1_mul, hash_to_zr
+
+
+class TokenError(Exception):
+    pass
+
+
+def commit(vector: list[int], generators: list[G1]) -> G1:
+    """Pedersen commitment (token.go:208-217)."""
+    com = bn254.G1_IDENTITY
+    for i, v in enumerate(vector):
+        if v is None:
+            raise TokenError("cannot commit a nil element")
+        com = g1_add(com, g1_mul(generators[i], v))
+    return com
+
+
+def commit_token(token_type: str, value: int, blinding_factor: int,
+                 pedersen_generators: list[G1]) -> G1:
+    """Data = g0^H(type) g1^value g2^bf (token.go:95-107)."""
+    return commit([hash_to_zr(token_type.encode()), value, blinding_factor],
+                  pedersen_generators)
+
+
+@dataclass
+class TokenDataWitness:
+    """Opening of Data (token.go:182-196)."""
+
+    token_type: str
+    value: int
+    blinding_factor: int
+
+    def clone(self) -> "TokenDataWitness":
+        return TokenDataWitness(self.token_type, self.value, self.blinding_factor)
+
+    def as_tuple(self) -> tuple[str, int, int]:
+        return (self.token_type, self.value, self.blinding_factor)
+
+
+def get_tokens_with_witness(values: list[int], token_type: str,
+                            pedersen_generators: list[G1]
+                            ) -> tuple[list[G1], list[TokenDataWitness]]:
+    """Fresh commitments + witnesses for output values (token.go:109-130)."""
+    witnesses = [TokenDataWitness(token_type, v, fr_rand()) for v in values]
+    tokens = [
+        commit_token(w.token_type, w.value, w.blinding_factor, pedersen_generators)
+        for w in witnesses
+    ]
+    return tokens, witnesses
+
+
+def to_clear(data: G1, owner: bytes, token_type: str, value: int,
+             blinding_factor: int, pedersen_generators: list[G1]) -> dict:
+    """Open a committed token and fail if the opening mismatches
+    (token.go:69-83). Returns the clear token {type, quantity, owner}."""
+    com = commit_token(token_type, value, blinding_factor, pedersen_generators)
+    if com != data:
+        raise TokenError(
+            "cannot retrieve token in the clear: output does not match provided opening")
+    return {"type": token_type, "quantity": hex(value), "owner": owner}
+
+
+def audit_inspect_output(data: G1, token_type: str, value: int,
+                         blinding_factor: int,
+                         pedersen_generators: list[G1]) -> None:
+    """Auditor commitment-reopen check (reference crypto/audit/auditor.go:225-246):
+    recompute commit(H(type), v, bf) and compare with the token data. This is
+    the per-output check that models.audit batches on TPU."""
+    if value is None or blinding_factor is None:
+        raise TokenError("invalid opening")
+    com = commit_token(token_type, value, blinding_factor, pedersen_generators)
+    if com != data:
+        raise TokenError("output does not match the provided opening")

@@ -1,0 +1,250 @@
+"""Host-side transfer proof: type-and-sum Σ-protocol + range correctness.
+
+Behavioral mirror of:
+  - reference token/core/zkatdlog/nogh/v1/crypto/transfer/typeandsum.go
+  - reference token/core/zkatdlog/nogh/v1/crypto/transfer/transfer.go
+
+A transfer proof shows (1) all inputs and outputs commit to one shared type,
+(2) sum of input values equals sum of output values, and (3) every output
+value lies in [0, 2^BitLength) — except for 1-in/1-out ownership transfers,
+where the range part is skipped (transfer.go:53-57,101-112).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import bn254
+from . import rp as rp_mod
+from . import serialization as ser
+from .bn254 import (
+    G1,
+    fr_add,
+    fr_mul,
+    fr_rand,
+    fr_sub,
+    g1_add,
+    g1_mul,
+    g1_neg,
+    hash_to_zr,
+)
+from .rp import ProofError, RangeCorrectness
+
+
+@dataclass
+class TypeAndSumProof:
+    """reference typeandsum.go:19-34."""
+
+    commitment_to_type: G1 = None
+    input_blinding_factors: list[int] = field(default_factory=list)
+    input_values: list[int] = field(default_factory=list)
+    type_: int = None
+    type_blinding_factor: int = None
+    equality_of_sum: int = None
+    challenge: int = None
+
+    def serialize(self) -> bytes:
+        # reference typeandsum.go:37-55
+        return ser.marshal_math(
+            (ser.G1_KIND, self.commitment_to_type),
+            (ser.ZR_ARRAY_KIND, self.input_blinding_factors),
+            (ser.ZR_ARRAY_KIND, self.input_values),
+            (ser.ZR_KIND, self.type_),
+            (ser.ZR_KIND, self.type_blinding_factor),
+            (ser.ZR_KIND, self.equality_of_sum),
+            (ser.ZR_KIND, self.challenge),
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TypeAndSumProof":
+        um = ser.MathUnmarshaller(raw)
+        return cls(um.next_g1(), um.next_zr_array(), um.next_zr_array(),
+                   um.next_zr(), um.next_zr(), um.next_zr(), um.next_zr())
+
+
+def _transcript_bytes(in_coms: list[G1], type_com: G1, sum_com: G1,
+                      inputs: list[G1], outputs: list[G1],
+                      commitment_to_type: G1, sum_: G1) -> bytes:
+    """Challenge input ordering per typeandsum.go:214,267."""
+    return ser.g1_array_bytes(
+        list(in_coms) + [type_com, sum_com] + list(inputs) + list(outputs)
+        + [commitment_to_type, sum_])
+
+
+def type_and_sum_prove(ped_params: list[G1], inputs: list[G1], outputs: list[G1],
+                       commitment_to_type: G1, in_values: list[int],
+                       in_bfs: list[int], out_bfs: list[int], type_zr: int,
+                       type_bf: int) -> TypeAndSumProof:
+    """reference typeandsum.go:189-227,280-356."""
+    # randomness + commitments (computeCommitments, typeandsum.go:319-356)
+    r_type = fr_rand()
+    r_type_bf = fr_rand()
+    com_type = g1_add(g1_mul(ped_params[0], r_type), g1_mul(ped_params[2], r_type_bf))
+    r_in_values = [fr_rand() for _ in inputs]
+    r_in_bfs = [fr_rand() for _ in inputs]
+    com_inputs = [
+        g1_add(g1_mul(ped_params[1], r_in_values[i]), g1_mul(ped_params[2], r_in_bfs[i]))
+        for i in range(len(inputs))
+    ]
+    r_sum_bf = fr_rand()
+    com_sum = g1_mul(ped_params[2], r_sum_bf)
+
+    # adjusted statement (Prove, typeandsum.go:195-211)
+    adj_inputs = []
+    adj_outputs = []
+    sum_ = bn254.G1_IDENTITY
+    for pt in inputs:
+        a = g1_add(pt, g1_neg(commitment_to_type))
+        adj_inputs.append(a)
+        sum_ = g1_add(sum_, a)
+    for pt in outputs:
+        a = g1_add(pt, g1_neg(commitment_to_type))
+        adj_outputs.append(a)
+        sum_ = g1_add(sum_, g1_neg(a))
+
+    chal = hash_to_zr(_transcript_bytes(
+        com_inputs, com_type, com_sum, adj_inputs, adj_outputs,
+        commitment_to_type, sum_))
+
+    # responses (computeProof, typeandsum.go:280-316)
+    proof = TypeAndSumProof(commitment_to_type=commitment_to_type, challenge=chal)
+    proof.type_ = fr_add(fr_mul(chal, type_zr), r_type)
+    proof.type_blinding_factor = fr_add(fr_mul(chal, type_bf), r_type_bf)
+    sum_bf = 0
+    for i in range(len(inputs)):
+        proof.input_values.append(fr_add(fr_mul(chal, in_values[i]), r_in_values[i]))
+        t = fr_sub(in_bfs[i], type_bf)
+        proof.input_blinding_factors.append(fr_add(fr_mul(chal, t), r_in_bfs[i]))
+        sum_bf = fr_add(sum_bf, t)
+    for i in range(len(outputs)):
+        t = fr_sub(out_bfs[i], type_bf)
+        sum_bf = fr_sub(sum_bf, t)
+    proof.equality_of_sum = fr_add(fr_mul(chal, sum_bf), r_sum_bf)
+    return proof
+
+
+def type_and_sum_verify(proof: TypeAndSumProof, ped_params: list[G1],
+                        inputs: list[G1], outputs: list[G1]) -> None:
+    """reference typeandsum.go:230-277. Raises ProofError on rejection."""
+    if (proof.type_blinding_factor is None or proof.type_ is None
+            or proof.commitment_to_type is None or proof.equality_of_sum is None):
+        raise ProofError("invalid sum and type proof")
+    if len(proof.input_values) < len(inputs) or len(proof.input_blinding_factors) < len(inputs):
+        raise ProofError("invalid sum and type proof")
+
+    adj_inputs = []
+    adj_outputs = []
+    sum_ = bn254.G1_IDENTITY
+    in_coms = []
+    for i, pt in enumerate(inputs):
+        if proof.input_values[i] is None:
+            raise ProofError("invalid sum and type proof")
+        a = g1_add(pt, g1_neg(proof.commitment_to_type))
+        adj_inputs.append(a)
+        sum_ = g1_add(sum_, a)
+        c = g1_add(g1_mul(ped_params[1], proof.input_values[i]),
+                   g1_mul(ped_params[2], proof.input_blinding_factors[i]))
+        c = g1_add(c, g1_neg(g1_mul(a, proof.challenge)))
+        in_coms.append(c)
+    for pt in outputs:
+        a = g1_add(pt, g1_neg(proof.commitment_to_type))
+        adj_outputs.append(a)
+        sum_ = g1_add(sum_, g1_neg(a))
+
+    sum_com = g1_add(g1_mul(ped_params[2], proof.equality_of_sum),
+                     g1_neg(g1_mul(sum_, proof.challenge)))
+    type_com = g1_add(g1_mul(ped_params[0], proof.type_),
+                      g1_mul(ped_params[2], proof.type_blinding_factor))
+    type_com = g1_add(type_com, g1_neg(g1_mul(proof.commitment_to_type, proof.challenge)))
+
+    chal = hash_to_zr(_transcript_bytes(
+        in_coms, type_com, sum_com, adj_inputs, adj_outputs,
+        proof.commitment_to_type, sum_))
+    if chal != proof.challenge:
+        raise ProofError("invalid sum and type proof")
+
+
+# --------------------------------------------------------------------------
+# Transfer proof composition (transfer.go)
+# --------------------------------------------------------------------------
+
+@dataclass
+class TransferProof:
+    type_and_sum: TypeAndSumProof = None
+    range_correctness: RangeCorrectness = None
+
+    def serialize(self) -> bytes:
+        # reference transfer.go:31-33
+        rc = self.range_correctness.serialize() if self.range_correctness else None
+        return ser.marshal_serializers([self.type_and_sum.serialize(), rc])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferProof":
+        parts = ser.unmarshal_serializers(raw, 2)
+        ts = TypeAndSumProof.deserialize(parts[0])
+        rc = RangeCorrectness.deserialize(parts[1]) if parts[1] else RangeCorrectness()
+        return cls(ts, rc)
+
+
+def transfer_prove(input_witness: list[tuple[str, int, int]],
+                   output_witness: list[tuple[str, int, int]],
+                   inputs: list[G1], outputs: list[G1], pp) -> bytes:
+    """reference transfer.go:69-150. Witnesses are (type, value, blinding_factor).
+
+    pp is a crypto.setup.PublicParams.
+    """
+    token_type = input_witness[0][0]
+    type_zr = hash_to_zr(token_type.encode())
+    type_bf = fr_rand()
+    commitment_to_type = g1_add(g1_mul(pp.pedersen_generators[0], type_zr),
+                                g1_mul(pp.pedersen_generators[2], type_bf))
+
+    in_values = [w[1] for w in input_witness]
+    in_bfs = [w[2] for w in input_witness]
+    out_bfs = [w[2] for w in output_witness]
+
+    ts = type_and_sum_prove(pp.pedersen_generators, inputs, outputs,
+                            commitment_to_type, in_values, in_bfs, out_bfs,
+                            type_zr, type_bf)
+
+    rc = None
+    if len(input_witness) != 1 or len(output_witness) != 1:
+        coms = [g1_add(outputs[i], g1_neg(commitment_to_type))
+                for i in range(len(outputs))]
+        values = [w[1] for w in output_witness]
+        bfs = [fr_sub(w[2], type_bf) for w in output_witness]
+        rpp = pp.range_proof_params
+        rc = rp_mod.range_correctness_prove(
+            coms, values, bfs, pp.pedersen_generators[1:],
+            rpp.left_generators, rpp.right_generators, rpp.P, rpp.Q,
+            rpp.bit_length, rpp.number_of_rounds)
+
+    return TransferProof(type_and_sum=ts, range_correctness=rc).serialize()
+
+
+def transfer_verify(proof_raw: bytes, inputs: list[G1], outputs: list[G1],
+                    pp) -> None:
+    """reference transfer.go:153-197. Raises ProofError on rejection."""
+    try:
+        proof = TransferProof.deserialize(proof_raw)
+    except (ValueError, ProofError) as e:
+        raise ProofError(f"invalid transfer proof: {e}") from e
+    if proof.type_and_sum is None:
+        raise ProofError("invalid transfer proof")
+
+    try:
+        type_and_sum_verify(proof.type_and_sum, pp.pedersen_generators,
+                            inputs, outputs)
+    except ProofError as e:
+        raise ProofError(f"invalid transfer proof: {e}") from e
+
+    if len(inputs) != 1 or len(outputs) != 1:
+        if proof.range_correctness is None:
+            raise ProofError("invalid transfer proof")
+        coms = [g1_add(o, g1_neg(proof.type_and_sum.commitment_to_type))
+                for o in outputs]
+        rpp = pp.range_proof_params
+        rp_mod.range_correctness_verify(
+            proof.range_correctness, coms, pp.pedersen_generators[1:],
+            rpp.left_generators, rpp.right_generators, rpp.P, rpp.Q,
+            rpp.bit_length, rpp.number_of_rounds)

@@ -1,0 +1,1 @@
+"""Shared codecs and helpers (json codec, proto wire format, slices)."""

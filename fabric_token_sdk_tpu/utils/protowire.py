@@ -1,0 +1,115 @@
+"""Minimal proto3 wire-format codec.
+
+The reference serializes public parameters and actions with protobuf
+(reference token/core/zkatdlog/nogh/protos/*.proto, token/driver/protos/*.proto).
+This hand-rolled codec produces byte-identical output for the message shapes
+used there (varint + length-delimited fields, tag order, proto3 default
+omission) without requiring generated code.
+"""
+
+from __future__ import annotations
+
+VARINT = 0
+I64 = 1
+LEN = 2
+I32 = 5
+
+
+def encode_varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64  # proto int64 two's complement
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("proto: truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift >= 70:
+            raise ValueError("proto: varint too long")
+
+
+def tag(field_number: int, wire_type: int) -> bytes:
+    return encode_varint((field_number << 3) | wire_type)
+
+
+def uint64_field(field_number: int, value: int) -> bytes:
+    """proto3 scalar: omitted when zero."""
+    if value == 0:
+        return b""
+    return tag(field_number, VARINT) + encode_varint(value)
+
+
+def bytes_field(field_number: int, value: bytes | None) -> bytes:
+    """proto3 bytes/string/submessage: omitted when empty/None.
+
+    Note: a present-but-empty submessage must be emitted explicitly with
+    message_field(..., force=True) semantics by callers that need it.
+    """
+    if not value:
+        return b""
+    return tag(field_number, LEN) + encode_varint(len(value)) + value
+
+
+def message_field(field_number: int, body: bytes | None, present: bool = None) -> bytes:
+    """Submessage: emitted when present (even if empty body)."""
+    if present is None:
+        present = body is not None
+    if not present:
+        return b""
+    body = body or b""
+    return tag(field_number, LEN) + encode_varint(len(body)) + body
+
+
+def string_field(field_number: int, value: str) -> bytes:
+    return bytes_field(field_number, value.encode("utf-8"))
+
+
+def iter_fields(data: bytes):
+    """Yield (field_number, wire_type, value) over a serialized message."""
+    pos = 0
+    while pos < len(data):
+        key, pos = decode_varint(data, pos)
+        field_number = key >> 3
+        wire_type = key & 7
+        if wire_type == VARINT:
+            value, pos = decode_varint(data, pos)
+        elif wire_type == LEN:
+            length, pos = decode_varint(data, pos)
+            if pos + length > len(data):
+                raise ValueError("proto: truncated length-delimited field")
+            value = data[pos:pos + length]
+            pos += length
+        elif wire_type == I64:
+            value = data[pos:pos + 8]
+            pos += 8
+        elif wire_type == I32:
+            value = data[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"proto: unsupported wire type {wire_type}")
+        yield field_number, wire_type, value
+
+
+def parse_fields(data: bytes) -> dict[int, list]:
+    """Collect fields into {field_number: [values...]} preserving order."""
+    out: dict[int, list] = {}
+    for num, _, value in iter_fields(data):
+        out.setdefault(num, []).append(value)
+    return out

@@ -1,0 +1,13 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Real-TPU execution is exercised by bench.py and the driver's graft entry;
+the test suite validates numerics and sharding on the CPU backend so it runs
+anywhere (SURVEY.md §7: multi-chip is tested via virtual devices).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,131 @@
+"""Oracle sanity tests for the pure-Python BN254 layer."""
+
+import hashlib
+
+from fabric_token_sdk_tpu.crypto import bn254, serialization as ser
+from fabric_token_sdk_tpu.crypto.bn254 import (
+    G1_GENERATOR,
+    G1_IDENTITY,
+    P,
+    R,
+    g1_add,
+    g1_mul,
+    g1_neg,
+    hash_to_g1,
+    hash_to_zr,
+    map_to_curve_svdw,
+)
+
+
+def test_curve_parameters():
+    # generator on curve, subgroup order r
+    assert G1_GENERATOR.on_curve()
+    assert g1_mul(G1_GENERATOR, R).is_identity()
+    assert P % 4 == 3  # sqrt via (p+1)/4 is valid
+
+
+def test_group_laws():
+    a = g1_mul(G1_GENERATOR, 1234567)
+    b = g1_mul(G1_GENERATOR, 7654321)
+    assert g1_add(a, b) == g1_add(b, a)
+    assert g1_add(a, G1_IDENTITY) == a
+    assert g1_add(a, g1_neg(a)).is_identity()
+    # (a+b)G == aG + bG
+    assert g1_mul(G1_GENERATOR, 1234567 + 7654321) == g1_add(a, b)
+    # distributivity with reduction mod r
+    assert g1_mul(G1_GENERATOR, R + 5) == g1_mul(G1_GENERATOR, 5)
+
+
+def test_small_multiples_match_known_values():
+    # 2G for BN254 is a fixed, widely published value (EIP-196 test vectors).
+    two_g = g1_mul(G1_GENERATOR, 2)
+    assert two_g.x == 1368015179489954701390400359078579693043519447331113978918064868415326638035
+    assert two_g.y == 9918110051302171585080402603319702774565515993150576347155970296011118125764
+
+
+def test_hash_to_zr_is_sha256_mod_r():
+    data = b"hello fiat shamir"
+    expected = int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+    assert hash_to_zr(data) == expected
+
+
+def test_map_to_curve_outputs_on_curve():
+    for u in [0, 1, 2, 12345, P - 1, 987654321987654321]:
+        assert map_to_curve_svdw(u % P).on_curve()
+
+
+def test_hash_to_g1_on_curve_and_deterministic():
+    p1 = hash_to_g1(b"RangeProof.2")
+    p2 = hash_to_g1(b"RangeProof.2")
+    p3 = hash_to_g1(b"RangeProof.3")
+    assert p1 == p2
+    assert p1 != p3
+    assert p1.on_curve()
+    assert g1_mul(p1, R).is_identity()
+
+
+def test_g1_bytes_roundtrip():
+    p = g1_mul(G1_GENERATOR, 424242)
+    raw = ser.g1_to_bytes(p)
+    assert len(raw) == 64
+    assert ser.g1_from_bytes(raw) == p
+    assert ser.g1_from_bytes(b"\x00" * 64).is_identity()
+
+
+def test_g1_from_bytes_rejects_off_curve():
+    raw = bytearray(ser.g1_to_bytes(g1_mul(G1_GENERATOR, 7)))
+    raw[63] ^= 1
+    try:
+        ser.g1_from_bytes(bytes(raw))
+        raise AssertionError("expected rejection")
+    except ValueError:
+        pass
+
+
+def test_zr_bytes_roundtrip():
+    s = 0x1234567890ABCDEF
+    assert ser.zr_from_bytes(ser.zr_to_bytes(s)) == s
+    # reduction semantics
+    assert ser.zr_from_bytes((R + 3).to_bytes(32, "big")) == 3
+
+
+def test_der_matches_go_asn1_shapes():
+    # Values{Values: [][]byte{"ab", "cd"}} framing round-trip
+    raw = ser.marshal_values([b"ab", b"cd"])
+    assert ser.unmarshal_values(raw) == [b"ab", b"cd"]
+    # Element framing
+    el = ser.marshal_element(1, b"\x01\x02")
+    assert ser.unmarshal_element(el) == (1, b"\x01\x02")
+    # hand-checked DER: SEQUENCE { SEQUENCE { OCTET STRING "ab" } }
+    assert ser.marshal_values([b"ab"]) == bytes.fromhex("3006" "3004" "0402" "6162")
+    # INTEGER minimal encoding incl. high-bit padding
+    assert ser.der_integer(1) == bytes.fromhex("020101")
+    assert ser.der_integer(128) == bytes.fromhex("02020080")
+    assert ser.der_integer(0) == bytes.fromhex("020100")
+
+
+def test_marshal_math_roundtrip():
+    p = g1_mul(G1_GENERATOR, 99)
+    q = g1_mul(G1_GENERATOR, 101)
+    raw = ser.marshal_math(
+        (ser.G1_KIND, p),
+        (ser.ZR_KIND, 42),
+        (ser.G1_ARRAY_KIND, [p, q]),
+        (ser.ZR_ARRAY_KIND, [1, 2, 3]),
+    )
+    um = ser.MathUnmarshaller(raw)
+    assert um.next_g1() == p
+    assert um.next_zr() == 42
+    assert um.next_g1_array() == [p, q]
+    assert um.next_zr_array() == [1, 2, 3]
+
+
+def test_g1_array_bytes_format():
+    p = g1_mul(G1_GENERATOR, 3)
+    q = g1_mul(G1_GENERATOR, 5)
+    raw = ser.g1_array_bytes([p, q])
+    parts = raw.split(b"||")
+    assert parts == [
+        ser.g1_to_bytes(p).hex().encode(),
+        ser.g1_to_bytes(q).hex().encode(),
+    ]

@@ -1,0 +1,196 @@
+"""Round-trip + tamper tests for the host proof layer (oracle).
+
+Mirrors the reference unit test strategy (SURVEY.md §4: ginkgo suites in
+crypto/rp, crypto/transfer, crypto/issue do prove/verify round trips and
+tamper checks)."""
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254, rp, setup as setup_mod
+from fabric_token_sdk_tpu.crypto import issue_proof, token_commit, transfer_proof
+from fabric_token_sdk_tpu.crypto.bn254 import fr_rand, fr_sub, g1_add, g1_mul, g1_neg
+from fabric_token_sdk_tpu.crypto.rp import ProofError
+
+
+@pytest.fixture(scope="module")
+def pp16():
+    return setup_mod.setup(16)
+
+
+def _value_commitment(pp, value, bf):
+    # com = G^v H^bf with (G, H) = PedersenGenerators[1:]
+    gens = pp.pedersen_generators
+    return g1_add(g1_mul(gens[1], value), g1_mul(gens[2], bf))
+
+
+class TestRangeProof:
+    def test_roundtrip_accept(self, pp16):
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        com = _value_commitment(pp16, 250, bf)
+        proof = rp.range_prove(com, 250, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        rp.range_verify(proof, com, pp16.pedersen_generators[1:],
+                        rpp.left_generators, rpp.right_generators,
+                        rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+    def test_serialization_roundtrip(self, pp16):
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        com = _value_commitment(pp16, 77, bf)
+        proof = rp.range_prove(com, 77, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        raw = proof.serialize()
+        restored = rp.RangeProof.deserialize(raw)
+        assert restored.serialize() == raw
+        rp.range_verify(restored, com, pp16.pedersen_generators[1:],
+                        rpp.left_generators, rpp.right_generators,
+                        rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+    def test_out_of_range_value_rejected(self, pp16):
+        # prove with a value that exceeds 2^16 - the bit decomposition
+        # truncates, so the outer polynomial check must fail
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        value = (1 << 16) + 5
+        com = _value_commitment(pp16, value, bf)
+        proof = rp.range_prove(com, value, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        with pytest.raises(ProofError, match="invalid range proof"):
+            rp.range_verify(proof, com, pp16.pedersen_generators[1:],
+                            rpp.left_generators, rpp.right_generators,
+                            rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+    def test_tampered_proof_rejected(self, pp16):
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        com = _value_commitment(pp16, 33, bf)
+        proof = rp.range_prove(com, 33, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        proof.data.tau = fr_rand()
+        with pytest.raises(ProofError):
+            rp.range_verify(proof, com, pp16.pedersen_generators[1:],
+                            rpp.left_generators, rpp.right_generators,
+                            rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+    def test_wrong_commitment_rejected(self, pp16):
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        com = _value_commitment(pp16, 33, bf)
+        other = _value_commitment(pp16, 34, bf)
+        proof = rp.range_prove(com, 33, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        with pytest.raises(ProofError):
+            rp.range_verify(proof, other, pp16.pedersen_generators[1:],
+                            rpp.left_generators, rpp.right_generators,
+                            rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+    def test_tampered_ipa_rejected(self, pp16):
+        rpp = pp16.range_proof_params
+        bf = fr_rand()
+        com = _value_commitment(pp16, 100, bf)
+        proof = rp.range_prove(com, 100, pp16.pedersen_generators[1:], bf,
+                               rpp.left_generators, rpp.right_generators,
+                               rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+        proof.ipa.left = fr_rand()
+        with pytest.raises(ProofError, match="invalid IPA"):
+            rp.range_verify(proof, com, pp16.pedersen_generators[1:],
+                            rpp.left_generators, rpp.right_generators,
+                            rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length)
+
+
+class TestTransferProof:
+    def _make_transfer(self, pp, in_vals, out_vals, tamper_out_value=None):
+        token_type = "USD"
+        in_tokens, in_w = token_commit.get_tokens_with_witness(
+            in_vals, token_type, pp.pedersen_generators)
+        out_tokens, out_w = token_commit.get_tokens_with_witness(
+            out_vals, token_type, pp.pedersen_generators)
+        if tamper_out_value is not None:
+            # change a committed output value without updating the witness sum
+            out_w[0].value = tamper_out_value
+            out_tokens[0] = token_commit.commit_token(
+                token_type, tamper_out_value, out_w[0].blinding_factor,
+                pp.pedersen_generators)
+        proof = transfer_proof.transfer_prove(
+            [w.as_tuple() for w in in_w], [w.as_tuple() for w in out_w],
+            in_tokens, out_tokens, pp)
+        return proof, in_tokens, out_tokens
+
+    def test_two_in_two_out_accept(self, pp16):
+        proof, ins, outs = self._make_transfer(pp16, [40, 60], [30, 70])
+        transfer_proof.transfer_verify(proof, ins, outs, pp16)
+
+    def test_one_in_one_out_skips_range(self, pp16):
+        proof, ins, outs = self._make_transfer(pp16, [50], [50])
+        parsed = transfer_proof.TransferProof.deserialize(proof)
+        assert parsed.range_correctness is None or not parsed.range_correctness.proofs
+        transfer_proof.transfer_verify(proof, ins, outs, pp16)
+
+    def test_unbalanced_rejected(self, pp16):
+        proof, ins, outs = self._make_transfer(pp16, [40, 60], [30, 71])
+        with pytest.raises(ProofError, match="invalid transfer proof"):
+            transfer_proof.transfer_verify(proof, ins, outs, pp16)
+
+    def test_swapped_statement_rejected(self, pp16):
+        proof, ins, outs = self._make_transfer(pp16, [40, 60], [30, 70])
+        with pytest.raises(ProofError):
+            transfer_proof.transfer_verify(proof, outs, ins, pp16)
+
+
+class TestIssueProof:
+    def test_roundtrip_accept(self, pp16):
+        tokens, w = token_commit.get_tokens_with_witness(
+            [10, 20], "EUR", pp16.pedersen_generators)
+        proof = issue_proof.issue_prove([x.as_tuple() for x in w], tokens, pp16)
+        issue_proof.issue_verify(proof, tokens, pp16)
+
+    def test_out_of_range_issue_rejected(self, pp16):
+        value = (1 << 16) + 1
+        tokens, w = token_commit.get_tokens_with_witness(
+            [value], "EUR", pp16.pedersen_generators)
+        proof = issue_proof.issue_prove([x.as_tuple() for x in w], tokens, pp16)
+        with pytest.raises(ProofError, match="invalid issue proof"):
+            issue_proof.issue_verify(proof, tokens, pp16)
+
+    def test_wrong_tokens_rejected(self, pp16):
+        tokens, w = token_commit.get_tokens_with_witness(
+            [10, 20], "EUR", pp16.pedersen_generators)
+        other, _ = token_commit.get_tokens_with_witness(
+            [10, 20], "EUR", pp16.pedersen_generators)
+        proof = issue_proof.issue_prove([x.as_tuple() for x in w], tokens, pp16)
+        with pytest.raises(ProofError):
+            issue_proof.issue_verify(proof, other, pp16)
+
+
+class TestAuditReopen:
+    def test_reopen_accept_and_reject(self, pp16):
+        bf = fr_rand()
+        data = token_commit.commit_token("USD", 42, bf, pp16.pedersen_generators)
+        token_commit.audit_inspect_output(data, "USD", 42, bf,
+                                          pp16.pedersen_generators)
+        with pytest.raises(token_commit.TokenError):
+            token_commit.audit_inspect_output(data, "USD", 43, bf,
+                                              pp16.pedersen_generators)
+
+
+class TestPublicParams:
+    def test_setup_validate_roundtrip(self, pp16):
+        pp16.validate()
+        raw = pp16.serialize()
+        restored = setup_mod.PublicParams.deserialize(raw)
+        restored.validate()
+        assert restored.serialize() == raw
+        assert restored.max_token == (1 << 16) - 1
+        assert restored.range_proof_params.number_of_rounds == 4
+        assert restored.pedersen_generators == pp16.pedersen_generators
+
+    def test_unsupported_precision_rejected(self):
+        pp = setup_mod.setup(8)
+        with pytest.raises(setup_mod.SetupError, match="invalid bit length"):
+            pp.validate()
