@@ -270,19 +270,18 @@ def map_to_curve_svdw(u: int) -> G1:
     tv3 = pow(tv3, P - 2, P) if tv3 else 0
     tv4 = u * tv1 % P * tv3 % P * _SVDW_C3 % P
     x1 = (_SVDW_C2 - tv4) % P
-    gx1 = _g_of_x(x1)
-    if fp_sqrt(gx1) is not None:
-        x, gx = x1, gx1
+    y = fp_sqrt(_g_of_x(x1))
+    if y is not None:
+        x = x1
     else:
         x2 = (_SVDW_C2 + tv4) % P
-        gx2 = _g_of_x(x2)
-        if fp_sqrt(gx2) is not None:
-            x, gx = x2, gx2
+        y = fp_sqrt(_g_of_x(x2))
+        if y is not None:
+            x = x2
         else:
             tv5 = tv2 * tv2 % P * tv3 % P
-            x3 = (_SVDW_Z + _SVDW_C4 * tv5 * tv5) % P
-            x, gx = x3, _g_of_x(x3)
-    y = fp_sqrt(gx)
+            x = (_SVDW_Z + _SVDW_C4 * tv5 * tv5) % P
+            y = fp_sqrt(_g_of_x(x))
     assert y is not None
     if fp_sgn0(u) != fp_sgn0(y):
         y = P - y

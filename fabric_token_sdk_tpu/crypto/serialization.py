@@ -150,6 +150,12 @@ class DerReader:
         body = self.raw[self.pos:self.pos + n]
         if len(body) != n:
             raise ValueError("DER: truncated integer")
+        # Go encoding/asn1 rejects empty and non-minimal INTEGER encodings.
+        if n == 0:
+            raise ValueError("DER: empty integer")
+        if n > 1 and ((body[0] == 0 and body[1] < 0x80)
+                      or (body[0] == 0xFF and body[1] >= 0x80)):
+            raise ValueError("DER: integer not minimally encoded")
         self.pos += n
         return int.from_bytes(body, "big", signed=True)
 
@@ -238,6 +244,11 @@ class MathUnmarshaller:
         if self.index >= len(self.frames):
             return None
         curve_id, body = unmarshal_element(self.frames[self.index])
+        # The reference dispatches on CurveID (math.Curves[e.CurveID],
+        # asn1.go:95-112); this stack supports BN254 only and must reject
+        # rather than silently parse with the wrong curve.
+        if curve_id != bn254.CURVE_ID:
+            raise ValueError(f"unsupported curve ID {curve_id}")
         self.index += 1
         return curve_id, body
 

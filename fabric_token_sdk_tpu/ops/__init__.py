@@ -1,0 +1,12 @@
+"""TPU compute kernels: limbed BN254 field/group arithmetic.
+
+This package is the TPU-native replacement for the reference's native math
+layer (github.com/IBM/mathlib -> consensys/gnark-crypto assembly BN254; see
+reference token/core/zkatdlog/nogh/v1/crypto/setup.go:14 and SURVEY.md §2.2).
+All arrays are uint32 with 16-bit limbs so every partial product and lazy
+column sum stays inside a 32-bit lane — the layout XLA:TPU vectorizes well.
+"""
+
+from . import limbs  # noqa: F401
+from . import field  # noqa: F401
+from . import ec  # noqa: F401

@@ -1,0 +1,220 @@
+"""Branchless BN254 G1 Jacobian arithmetic + batched MSM on TPU.
+
+Points are (..., 3, 16) uint32 arrays: Montgomery-form Jacobian (X, Y, Z)
+with Z == 0 denoting the identity. All control flow is `jnp.where` selects so
+the code traces to a single static XLA graph (SURVEY.md §7: no data-dependent
+control flow under jit); the scalar bit loop uses `lax.fori_loop`.
+
+Equivalent of the reference's gnark-crypto G1 ops used via IBM/mathlib
+(G1.Mul/Add/Sub, reference token/core/zkatdlog/nogh/v1/crypto files passim).
+The batched `msm_is_identity` is the verification hot loop replacing the
+sequential per-proof loop at reference rp/rangecorrectness.go:137-162.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import field
+from .field import FP
+
+# Point component indices.
+_X, _Y, _Z = 0, 1, 2
+
+
+def identity(batch_shape: tuple[int, ...] = ()) -> jnp.ndarray:
+    """Identity point(s): (batch..., 3, 16) with Z = 0, X = Y = mont(1)."""
+    one = FP.r1_arr
+    pt = jnp.stack([one, one, jnp.zeros_like(one)])
+    return jnp.broadcast_to(pt, batch_shape + pt.shape)
+
+
+def is_identity(p: jnp.ndarray) -> jnp.ndarray:
+    return field.is_zero(p[..., _Z, :])
+
+
+def double(p: jnp.ndarray) -> jnp.ndarray:
+    """Jacobian doubling (dbl-2009-l); safe for Z=0 (returns Z=0)."""
+    X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    A = field.mont_sqr(X1, FP)
+    B = field.mont_sqr(Y1, FP)
+    C = field.mont_sqr(B, FP)
+    t = field.add(X1, B, FP)
+    t = field.mont_sqr(t, FP)
+    t = field.sub(t, A, FP)
+    t = field.sub(t, C, FP)
+    D = field.double_val(t, FP)
+    E = field.add(field.double_val(A, FP), A, FP)
+    F = field.mont_sqr(E, FP)
+    X3 = field.sub(F, field.double_val(D, FP), FP)
+    Y3 = field.sub(D, X3, FP)
+    Y3 = field.mont_mul(E, Y3, FP)
+    C8 = field.double_val(field.double_val(field.double_val(C, FP), FP), FP)
+    Y3 = field.sub(Y3, C8, FP)
+    Z3 = field.double_val(field.mont_mul(Y1, Z1, FP), FP)
+    return jnp.stack([X3, Y3, Z3], axis=-2)
+
+
+def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Branchless general Jacobian addition handling all edge cases.
+
+    Cases folded in via selects: P=O -> Q; Q=O -> P; P==Q -> double;
+    P==-Q -> O; otherwise add-2007-bl.
+    """
+    X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
+
+    Z1Z1 = field.mont_sqr(Z1, FP)
+    Z2Z2 = field.mont_sqr(Z2, FP)
+    U1 = field.mont_mul(X1, Z2Z2, FP)
+    U2 = field.mont_mul(X2, Z1Z1, FP)
+    S1 = field.mont_mul(field.mont_mul(Y1, Z2, FP), Z2Z2, FP)
+    S2 = field.mont_mul(field.mont_mul(Y2, Z1, FP), Z1Z1, FP)
+    H = field.sub(U2, U1, FP)
+    r = field.sub(S2, S1, FP)
+
+    # General addition path.
+    HH = field.mont_sqr(H, FP)
+    HHH = field.mont_mul(H, HH, FP)
+    V = field.mont_mul(U1, HH, FP)
+    X3 = field.mont_sqr(r, FP)
+    X3 = field.sub(X3, HHH, FP)
+    X3 = field.sub(X3, field.double_val(V, FP), FP)
+    Y3 = field.sub(V, X3, FP)
+    Y3 = field.mont_mul(r, Y3, FP)
+    Y3 = field.sub(Y3, field.mont_mul(S1, HHH, FP), FP)
+    Z3 = field.mont_mul(field.mont_mul(Z1, Z2, FP), H, FP)
+    added = jnp.stack([X3, Y3, Z3], axis=-2)
+
+    doubled = double(p)
+
+    id1 = is_identity(p)
+    id2 = is_identity(q)
+    h0 = field.is_zero(H)
+    r0 = field.is_zero(r)
+
+    same = jnp.logical_and(jnp.logical_and(h0, r0),
+                           jnp.logical_and(~id1, ~id2))
+    anni = jnp.logical_and(jnp.logical_and(h0, ~r0),
+                           jnp.logical_and(~id1, ~id2))
+
+    out = added
+    out = jnp.where(same[..., None, None], doubled, out)
+    out = jnp.where(anni[..., None, None], identity(p.shape[:-2]), out)
+    out = jnp.where(id2[..., None, None], p, out)
+    out = jnp.where(id1[..., None, None], q, out)
+    return out
+
+
+def neg(p: jnp.ndarray) -> jnp.ndarray:
+    Y = field.neg(p[..., _Y, :], FP)
+    return p.at[..., _Y, :].set(Y)
+
+
+def scale(p: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
+    """p if bit else identity — implemented by masking Z (cheap select)."""
+    Z = p[..., _Z, :] * bit[..., None].astype(jnp.uint32)
+    return p.at[..., _Z, :].set(Z)
+
+
+def _scalar_bit(scalars: jnp.ndarray, bit_index) -> jnp.ndarray:
+    """Bit `bit_index` (0 = LSB) of (..., 16)-limb scalars -> (...,) uint32."""
+    limb = bit_index // 16
+    off = bit_index % 16
+    word = jnp.take(scalars, limb, axis=-1)
+    return (word >> off) & jnp.uint32(1)
+
+
+def scalar_mul(p: jnp.ndarray, scalar: jnp.ndarray) -> jnp.ndarray:
+    """Double-and-add scalar multiplication (256 fixed iterations).
+
+    p: (..., 3, 16) point(s); scalar: (..., 16) plain-integer limbs.
+    Not constant-time in value distribution but branchless in structure —
+    verification-side only (SURVEY.md §7: constant-time not required).
+    """
+    batch = p.shape[:-2]
+
+    def body(i, acc):
+        acc = double(acc)
+        bit = _scalar_bit(scalar, 255 - i)
+        cand = add(acc, p)
+        return jnp.where(bit[..., None, None].astype(bool), cand, acc)
+
+    return jax.lax.fori_loop(0, 256, body, identity(batch))
+
+
+def _tree_sum(pts: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise tree reduction of points over axis -3 (the term axis).
+
+    pts: (..., T, 3, 16) with T a power of two -> (..., 3, 16).
+    log2(T) vectorized point additions.
+    """
+    T = pts.shape[-3]
+    while T > 1:
+        half = T // 2
+        pts = add(pts[..., :half, :, :], pts[..., half : 2 * half, :, :])
+        T = half
+    return pts[..., 0, :, :]
+
+
+def _pad_pow2(pts: jnp.ndarray, scalars: jnp.ndarray):
+    T = pts.shape[-3]
+    pow2 = 1
+    while pow2 < T:
+        pow2 *= 2
+    if pow2 == T:
+        return pts, scalars
+    pad = pow2 - T
+    id_pts = identity(pts.shape[:-3] + (pad,))
+    pts = jnp.concatenate([pts, id_pts], axis=-3)
+    zpad = jnp.zeros(scalars.shape[:-2] + (pad, scalars.shape[-1]),
+                     dtype=scalars.dtype)
+    scalars = jnp.concatenate([scalars, zpad], axis=-2)
+    return pts, scalars
+
+
+def msm(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Batched multi-scalar multiplication with shared doublings.
+
+    points: (..., T, 3, 16) Montgomery Jacobian; scalars: (..., T, 16) plain
+    limbs. Returns (..., 3, 16) = sum_t scalars[t] * points[t].
+
+    MSB-first bit scan: per bit, one shared doubling of the accumulator plus
+    a masked tree-sum over the T term axis — every op is batch x T wide,
+    which is what keeps the VPU lanes full (SURVEY.md §2.5: batch
+    data-parallel proof verification is the only first-class parallelism).
+    """
+    points, scalars = _pad_pow2(points, scalars)
+    batch = points.shape[:-3]
+
+    def body(i, acc):
+        acc = double(acc)
+        bits = _scalar_bit(scalars, 255 - i)  # (..., T)
+        masked = scale(points, bits)
+        return add(acc, _tree_sum(masked))
+
+    return jax.lax.fori_loop(0, 256, body, identity(batch))
+
+
+def msm_is_identity(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """True per batch element iff sum_t scalars[t]*points[t] == O."""
+    return is_identity(msm(points, scalars))
+
+
+def points_equal(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Jacobian equality without inversion: cross-multiplied coordinates."""
+    X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
+    Z1Z1 = field.mont_sqr(Z1, FP)
+    Z2Z2 = field.mont_sqr(Z2, FP)
+    x_eq = field.is_zero(
+        field.sub(field.mont_mul(X1, Z2Z2, FP),
+                  field.mont_mul(X2, Z1Z1, FP), FP))
+    y_eq = field.is_zero(
+        field.sub(field.mont_mul(field.mont_mul(Y1, Z2, FP), Z2Z2, FP),
+                  field.mont_mul(field.mont_mul(Y2, Z1, FP), Z1Z1, FP), FP))
+    both_id = jnp.logical_and(is_identity(p), is_identity(q))
+    one_id = jnp.logical_xor(is_identity(p), is_identity(q))
+    eq = jnp.logical_and(x_eq, y_eq)
+    return jnp.where(both_id, True, jnp.where(one_id, False, eq))

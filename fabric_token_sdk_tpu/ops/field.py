@@ -1,0 +1,195 @@
+"""Vectorized Montgomery field arithmetic over 16-bit limbs in uint32 lanes.
+
+Design notes (why this maps well to TPU / XLA, SURVEY.md §7 item 1):
+
+- All loops below run over the *static* limb index (16 or 32 iterations) and
+  are unrolled at trace time; the batch dimensions are the vector axes, so
+  every emitted op is a full-width VPU op over the batch.
+- 16x16-bit products fit exactly in uint32 ((2^16-1)^2 < 2^32), and lazy
+  column accumulation adds at most ~2^6 such 16-bit half-terms, keeping
+  every lane < 2^23 — no 64-bit integers anywhere, which TPUs lack natively.
+- Montgomery (radix 2^256) keeps reduction multiplication-only; the single
+  carry chain per mul is a 16-step scalar-dependency but each step is a
+  batch-wide vector op.
+
+The functions are modulus-generic: `FieldSpec` bundles the limb constants for
+Fp (point coordinates) and Fr (scalars). Equivalent of the reference's
+IBM/mathlib -> gnark-crypto assembly field layer (reference
+token/core/zkatdlog/nogh/v1/crypto/setup.go:14).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+
+MASK = jnp.uint32(L.LIMB_MASK)
+BITS = L.LIMB_BITS
+N = L.NLIMBS
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    """Static limb constants for one prime field (hashable -> jit-static)."""
+
+    name: str
+    mod: tuple[int, ...]       # modulus limbs
+    r1: tuple[int, ...]        # montgomery 1
+    r2: tuple[int, ...]        # montgomery R^2 (for to_mont)
+    n0inv: int                 # -mod^-1 mod 2^16
+
+    @property
+    def mod_arr(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.mod, dtype=np.uint32))
+
+    @property
+    def r1_arr(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.r1, dtype=np.uint32))
+
+    @property
+    def r2_arr(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.r2, dtype=np.uint32))
+
+
+FP = FieldSpec(
+    name="fp",
+    mod=tuple(int(v) for v in L.P_LIMBS),
+    r1=tuple(int(v) for v in L.P_R1_LIMBS),
+    r2=tuple(int(v) for v in L.P_R2_LIMBS),
+    n0inv=int(L.P_N0INV),
+)
+
+FR = FieldSpec(
+    name="fr",
+    mod=tuple(int(v) for v in L.R_LIMBS),
+    r1=tuple(int(v) for v in L.R_R1_LIMBS),
+    r2=tuple(int(v) for v in L.R_R2_LIMBS),
+    n0inv=int(L.R_N0INV),
+)
+
+
+def _carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Propagate lazy column sums (< 2^32) into canonical 16-bit limbs.
+
+    t: (..., K) uint32. Returns (..., out_limbs); caller guarantees the value
+    fits (any final carry would be dropped).
+    """
+    cols = []
+    carry = jnp.zeros(t.shape[:-1], dtype=jnp.uint32)
+    k = t.shape[-1]
+    for i in range(out_limbs):
+        cur = (t[..., i] if i < k else jnp.zeros_like(carry)) + carry
+        cols.append(cur & MASK)
+        carry = cur >> BITS
+    return jnp.stack(cols, axis=-1)
+
+
+def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """a - b over canonical limbs; returns (diff, borrow_out in {0,1})."""
+    cols = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    for i in range(a.shape[-1]):
+        cur = a[..., i] + jnp.uint32(1 << BITS) - b[..., i] - borrow
+        cols.append(cur & MASK)
+        borrow = jnp.uint32(1) - (cur >> BITS)
+    return jnp.stack(cols, axis=-1), borrow
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Modular addition of canonical-limb values < mod."""
+    s = _carry_propagate(a + b, N + 1)
+    # value < 2 * mod < 2^257: compare/subtract over 17 limbs.
+    mod17 = jnp.concatenate(
+        [spec.mod_arr, jnp.zeros(1, dtype=jnp.uint32)]).astype(jnp.uint32)
+    mod17 = jnp.broadcast_to(mod17, s.shape)
+    diff, borrow = _sub_limbs(s, mod17)
+    keep = (borrow != 0)[..., None]
+    return jnp.where(keep, s, diff)[..., :N]
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Modular subtraction of canonical-limb values < mod."""
+    diff, borrow = _sub_limbs(a, b)
+    mod = jnp.broadcast_to(spec.mod_arr, a.shape)
+    fixed = _carry_propagate(diff + mod, N)
+    need_fix = (borrow != 0)[..., None]
+    return jnp.where(need_fix, fixed, diff)
+
+
+def neg(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Modular negation: mod - a, with -0 = 0."""
+    mod = jnp.broadcast_to(spec.mod_arr, a.shape)
+    diff, _ = _sub_limbs(mod, a)
+    zero = is_zero(a)[..., None]
+    return jnp.where(zero, jnp.zeros_like(a), diff)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """True where all limbs are zero; shape = batch shape."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Montgomery product a*b*R^-1 mod m over (..., 16) uint32 limbs.
+
+    Product scanning with lo/hi split lazy columns, then an interleaved
+    word-by-word Montgomery reduction. Output canonical (< mod).
+    """
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    batch = shape[:-1]
+    t = jnp.zeros(batch + (2 * N + 1,), dtype=jnp.uint32)
+
+    # Schoolbook partial products, lazily accumulated per column.
+    for i in range(N):
+        p = a[..., i : i + 1] * b  # (..., N) full 32-bit products
+        t = t.at[..., i : i + N].add(p & MASK)
+        t = t.at[..., i + 1 : i + N + 1].add(p >> BITS)
+
+    # Interleaved Montgomery reduction: one m_i per low limb.
+    mod = spec.mod_arr
+    n0inv = jnp.uint32(spec.n0inv)
+    carry = jnp.zeros(batch, dtype=jnp.uint32)
+    for i in range(N):
+        cur = t[..., i] + carry
+        m = ((cur & MASK) * n0inv) & MASK
+        pm = m[..., None] * mod  # (..., N)
+        t = t.at[..., i : i + N].add(pm & MASK)
+        t = t.at[..., i + 1 : i + N + 1].add(pm >> BITS)
+        carry = (cur + ((m * mod[0]) & MASK)) >> BITS
+
+    hi = t[..., N:]
+    hi = hi.at[..., 0].add(carry)
+    res = _carry_propagate(hi, N + 1)
+    mod17 = jnp.concatenate([spec.mod_arr, jnp.zeros(1, dtype=jnp.uint32)])
+    mod17 = jnp.broadcast_to(mod17, res.shape)
+    diff, borrow = _sub_limbs(res, mod17)
+    keep = (borrow != 0)[..., None]
+    return jnp.where(keep, res, diff)[..., :N]
+
+
+def mont_sqr(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return mont_mul(a, a, spec)
+
+
+def to_mont(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return mont_mul(a, jnp.broadcast_to(spec.r2_arr, a.shape), spec)
+
+
+def from_mont(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mont_mul(a, one, spec)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless limb select: cond is a batch-shaped bool array."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def double_val(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return add(a, a, spec)

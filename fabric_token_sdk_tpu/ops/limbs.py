@@ -1,0 +1,118 @@
+"""Limb codecs and BN254 constants for the TPU kernels.
+
+Representation: a 256-bit value is 16 little-endian limbs of 16 bits, stored
+as uint32 so that (a) every 16x16-bit partial product fits exactly in one
+uint32 lane and (b) lazy column accumulation of up to ~2^6 terms stays far
+from the 2^32 wrap (SURVEY.md §7 item 1: "carry chains in int32 lanes").
+
+Host <-> device conversion lives here (numpy only; no jax import so the
+control plane can use it without touching a backend).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto import bn254
+
+LIMB_BITS = 16
+LIMB_MASK = 0xFFFF
+NLIMBS = 16  # 256 bits
+
+# Base field Fp.
+P_INT = bn254.P
+# Scalar field Fr (group order).
+R_INT = bn254.R
+
+# Montgomery radix 2^256.
+MONT_R = 1 << (LIMB_BITS * NLIMBS)
+
+
+def _mont_consts(mod: int) -> tuple[int, int, int]:
+    """(R mod m, R^2 mod m, -m^-1 mod 2^LIMB_BITS)."""
+    r1 = MONT_R % mod
+    r2 = (MONT_R * MONT_R) % mod
+    n0inv = (-pow(mod, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+    return r1, r2, n0inv
+
+
+P_R1_INT, P_R2_INT, P_N0INV = _mont_consts(P_INT)
+R_R1_INT, R_R2_INT, R_N0INV = _mont_consts(R_INT)
+
+
+def int_to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Little-endian 16-bit limb decomposition as uint32."""
+    if x < 0:
+        raise ValueError("negative value")
+    out = np.empty(nlimbs, dtype=np.uint32)
+    for i in range(nlimbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit in limbs")
+    return out
+
+
+def limbs_to_int(a: np.ndarray) -> int:
+    """Inverse of int_to_limbs for a single limb vector (any leading dims=())."""
+    x = 0
+    arr = np.asarray(a, dtype=np.uint64)
+    for i in range(arr.shape[-1] - 1, -1, -1):
+        x = (x << LIMB_BITS) | int(arr[..., i])
+    return x
+
+
+def ints_to_limbs(xs, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Vector codec: list of ints -> (len, nlimbs) uint32."""
+    return np.stack([int_to_limbs(x, nlimbs) for x in xs])
+
+
+# Precomputed limb constants (numpy; jnp converts on use).
+P_LIMBS = int_to_limbs(P_INT)
+P_R2_LIMBS = int_to_limbs(P_R2_INT)
+P_R1_LIMBS = int_to_limbs(P_R1_INT)
+R_LIMBS = int_to_limbs(R_INT)
+R_R2_LIMBS = int_to_limbs(R_R2_INT)
+R_R1_LIMBS = int_to_limbs(R_R1_INT)
+ZERO_LIMBS = np.zeros(NLIMBS, dtype=np.uint32)
+
+
+def fp_to_mont_int(x: int) -> int:
+    return (x * MONT_R) % P_INT
+
+
+def fp_from_mont_int(x: int) -> int:
+    return (x * pow(MONT_R, -1, P_INT)) % P_INT
+
+
+def point_to_jacobian_limbs(p: bn254.G1) -> np.ndarray:
+    """Affine host point -> (3, NLIMBS) Montgomery Jacobian uint32 limbs.
+
+    Identity encodes as Z = 0 (X, Y arbitrary non-garbage: montgomery 1).
+    """
+    if p.inf:
+        one = int_to_limbs(P_R1_INT)
+        return np.stack([one, one, ZERO_LIMBS])
+    return np.stack([
+        int_to_limbs(fp_to_mont_int(p.x)),
+        int_to_limbs(fp_to_mont_int(p.y)),
+        int_to_limbs(P_R1_INT),  # Z = 1 in Montgomery form
+    ])
+
+
+def points_to_jacobian_limbs(points) -> np.ndarray:
+    """(N, 3, NLIMBS) uint32 from a list of host points."""
+    return np.stack([point_to_jacobian_limbs(p) for p in points])
+
+
+def jacobian_limbs_to_point(arr: np.ndarray) -> bn254.G1:
+    """Device (3, NLIMBS) Montgomery Jacobian -> host affine point."""
+    X = fp_from_mont_int(limbs_to_int(arr[0]))
+    Y = fp_from_mont_int(limbs_to_int(arr[1]))
+    Z = fp_from_mont_int(limbs_to_int(arr[2]))
+    return bn254._jac_to_affine(X, Y, Z)
+
+
+def scalars_to_limbs(scalars) -> np.ndarray:
+    """Scalars mod r -> (N, NLIMBS) uint32 (plain integers, not Montgomery)."""
+    return np.stack([int_to_limbs(s % R_INT) for s in scalars])

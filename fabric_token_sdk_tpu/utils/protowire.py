@@ -97,9 +97,13 @@ def iter_fields(data: bytes):
             value = data[pos:pos + length]
             pos += length
         elif wire_type == I64:
+            if pos + 8 > len(data):
+                raise ValueError("proto: truncated fixed64 field")
             value = data[pos:pos + 8]
             pos += 8
         elif wire_type == I32:
+            if pos + 4 > len(data):
+                raise ValueError("proto: truncated fixed32 field")
             value = data[pos:pos + 4]
             pos += 4
         else:

@@ -1,0 +1,64 @@
+"""Field kernel vs pure-Python oracle (fabric_token_sdk_tpu.crypto.bn254)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.ops import field, limbs
+
+rng = random.Random(0xF1E1D)
+
+
+def _rand_vals(n, mod):
+    edge = [0, 1, 2, mod - 1, mod - 2, (mod - 1) // 2]
+    vals = edge + [rng.randrange(mod) for _ in range(n - len(edge))]
+    return vals[:n]
+
+
+@pytest.mark.parametrize("spec,mod", [(field.FP, bn254.P), (field.FR, bn254.R)])
+def test_mont_mul_roundtrip_and_product(spec, mod):
+    n = 32
+    a_int = _rand_vals(n, mod)
+    b_int = _rand_vals(n, mod)[::-1]
+    mont_r = limbs.MONT_R
+    a = jnp.asarray(limbs.ints_to_limbs([x * mont_r % mod for x in a_int]))
+    b = jnp.asarray(limbs.ints_to_limbs([x * mont_r % mod for x in b_int]))
+    out = np.asarray(field.mont_mul(a, b, spec))
+    for i in range(n):
+        got = limbs.limbs_to_int(out[i]) * pow(mont_r, -1, mod) % mod
+        assert got == a_int[i] * b_int[i] % mod, f"mismatch at {i}"
+
+
+@pytest.mark.parametrize("spec,mod", [(field.FP, bn254.P), (field.FR, bn254.R)])
+def test_add_sub_neg(spec, mod):
+    n = 32
+    a_int = _rand_vals(n, mod)
+    b_int = _rand_vals(n, mod)[::-1]
+    a = jnp.asarray(limbs.ints_to_limbs(a_int))
+    b = jnp.asarray(limbs.ints_to_limbs(b_int))
+    s = np.asarray(field.add(a, b, spec))
+    d = np.asarray(field.sub(a, b, spec))
+    ng = np.asarray(field.neg(a, spec))
+    for i in range(n):
+        assert limbs.limbs_to_int(s[i]) == (a_int[i] + b_int[i]) % mod
+        assert limbs.limbs_to_int(d[i]) == (a_int[i] - b_int[i]) % mod
+        assert limbs.limbs_to_int(ng[i]) == (-a_int[i]) % mod
+
+
+def test_to_from_mont():
+    n = 16
+    vals = _rand_vals(n, bn254.P)
+    a = jnp.asarray(limbs.ints_to_limbs(vals))
+    m = field.to_mont(a, field.FP)
+    back = np.asarray(field.from_mont(m, field.FP))
+    for i in range(n):
+        assert limbs.limbs_to_int(back[i]) == vals[i]
+
+
+def test_is_zero_and_select():
+    a = jnp.asarray(limbs.ints_to_limbs([0, 1, bn254.P - 1, 0]))
+    z = np.asarray(field.is_zero(a))
+    assert list(z) == [True, False, False, True]
