@@ -1,0 +1,104 @@
+"""Headline benchmark: 64-bit range-proof verifies/sec on one chip.
+
+Prints exactly one JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+The reference publishes no performance numbers (BASELINE.md); the baseline
+used here is the BASELINE.json north-star target of 10,000 64-bit range-proof
+verifies/sec on a single v5e chip, so vs_baseline is the fraction of target
+achieved (1.0 == target met).
+
+Proof corpus: pre-generated 64-bit proofs in benchdata/ (host prover is
+~seconds/proof; regenerate with `python bench.py --regen`). The corpus is
+tiled to the benchmark batch size; verification cost is value-independent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+BENCH_DIR = pathlib.Path(__file__).parent / "benchdata"
+BIT_LENGTH = 64
+N_PROOFS = 4
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+TARGET_BASELINE = 10_000.0  # north-star verifies/sec (BASELINE.json)
+
+
+def _regen():
+    from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+    from fabric_token_sdk_tpu.crypto import serialization as ser
+
+    pp = setup.setup(BIT_LENGTH)
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    BENCH_DIR.mkdir(exist_ok=True)
+    (BENCH_DIR / "pp.json").write_bytes(pp.serialize())
+    blobs = []
+    for i in range(N_PROOFS):
+        value = (0xDEADBEEF * (i + 1)) % (1 << BIT_LENGTH)
+        bf = bn254.fr_rand()
+        com = bn254.g1_add(bn254.g1_mul(cg[0], value), bn254.g1_mul(cg[1], bf))
+        proof = rp.range_prove(com, value, cg, bf, rpp.left_generators,
+                               rpp.right_generators, rpp.P, rpp.Q,
+                               rpp.number_of_rounds, rpp.bit_length)
+        blobs.append(ser.marshal_std_bytes_slices(
+            [proof.serialize(), ser.g1_to_bytes(com)]))
+    payload = ser.marshal_std_bytes_slices(blobs)
+    (BENCH_DIR / f"proofs_{BIT_LENGTH}.bin").write_bytes(payload)
+    print(f"wrote {N_PROOFS} proofs to {BENCH_DIR}", file=sys.stderr)
+
+
+def _load():
+    from fabric_token_sdk_tpu.crypto import rp, setup
+    from fabric_token_sdk_tpu.crypto import serialization as ser
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    raw = (BENCH_DIR / f"proofs_{BIT_LENGTH}.bin").read_bytes()
+    reader = ser.DerReader(raw).read_sequence()
+    proofs, coms = [], []
+    while not reader.eof():
+        inner = ser.DerReader(reader.read_octet_string()).read_sequence()
+        proofs.append(rp.RangeProof.deserialize(inner.read_octet_string()))
+        coms.append(ser.g1_from_bytes(inner.read_octet_string()))
+    return pp, proofs, coms
+
+
+def main():
+    if "--regen" in sys.argv:
+        _regen()
+        return
+    if not (BENCH_DIR / f"proofs_{BIT_LENGTH}.bin").exists():
+        _regen()
+
+    from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
+
+    pp, proofs, coms = _load()
+    reps = (BATCH + len(proofs) - 1) // len(proofs)
+    proofs = (proofs * reps)[:BATCH]
+    coms = (coms * reps)[:BATCH]
+
+    verifier = BatchRangeVerifier(pp)
+    # Warm-up: compile both device passes.
+    out = verifier.verify(proofs, coms)
+    assert out.all(), "bench corpus failed verification"
+
+    t0 = time.perf_counter()
+    out = verifier.verify(proofs, coms)
+    elapsed = time.perf_counter() - t0
+    assert out.all()
+
+    value = BATCH / elapsed
+    print(json.dumps({
+        "metric": f"range_proof_verifies_per_sec_{BIT_LENGTH}bit",
+        "value": round(value, 2),
+        "unit": "proofs/s",
+        "vs_baseline": round(value / TARGET_BASELINE, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""tokengen: public-parameter generation CLI.
+
+Behavioral mirror of reference cmd/tokengen (main.go:46-51 command set):
+
+  gen dlog      — zkatdlog public params (--base/--exponent set the range
+                  bit-length as base^exponent bits of value, mirroring
+                  cobra/pp/dlog/gen.go:24-80; or --bits directly), plus the
+                  TPU batching extension required by BASELINE.json:
+                  --tpu-batch-size / --tpu-mesh-devices embed TpuBatchParams.
+  gen fabtoken  — plaintext driver params (--precision).
+  pp print      — inspect a serialized public-parameters file.
+  update        — bump/refresh params preserving identities.
+  version       — print the framework version.
+
+Identities (issuers/auditors) are registered from PEM/DER public-key files
+via --issuer/--auditor (repeatable), standing in for the reference's MSP
+cert directories.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+VERSION = "0.1.0"
+
+
+def _load_identity(path: str) -> bytes:
+    raw = pathlib.Path(path).read_bytes()
+    if raw.lstrip().startswith(b"-----BEGIN"):
+        from cryptography.hazmat.primitives import serialization
+
+        key = serialization.load_pem_public_key(raw)
+        return key.public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+    return raw
+
+
+def _gen_dlog(args) -> int:
+    from ..crypto import setup as dlog_setup
+
+    bits = args.bits
+    if bits is None:
+        bits = 1
+        for _ in range(args.exponent):
+            bits *= args.base
+    if bits not in dlog_setup.SUPPORTED_PRECISIONS:
+        print(f"unsupported bit length {bits}; supported: "
+              f"{dlog_setup.SUPPORTED_PRECISIONS}", file=sys.stderr)
+        return 2
+    pp = dlog_setup.setup(bits)
+    for path in args.issuer or []:
+        pp.add_issuer(_load_identity(path))
+    for path in args.auditor or []:
+        pp.add_auditor(_load_identity(path))
+    if args.tpu_batch_size or args.tpu_mesh_devices:
+        pp.tpu_batch = dlog_setup.TpuBatchParams(
+            batch_size=args.tpu_batch_size or 1024,
+            mesh_devices=args.tpu_mesh_devices or 1)
+    out = pathlib.Path(args.output) / "zkatdlog_pp.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_bytes(pp.serialize())
+    print(str(out))
+    return 0
+
+
+def _gen_fabtoken(args) -> int:
+    from ..core import fabtoken
+
+    pp = fabtoken.setup(args.precision)
+    for path in args.issuer or []:
+        pp.issuer_ids.append(_load_identity(path))
+    for path in args.auditor or []:
+        pp.auditor = _load_identity(path)
+    out = pathlib.Path(args.output) / "fabtoken_pp.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_bytes(pp.serialize())
+    print(str(out))
+    return 0
+
+
+def _pp_print(args) -> int:
+    raw = pathlib.Path(args.path).read_bytes()
+    outer = json.loads(raw)
+    ident = outer.get("identifier", "")
+    print(f"identifier: {ident}")
+    if ident == "zkatdlog":
+        from ..crypto import setup as dlog_setup
+
+        pp = dlog_setup.PublicParams.deserialize(raw)
+        rpp = pp.range_proof_params
+        print(f"version: {pp.version}")
+        print(f"bit_length: {rpp.bit_length}")
+        print(f"rounds: {rpp.number_of_rounds}")
+        print(f"max_token: {pp.max_token}")
+        print(f"issuers: {len(pp.issuer_ids)}")
+        print(f"auditor: {'yes' if pp.auditor else 'no'}")
+        if pp.tpu_batch:
+            print(f"tpu_batch_size: {pp.tpu_batch.batch_size}")
+            print(f"tpu_mesh_devices: {pp.tpu_batch.mesh_devices}")
+    elif ident == "fabtoken":
+        from ..core.fabtoken.setup import PublicParams
+
+        pp = PublicParams.deserialize(raw)
+        print(f"version: {pp.ver}")
+        print(f"precision: {pp.quantity_precision}")
+        print(f"max_token: {pp.max_token}")
+        print(f"issuers: {len(pp.issuer_ids)}")
+        print(f"auditor: {'yes' if pp.auditor else 'no'}")
+    else:
+        print("unknown public parameters identifier", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _update(args) -> int:
+    """Re-serialize with a fresh version stamp (TMSProvider.Update path,
+    reference core/tms.go:117; identities/generators preserved)."""
+    raw = pathlib.Path(args.path).read_bytes()
+    outer = json.loads(raw)
+    if outer.get("identifier") == "zkatdlog":
+        from ..crypto import setup as dlog_setup
+
+        pp = dlog_setup.PublicParams.deserialize(raw)
+        pathlib.Path(args.path).write_bytes(pp.serialize())
+    else:
+        from ..core.fabtoken.setup import PublicParams
+
+        pp = PublicParams.deserialize(raw)
+        pathlib.Path(args.path).write_bytes(pp.serialize())
+    print(args.path)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tokengen")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    gen = sub.add_parser("gen", help="generate public parameters")
+    gensub = gen.add_subparsers(dest="driver", required=True)
+
+    dlog = gensub.add_parser("dlog", help="zkatdlog (ZK privacy) params")
+    dlog.add_argument("--base", type=int, default=2)
+    dlog.add_argument("--exponent", type=int, default=6)
+    dlog.add_argument("--bits", type=int, default=None,
+                      help="range bit-length directly (16/32/64)")
+    dlog.add_argument("--issuer", action="append", default=[])
+    dlog.add_argument("--auditor", action="append", default=[])
+    dlog.add_argument("--tpu-batch-size", type=int, default=0,
+                      help="TPU batch size hint embedded in the params")
+    dlog.add_argument("--tpu-mesh-devices", type=int, default=0,
+                      help="device-mesh size hint for the verification fleet")
+    dlog.add_argument("--output", "-o", default=".")
+    dlog.set_defaults(fn=_gen_dlog)
+
+    fab = gensub.add_parser("fabtoken", help="plaintext driver params")
+    fab.add_argument("--precision", type=int, default=64)
+    fab.add_argument("--issuer", action="append", default=[])
+    fab.add_argument("--auditor", action="append", default=[])
+    fab.add_argument("--output", "-o", default=".")
+    fab.set_defaults(fn=_gen_fabtoken)
+
+    pp = sub.add_parser("pp", help="public-parameter utilities")
+    ppsub = pp.add_subparsers(dest="ppcmd", required=True)
+    pprint = ppsub.add_parser("print")
+    pprint.add_argument("path")
+    pprint.set_defaults(fn=_pp_print)
+
+    upd = sub.add_parser("update", help="refresh serialized parameters")
+    upd.add_argument("path")
+    upd.set_defaults(fn=_update)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=lambda a: print(f"tokengen version {VERSION}") or 0)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Driver registry + shared driver plumbing + concrete drivers.
+
+Mirrors reference token/core (SURVEY.md §2.1): a named-factory registry with
+lazy TMS instantiation, the generic validation pipeline, and the fabtoken
+(plaintext UTXO) and zkatdlog (ZK privacy) drivers.
+"""

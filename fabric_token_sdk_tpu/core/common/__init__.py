@@ -1,0 +1,1 @@
+"""Driver-agnostic plumbing shared by fabtoken and zkatdlog."""

@@ -1,0 +1,209 @@
+"""Generic driver-agnostic validation pipeline.
+
+Behavioral mirror of reference token/core/common/validator.go:78-253 and
+backend.go: unmarshal request -> auditor signature -> per-action validator
+chains -> metadata-coverage invariant. Drivers plug in action deserializers
+and chains of validator steps; the zkatdlog chain routes its ZK step to the
+TPU batch verifier (SURVEY.md §3.2 "where the TPU backend plugs in").
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ...driver import TokenRequest
+from ...driver.api import GetStateFnc, ValidationAttributes
+from ...driver.identity import Identity
+
+TOKEN_REQUEST_TO_SIGN = "trs"
+TOKEN_REQUEST_SIGNATURES = "sigs"
+
+
+class ValidationError(Exception):
+    pass
+
+
+class Backend:
+    """Ledger view + signature provider over one request (backend.go:31).
+
+    Tracks a cursor over the provided signatures: each HasBeenSignedBy call
+    consumes the next signature and verifies it against the message.
+    """
+
+    def __init__(self, get_state: GetStateFnc, message: bytes,
+                 signatures: list[bytes]):
+        self._get_state = get_state
+        self.message = message
+        self.signatures = signatures
+        self.cursor = 0
+
+    # driver.Ledger
+    def get_state(self, token_id) -> bytes | None:
+        return self._get_state(token_id)
+
+    # driver.SignatureProvider
+    def has_been_signed_by(self, identity: Identity, verifier) -> bytes:
+        if self.cursor >= len(self.signatures):
+            raise ValidationError("invalid number of signatures")
+        sigma = self.signatures[self.cursor]
+        verifier.verify(self.message, sigma)
+        self.cursor += 1
+        return sigma
+
+    def sigs(self) -> list[bytes]:
+        return self.signatures
+
+
+@dataclass
+class Context:
+    """Per-action validation context (validator.go:25-41)."""
+
+    pp: object
+    deserializer: object
+    signature_provider: Backend
+    ledger: object
+    attributes: ValidationAttributes
+    issue_action: object = None
+    transfer_action: object = None
+    input_tokens: list = field(default_factory=list)
+    signatures: list = field(default_factory=list)
+    metadata_counter: dict = field(default_factory=dict)
+    # extension point for drivers that batch across actions (TPU verifier)
+    bundle: object = None
+
+    def count_metadata_key(self, key: str) -> None:
+        self.metadata_counter[key] = self.metadata_counter.get(key, 0) + 1
+
+
+ValidateStep = Callable[[Context], None]
+
+
+class Validator:
+    """Pluggable validation pipeline (validator.go:52-110)."""
+
+    def __init__(self, pp, deserializer, action_deserializer,
+                 transfer_validators: list[ValidateStep],
+                 issue_validators: list[ValidateStep],
+                 bundle_factory: Callable[[], object] | None = None,
+                 bundle_flush: Callable[[object], None] | None = None):
+        self.pp = pp
+        self.deserializer = deserializer
+        self.action_deserializer = action_deserializer
+        self.transfer_validators = transfer_validators
+        self.issue_validators = issue_validators
+        # Batching hooks: drivers may collect device-verifiable work across
+        # all actions of a request and flush it in one TPU batch.
+        self.bundle_factory = bundle_factory
+        self.bundle_flush = bundle_flush
+
+    def unmarshal_actions(self, raw: bytes) -> list:
+        tr = TokenRequest.from_bytes(raw)
+        issues, transfers = self.action_deserializer.deserialize_actions(tr)
+        return list(issues) + list(transfers)
+
+    def verify_token_request_from_raw(self, get_state: GetStateFnc,
+                                      anchor: str, raw: bytes
+                                      ) -> tuple[list, ValidationAttributes]:
+        """validator.go:78-110."""
+        if not raw:
+            raise ValidationError("empty token request")
+        try:
+            tr = TokenRequest.from_bytes(raw)
+        except Exception as e:
+            raise ValidationError(
+                f"failed to unmarshal token request: {e}") from e
+        signed = tr.message_to_sign(anchor.encode())
+        if len(self.pp.auditors()) != 0:
+            signatures = list(tr.auditor_signatures) + list(tr.signatures)
+        else:
+            signatures = list(tr.signatures)
+        attributes: ValidationAttributes = {
+            TOKEN_REQUEST_TO_SIGN: signed,
+            TOKEN_REQUEST_SIGNATURES: json.dumps(
+                [s.hex() for s in signatures]).encode(),
+        }
+        backend = Backend(get_state, signed, signatures)
+        return self.verify_token_request(backend, backend, anchor, tr,
+                                         attributes)
+
+    def verify_token_request(self, ledger, signature_provider, anchor: str,
+                             tr: TokenRequest,
+                             attributes: ValidationAttributes
+                             ) -> tuple[list, ValidationAttributes]:
+        self._verify_auditor_signature(signature_provider, anchor)
+        try:
+            issues, transfers = self.action_deserializer.deserialize_actions(tr)
+        except Exception as e:
+            raise ValidationError(
+                f"failed to unmarshal actions [{anchor}]: {e}") from e
+        bundle = self.bundle_factory() if self.bundle_factory else None
+        self._verify_actions("issue", issues, self.issue_validators, ledger,
+                             signature_provider, attributes, anchor, bundle)
+        self._verify_actions("transfer", transfers, self.transfer_validators,
+                             ledger, signature_provider, attributes, anchor,
+                             bundle)
+        if bundle is not None and self.bundle_flush is not None:
+            self.bundle_flush(bundle)
+        return list(issues) + list(transfers), attributes
+
+    def _verify_auditor_signature(self, signature_provider, anchor: str) -> None:
+        """validator.go:160-173: first auditor's signature must be present."""
+        auditors = self.pp.auditors()
+        if len(auditors) == 0:
+            return
+        auditor = auditors[0]
+        try:
+            verifier = self.deserializer.get_auditor_verifier(auditor)
+        except Exception as e:
+            raise ValidationError(
+                "failed to deserialize auditor's public key") from e
+        try:
+            signature_provider.has_been_signed_by(auditor, verifier)
+        except Exception as e:
+            raise ValidationError(
+                f"failed to verifier auditor's signature [{anchor}]: {e}"
+            ) from e
+
+    def _verify_actions(self, kind: str, actions: list,
+                        validators: list[ValidateStep], ledger,
+                        signature_provider, attributes, anchor: str,
+                        bundle) -> None:
+        for i, action in enumerate(actions):
+            ctx = Context(
+                pp=self.pp,
+                deserializer=self.deserializer,
+                signature_provider=signature_provider,
+                ledger=ledger,
+                attributes=attributes,
+                bundle=bundle,
+            )
+            if kind == "issue":
+                ctx.issue_action = action
+            else:
+                ctx.transfer_action = action
+            try:
+                for step in validators:
+                    step(ctx)
+            except Exception as e:
+                raise ValidationError(
+                    f"failed to verify {kind} action at [{i}] [{anchor}]: {e}"
+                ) from e
+            self._check_metadata_coverage(action, ctx, kind, i)
+
+    @staticmethod
+    def _check_metadata_coverage(action, ctx: Context, kind: str, i: int) -> None:
+        """Every metadata key must be validated exactly once
+        (validator.go:203-216,244-253)."""
+        counter = 0
+        for k, c in ctx.metadata_counter.items():
+            if c > 1:
+                raise ValidationError(
+                    f"metadata key [{k}] appeared more than one time")
+            counter += c
+        metadata = action.get_metadata() or {}
+        if len(metadata) != counter:
+            raise ValidationError(
+                f"more metadata than those validated [{len(metadata)}]!="
+                f"[{counter}] in {kind} action [{i}]")

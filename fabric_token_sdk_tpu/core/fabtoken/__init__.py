@@ -1,0 +1,10 @@
+"""fabtoken driver: plaintext UTXO tokens (reference token/core/fabtoken/v1).
+
+Quantities travel in the clear; validation checks owner signatures and
+plaintext balance. The simplest driver — and the reference model for the
+action/validator plumbing the zkatdlog driver extends with ZK proofs.
+"""
+
+from .setup import PublicParams, setup  # noqa: F401
+from .actions import Output, IssueAction, TransferAction  # noqa: F401
+from .validator import new_validator  # noqa: F401

@@ -1,0 +1,197 @@
+"""fabtoken actions: plaintext JSON tokens and issue/transfer actions.
+
+Behavioral mirror of reference token/core/fabtoken/v1/core/actions.go:40-300:
+an Output is a cleartext Token (owner/type/hex-quantity) wrapped with the
+fabtoken format tag; IssueAction carries issuer + outputs; TransferAction
+carries input IDs + the claimed input tokens + outputs. All JSON.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+
+from ...driver.identity import Identity
+from ...token.model import ID
+
+# services/tokens/core/fabtoken/token.go:18-20: format tag of fabtoken tokens.
+FABTOKEN_FORMAT = 1
+
+
+class ActionError(ValueError):
+    pass
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(bytes(b)).decode("ascii")
+
+
+def _unb64(s: str | None) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+def wrap_token_with_type(raw: bytes) -> bytes:
+    """tokens.WrapWithType: typed-token envelope {Type, Token}."""
+    return json.dumps({"Type": FABTOKEN_FORMAT, "Token": _b64(raw)}).encode()
+
+
+def unmarshal_typed_token(raw: bytes) -> bytes:
+    t = json.loads(raw)
+    if t.get("Type") != FABTOKEN_FORMAT:
+        raise ActionError(f"invalid token type [{t.get('Type')}]")
+    return _unb64(t.get("Token"))
+
+
+@dataclass
+class Output:
+    """Cleartext token output (actions.go:40-68)."""
+
+    owner: bytes
+    type: str
+    quantity: str  # "0x..." base-16
+
+    def is_redeem(self) -> bool:
+        return len(self.owner) == 0
+
+    def get_owner(self) -> bytes:
+        return self.owner
+
+    def serialize(self) -> bytes:
+        raw = json.dumps({
+            "owner": _b64(self.owner), "type": self.type,
+            "quantity": self.quantity,
+        }).encode()
+        return wrap_token_with_type(raw)
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "Output":
+        body = json.loads(unmarshal_typed_token(raw))
+        return cls(owner=_unb64(body.get("owner")), type=body["type"],
+                   quantity=body["quantity"])
+
+    def to_dict(self) -> dict:
+        return {"owner": _b64(self.owner), "type": self.type,
+                "quantity": self.quantity}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Output":
+        return cls(owner=_unb64(d.get("owner")), type=d["type"],
+                   quantity=d["quantity"])
+
+
+@dataclass
+class IssueAction:
+    """actions.go:72-175."""
+
+    issuer: Identity
+    outputs: list[Output] = field(default_factory=list)
+    metadata: dict[str, bytes] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if len(self.issuer) == 0:
+            raise ActionError("issuer is not set")
+        if not self.outputs:
+            raise ActionError("no outputs in issue action")
+        if any(o is None for o in self.outputs):
+            raise ActionError("nil output in issue action")
+
+    def num_outputs(self) -> int:
+        return len(self.outputs)
+
+    def get_outputs(self) -> list[Output]:
+        return list(self.outputs)
+
+    def get_serialized_outputs(self) -> list[bytes]:
+        return [o.serialize() for o in self.outputs]
+
+    def get_inputs(self) -> list[ID]:
+        return []
+
+    def get_metadata(self) -> dict[str, bytes]:
+        return self.metadata
+
+    def is_anonymous(self) -> bool:
+        return False
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "issuer": _b64(self.issuer),
+            "outputs": [o.to_dict() for o in self.outputs],
+            "metadata": {k: _b64(v) for k, v in self.metadata.items()},
+        }).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IssueAction":
+        d = json.loads(raw)
+        return cls(
+            issuer=Identity(_unb64(d.get("issuer"))),
+            outputs=[Output.from_dict(o) for o in d.get("outputs", [])],
+            metadata={k: _unb64(v) for k, v in (d.get("metadata") or {}).items()},
+        )
+
+
+@dataclass
+class TransferAction:
+    """actions.go:177-300."""
+
+    inputs: list[ID] = field(default_factory=list)
+    input_tokens: list[Output] = field(default_factory=list)
+    outputs: list[Output] = field(default_factory=list)
+    metadata: dict[str, bytes] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.inputs:
+            raise ActionError("invalid number of token inputs in transfer action")
+        if len(self.inputs) != len(self.input_tokens):
+            raise ActionError("invalid transfer action: inputs and input "
+                              "tokens do not match")
+        if not self.outputs:
+            raise ActionError("invalid number of token outputs in transfer action")
+
+    def num_inputs(self) -> int:
+        return len(self.inputs)
+
+    def num_outputs(self) -> int:
+        return len(self.outputs)
+
+    def get_inputs(self) -> list[ID]:
+        return list(self.inputs)
+
+    def get_outputs(self) -> list[Output]:
+        return list(self.outputs)
+
+    def get_serialized_outputs(self) -> list[bytes]:
+        return [o.serialize() for o in self.outputs]
+
+    def get_serialized_inputs(self) -> list[bytes]:
+        return [t.serialize() for t in self.input_tokens]
+
+    def is_redeem_at(self, index: int) -> bool:
+        return self.outputs[index].is_redeem()
+
+    def get_metadata(self) -> dict[str, bytes]:
+        return self.metadata
+
+    def is_graph_hiding(self) -> bool:
+        return False
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "inputs": [{"tx_id": i.tx_id, "index": i.index} for i in self.inputs],
+            "input_tokens": [t.to_dict() for t in self.input_tokens],
+            "outputs": [o.to_dict() for o in self.outputs],
+            "metadata": {k: _b64(v) for k, v in self.metadata.items()},
+        }).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferAction":
+        d = json.loads(raw)
+        return cls(
+            inputs=[ID(i["tx_id"], i.get("index", 0))
+                    for i in d.get("inputs", [])],
+            input_tokens=[Output.from_dict(t)
+                          for t in d.get("input_tokens", [])],
+            outputs=[Output.from_dict(o) for o in d.get("outputs", [])],
+            metadata={k: _unb64(v) for k, v in (d.get("metadata") or {}).items()},
+        )

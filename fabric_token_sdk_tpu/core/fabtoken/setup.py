@@ -1,0 +1,111 @@
+"""fabtoken public parameters.
+
+Behavioral mirror of reference token/core/fabtoken/v1/core/setup.go:24-120:
+{Label "fabtoken", Ver, QuantityPrecision <= 64, Auditor, IssuerIDs,
+MaxToken = 2^precision - 1}, serialized as JSON inside the driver-level
+{identifier, raw} wrapper (same envelope as zkatdlog pp).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+
+from ...driver.identity import Identity
+
+FABTOKEN_LABEL = "fabtoken"
+VERSION = "1.0.0"
+DEFAULT_PRECISION = 64
+
+
+class SetupError(Exception):
+    pass
+
+
+@dataclass
+class PublicParams:
+    label: str = FABTOKEN_LABEL
+    ver: str = VERSION
+    quantity_precision: int = DEFAULT_PRECISION
+    auditor: bytes = b""
+    issuer_ids: list[Identity] = field(default_factory=list)
+    max_token: int = (1 << DEFAULT_PRECISION) - 1
+
+    # ---- driver.PublicParameters surface
+    def identifier(self) -> str:
+        return self.label
+
+    def precision(self) -> int:
+        return self.quantity_precision
+
+    def auditors(self) -> list[Identity]:
+        return [Identity(self.auditor)] if self.auditor else []
+
+    def issuers(self) -> list[Identity]:
+        return list(self.issuer_ids)
+
+    def max_token_value(self) -> int:
+        return self.max_token
+
+    def graph_hiding(self) -> bool:
+        return False
+
+    # ---- serialization (setup.go:66-95)
+    def serialize(self) -> bytes:
+        inner = json.dumps({
+            "Label": self.label,
+            "Ver": self.ver,
+            "QuantityPrecision": self.quantity_precision,
+            "Auditor": base64.b64encode(self.auditor).decode("ascii"),
+            "IssuerIDs": [base64.b64encode(bytes(i)).decode("ascii")
+                          for i in self.issuer_ids],
+            "MaxToken": self.max_token,
+        }).encode()
+        return json.dumps({
+            "identifier": self.label,
+            "raw": base64.b64encode(inner).decode("ascii"),
+        }).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "PublicParams":
+        outer = json.loads(raw)
+        if outer.get("identifier") != FABTOKEN_LABEL:
+            raise SetupError(
+                f"invalid identifier [{outer.get('identifier')}]")
+        inner = json.loads(base64.b64decode(outer["raw"]))
+        pp = cls(
+            label=inner["Label"],
+            ver=inner["Ver"],
+            quantity_precision=inner["QuantityPrecision"],
+            auditor=base64.b64decode(inner.get("Auditor", "")),
+            issuer_ids=[Identity(base64.b64decode(x))
+                        for x in inner.get("IssuerIDs", [])],
+            max_token=inner["MaxToken"],
+        )
+        pp.validate()
+        return pp
+
+    def validate(self) -> None:
+        """setup.go:97-109."""
+        if self.quantity_precision > 64:
+            raise SetupError(
+                f"invalid precision [{self.quantity_precision}], must be "
+                "smaller or equal than 64")
+        if self.quantity_precision == 0:
+            raise SetupError("invalid precision, should be greater than 0")
+        if self.max_token != (1 << self.quantity_precision) - 1:
+            raise SetupError("invalid max token")
+
+
+def setup(precision: int = DEFAULT_PRECISION) -> PublicParams:
+    """setup.go:41-64."""
+    if precision > 64:
+        raise SetupError(
+            f"invalid precision [{precision}], must be smaller or equal than 64")
+    if precision == 0:
+        raise SetupError("invalid precision, should be greater than 0")
+    return PublicParams(
+        quantity_precision=precision,
+        max_token=(1 << precision) - 1,
+    )

@@ -1,0 +1,146 @@
+"""fabtoken validation chain.
+
+Behavioral mirror of reference token/core/fabtoken/v1/validator: transfer
+chain = ActionValidate -> SignatureValidate -> BalanceValidate ->
+HTLCValidate; issue chain = IssueValidate. Error strings follow the
+reference so observable accept/reject behavior matches
+(validator_transfer.go:23-170, validator_issue.go:17-63).
+"""
+
+from __future__ import annotations
+
+import time as time_mod
+
+from ...driver import TokenRequest
+from ...token import quantity as q
+from ..common.validator import Context, ValidationError, Validator
+from .actions import IssueAction, TransferAction
+
+
+class ActionDeserializer:
+    """validator.go:20-42."""
+
+    def deserialize_actions(self, tr: TokenRequest):
+        issues = [IssueAction.deserialize(raw) for raw in tr.issues]
+        transfers = [TransferAction.deserialize(raw) for raw in tr.transfers]
+        return issues, transfers
+
+
+def transfer_action_validate(ctx: Context) -> None:
+    ctx.transfer_action.validate()
+
+
+def transfer_signature_validate(ctx: Context) -> None:
+    """validator_transfer.go:28-47: every input owner must have signed."""
+    ctx.input_tokens = list(ctx.transfer_action.input_tokens)
+    for tok in ctx.input_tokens:
+        owner = tok.get_owner()
+        try:
+            verifier = ctx.deserializer.get_owner_verifier(owner)
+        except Exception as e:
+            raise ValidationError(
+                f"failed deserializing owner [{e}]") from e
+        try:
+            sigma = ctx.signature_provider.has_been_signed_by(owner, verifier)
+        except Exception as e:
+            raise ValidationError(
+                f"failed signature verification [{e}]") from e
+        ctx.signatures.append(sigma)
+
+
+def transfer_balance_validate(ctx: Context) -> None:
+    """validator_transfer.go:50-93: same type everywhere, sum-in == sum-out."""
+    action = ctx.transfer_action
+    if action.num_outputs() == 0:
+        raise ValidationError("there is no output")
+    if len(ctx.input_tokens) == 0:
+        raise ValidationError("there is no input")
+    if ctx.input_tokens[0] is None:
+        raise ValidationError("first input is nil")
+    precision = ctx.pp.precision()
+    typ = ctx.input_tokens[0].type
+    input_sum = q.new_zero(precision)
+    output_sum = q.new_zero(precision)
+    for i, tok in enumerate(ctx.input_tokens):
+        if tok is None:
+            raise ValidationError(f"input {i} is nil")
+        try:
+            input_sum = input_sum.add(q.to_quantity(tok.quantity, precision))
+        except q.QuantityError as e:
+            raise ValidationError(
+                f"failed parsing quantity [{tok.quantity}]: {e}") from e
+        if tok.type != typ:
+            raise ValidationError(
+                f"input type {tok.type} does not match type {typ}")
+    for out in action.get_outputs():
+        try:
+            output_sum = output_sum.add(q.to_quantity(out.quantity, precision))
+        except q.QuantityError as e:
+            raise ValidationError(
+                f"failed parsing quantity [{out.quantity}]: {e}") from e
+        if out.type != typ:
+            raise ValidationError(
+                f"output type {out.type} does not match type {typ}")
+    if input_sum.cmp(output_sum) != 0:
+        raise ValidationError(
+            f"input sum {input_sum} does not match output sum {output_sum}")
+
+
+def transfer_htlc_validate(ctx: Context) -> None:
+    """validator_transfer.go:96-170; deferred to the htlc service module."""
+    from ...services.interop import htlc
+
+    htlc.transfer_htlc_validate(ctx, now=time_mod.time())
+
+
+def issue_validate(ctx: Context) -> None:
+    """validator_issue.go:17-63."""
+    action = ctx.issue_action
+    try:
+        action.validate()
+    except Exception as e:
+        raise ValidationError(
+            f"failed validating issue action: {e}") from e
+    if action.num_outputs() == 0:
+        raise ValidationError("there is no output")
+    precision = ctx.pp.precision()
+    for out in action.get_outputs():
+        try:
+            quantity = q.to_quantity(out.quantity, precision)
+        except q.QuantityError as e:
+            raise ValidationError(
+                f"failed parsing quantity [{out.quantity}]: {e}") from e
+        if quantity.value == 0:
+            raise ValidationError("quantity is zero")
+    issuers = ctx.pp.issuers()
+    if issuers:
+        if not any(bytes(action.issuer) == bytes(i) for i in issuers):
+            raise ValidationError(
+                f"issuer [{action.issuer!r}] is not in issuers")
+    try:
+        verifier = ctx.deserializer.get_issuer_verifier(action.issuer)
+    except Exception as e:
+        raise ValidationError(
+            f"failed getting verifier for issuer identity: {e}") from e
+    try:
+        ctx.signature_provider.has_been_signed_by(action.issuer, verifier)
+    except Exception as e:
+        raise ValidationError(f"failed verifying signature: {e}") from e
+
+
+def new_validator(pp, deserializer, extra_transfer_validators=()) -> Validator:
+    """validator.go:48-70."""
+    transfer_chain = [
+        transfer_action_validate,
+        transfer_signature_validate,
+        transfer_balance_validate,
+        transfer_htlc_validate,
+        *extra_transfer_validators,
+    ]
+    return Validator(
+        pp=pp,
+        deserializer=deserializer,
+        action_deserializer=ActionDeserializer(),
+        transfer_validators=transfer_chain,
+        issue_validators=[issue_validate],
+    )

@@ -1,0 +1,11 @@
+"""zkatdlog driver: ZK privacy tokens (reference token/core/zkatdlog/nogh/v1).
+
+Tokens are Pedersen commitments; transfers carry type-and-sum Σ-proofs plus
+Bulletproof-style range proofs; issues carry same-type + range proofs. The
+range-proof workload — the entire ZK verification cost (SURVEY.md §3.2) —
+routes to the TPU batch verifier behind the driver.Validator boundary.
+"""
+
+from .actions import Token, IssueAction, TransferAction  # noqa: F401
+from .validator import new_validator  # noqa: F401
+from .verifier import ZKVerifier  # noqa: F401

@@ -1,0 +1,134 @@
+"""zkatdlog validation chain.
+
+Behavioral mirror of reference token/core/zkatdlog/nogh/v1/validator:
+transfer chain = ActionValidate -> SignatureValidate ->
+UpgradeWitnessValidate -> ZKProofValidate -> HTLCValidate; issue chain =
+IssueValidate (validator.go:53-80). The ZK step routes through ZKVerifier,
+which batches all range proofs on the TPU (the north-star plugin boundary,
+validator_transfer.go:96-110).
+"""
+
+from __future__ import annotations
+
+import time as time_mod
+
+from ...driver import TokenRequest
+from ..common.validator import Context, ValidationError, Validator
+from .actions import IssueAction, TransferAction
+from .verifier import ZKVerifier
+
+
+class ActionDeserializer:
+    """v1/validator/validator.go:29-49."""
+
+    def deserialize_actions(self, tr: TokenRequest):
+        issues = [IssueAction.deserialize(raw) for raw in tr.issues]
+        transfers = [TransferAction.deserialize(raw) for raw in tr.transfers]
+        return issues, transfers
+
+
+def transfer_action_validate(ctx: Context) -> None:
+    """validator_transfer.go:25."""
+    ctx.transfer_action.validate()
+
+
+def transfer_signature_validate(ctx: Context) -> None:
+    """validator_transfer.go:29-61: every input owner must have signed."""
+    ctx.input_tokens = ctx.transfer_action.input_tokens()
+    for tok in ctx.input_tokens:
+        owner = tok.get_owner()
+        try:
+            verifier = ctx.deserializer.get_owner_verifier(owner)
+        except Exception as e:
+            raise ValidationError(f"failed deserializing owner [{e}]") from e
+        try:
+            sigma = ctx.signature_provider.has_been_signed_by(owner, verifier)
+        except Exception as e:
+            raise ValidationError(
+                f"failed signature verification [{e}]") from e
+        ctx.signatures.append(sigma)
+
+
+def transfer_upgrade_witness_validate(ctx: Context) -> None:
+    """validator_transfer.go:64-93: token-upgrade witnesses.
+
+    Upgrade (converting plaintext ledger tokens into commitments) is not yet
+    supported in this framework; actions carrying upgrade witnesses are
+    rejected, matching the reference's failure path for malformed witnesses.
+    """
+    for inp in ctx.transfer_action.inputs:
+        if getattr(inp, "upgrade_witness", None) is not None:
+            raise ValidationError("upgrade witnesses are not supported")
+
+
+def transfer_zk_proof_validate(ctx: Context) -> None:
+    """validator_transfer.go:96-110 — the entire ZK cost, TPU-batched."""
+    inputs = [tok.data for tok in ctx.input_tokens]
+    outputs = ctx.transfer_action.get_output_commitments()
+    verifier: ZKVerifier = ctx.pp.zk_verifier
+    verifier.verify_transfer(ctx.transfer_action.get_proof(), inputs, outputs)
+
+
+def transfer_htlc_validate(ctx: Context) -> None:
+    """validator_transfer.go:112-175 (commitment-token variant)."""
+    from ...services.interop import htlc
+
+    htlc.transfer_htlc_validate(ctx, now=time_mod.time())
+
+
+def issue_validate(ctx: Context) -> None:
+    """validator_issue.go:17-57."""
+    action = ctx.issue_action
+    try:
+        action.validate()
+    except Exception as e:
+        raise ValidationError(f"failed validating issue action: {e}") from e
+    commitments = action.get_commitments()
+    verifier: ZKVerifier = ctx.pp.zk_verifier
+    verifier.verify_issue(action.get_proof(), commitments)
+    issuers = ctx.pp.issuers()
+    if issuers:
+        if not any(bytes(action.issuer) == bytes(i) for i in issuers):
+            raise ValidationError(
+                f"issuer [{action.issuer!r}] is not in issuers")
+    try:
+        sig_verifier = ctx.deserializer.get_issuer_verifier(action.issuer)
+    except Exception as e:
+        raise ValidationError(
+            f"failed getting verifier for issuer: {e}") from e
+    try:
+        ctx.signature_provider.has_been_signed_by(action.issuer, sig_verifier)
+    except Exception as e:
+        raise ValidationError(f"failed verifying signature: {e}") from e
+
+
+class _PPFacade:
+    """Binds the crypto PublicParams to a shared ZKVerifier instance."""
+
+    def __init__(self, pp, device: bool):
+        self._pp = pp
+        self.zk_verifier = ZKVerifier(pp, device=device)
+
+    def __getattr__(self, name):
+        return getattr(self._pp, name)
+
+
+def new_validator(pp, deserializer, device: bool = True,
+                  extra_transfer_validators=()) -> Validator:
+    """validator.go:53-80; `device=True` routes range proofs to the TPU."""
+    facade = _PPFacade(pp, device)
+    transfer_chain = [
+        transfer_action_validate,
+        transfer_signature_validate,
+        transfer_upgrade_witness_validate,
+        transfer_zk_proof_validate,
+        transfer_htlc_validate,
+        *extra_transfer_validators,
+    ]
+    return Validator(
+        pp=facade,
+        deserializer=deserializer,
+        action_deserializer=ActionDeserializer(),
+        transfer_validators=transfer_chain,
+        issue_validators=[issue_validate],
+    )

@@ -1,0 +1,102 @@
+"""zkatdlog proof verification with TPU-batched range proofs.
+
+The plugin point promised by BASELINE.json: the sub-tree under
+TransferZKProofValidate / IssueValidate (reference crypto/transfer/
+transfer.go:153-197, crypto/issue/verifier.go:32-57) re-routed so that the
+Σ-protocol checks (cheap, per-action) run on host while every range proof in
+the request is verified in one batched device pass. On batch rejection the
+host oracle re-verifies the failing action to produce the reference's exact
+error message (SURVEY.md north star: bit-identical accept/reject).
+"""
+
+from __future__ import annotations
+
+from ...crypto import issue_proof, rp, transfer_proof
+from ...crypto.bn254 import G1, g1_add, g1_neg
+from ...crypto.rp import ProofError
+
+
+class ZKVerifier:
+    """Per-pp verifier with an optional device batch backend."""
+
+    def __init__(self, pp, device: bool = True):
+        self.pp = pp
+        self._range = None
+        if device:
+            from ...models.range_verifier import BatchRangeVerifier
+
+            self._range = BatchRangeVerifier(pp)
+
+    # ------------------------------------------------------------ transfer
+    def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
+                        outputs: list[G1]) -> None:
+        """transfer.go:153-197 semantics; range part batched on device."""
+        if self._range is None:
+            transfer_proof.transfer_verify(proof_raw, inputs, outputs, self.pp)
+            return
+        try:
+            proof = transfer_proof.TransferProof.deserialize(proof_raw)
+        except (ValueError, ProofError) as e:
+            raise ProofError(f"invalid transfer proof: {e}") from e
+        if proof.type_and_sum is None:
+            raise ProofError("invalid transfer proof")
+        try:
+            transfer_proof.type_and_sum_verify(
+                proof.type_and_sum, self.pp.pedersen_generators, inputs,
+                outputs)
+        except ProofError as e:
+            raise ProofError(f"invalid transfer proof: {e}") from e
+        if len(inputs) != 1 or len(outputs) != 1:
+            if proof.range_correctness is None:
+                raise ProofError("invalid transfer proof")
+            coms = [g1_add(o, g1_neg(proof.type_and_sum.commitment_to_type))
+                    for o in outputs]
+            self._verify_range_batch(proof.range_correctness, coms)
+
+    # --------------------------------------------------------------- issue
+    def verify_issue(self, proof_raw: bytes, commitments: list[G1]) -> None:
+        """issue/verifier.go:32-57 semantics; range part batched on device."""
+        if self._range is None:
+            issue_proof.issue_verify(proof_raw, commitments, self.pp)
+            return
+        try:
+            proof = issue_proof.IssueProof.deserialize(proof_raw)
+        except (ValueError, ProofError) as e:
+            raise ProofError(f"invalid issue proof: {e}") from e
+        try:
+            issue_proof.same_type_verify(proof.same_type,
+                                         self.pp.pedersen_generators)
+        except ProofError as e:
+            raise ProofError(f"invalid issue proof: {e}") from e
+        coms = [g1_add(t, g1_neg(proof.same_type.commitment_to_type))
+                for t in commitments]
+        try:
+            self._verify_range_batch(proof.range_correctness, coms)
+        except ProofError as e:
+            raise ProofError(f"invalid issue proof: {e}") from e
+
+    # ------------------------------------------------------------- helpers
+    def _verify_range_batch(self, rc: rp.RangeCorrectness,
+                            commitments: list[G1]) -> None:
+        """Device-batched RangeCorrectness with host fallback for the exact
+        reference error (rangecorrectness.go:137-162 ordering)."""
+        if len(rc.proofs) != len(commitments):
+            raise ProofError("invalid range proof")
+        accepts = self._range.verify_range_correctness(rc, commitments)
+        if accepts.all():
+            return
+        # Reproduce the sequential loop's first-failure error exactly.
+        first_bad = int(accepts.argmin())
+        rpp = self.pp.range_proof_params
+        for i in range(first_bad, len(rc.proofs)):
+            try:
+                rp.range_verify(rc.proofs[i], commitments[i],
+                                self.pp.pedersen_generators[1:3],
+                                rpp.left_generators, rpp.right_generators,
+                                rpp.P, rpp.Q, rpp.number_of_rounds,
+                                rpp.bit_length)
+            except ProofError as e:
+                raise ProofError(f"invalid range proof at index {i}: {e}") from e
+        # Device said reject but host accepts everything: trust the host
+        # oracle (exactness) — should be unreachable; tested for parity.
+        return

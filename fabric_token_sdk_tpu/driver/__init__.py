@@ -1,0 +1,18 @@
+"""Driver SPI — the plugin boundary between the Token API and drivers.
+
+Mirrors the capability surface of reference token/driver/*.go (SURVEY.md
+§2.1): token request wire format, validator/ledger/signature interfaces, and
+the identity type. Drivers (fabtoken, zkatdlog) implement these contracts;
+the TPU batch verifier plugs in behind `Validator` exactly as the north star
+requires (BASELINE.json).
+"""
+
+from .identity import Identity  # noqa: F401
+from .request import TokenRequest  # noqa: F401
+from .api import (  # noqa: F401
+    Ledger,
+    SignatureProvider,
+    Validator,
+    Verifier,
+    ValidationAttributes,
+)

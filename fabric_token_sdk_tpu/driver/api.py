@@ -1,0 +1,86 @@
+"""Driver SPI contracts.
+
+Python Protocols standing in for the reference's Go interfaces (reference
+token/driver/driver.go, validator.go, tms.go — SURVEY.md §1 "Driver API").
+Only behavior-bearing members are modeled; Go's context plumbing is dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol, runtime_checkable
+
+from ..token.model import ID
+from .identity import Identity
+
+# Attributes generated during validation (driver/validator.go:15-18).
+ValidationAttributes = dict[str, bytes]
+
+# GetStateFnc returns the ledger value for a token ID (validator.go:21-22).
+GetStateFnc = Callable[[ID], bytes | None]
+
+
+@runtime_checkable
+class Ledger(Protocol):
+    """Read-only ledger (validator.go:24-28)."""
+
+    def get_state(self, token_id: ID) -> bytes | None: ...
+
+
+@runtime_checkable
+class Verifier(Protocol):
+    """Signature verifier bound to one identity's key material."""
+
+    def verify(self, message: bytes, signature: bytes) -> None:
+        """Raises on invalid signature."""
+
+
+@runtime_checkable
+class SignatureProvider(Protocol):
+    """validator.go:30-35."""
+
+    def has_been_signed_by(self, identity: Identity, verifier: Verifier) -> bytes:
+        """Returns the verified signature or raises."""
+
+    def sigs(self) -> list[bytes]: ...
+
+
+@runtime_checkable
+class Validator(Protocol):
+    """Token request validator (validator.go:44-52) — the TPU plugin boundary."""
+
+    def unmarshal_actions(self, raw: bytes) -> list: ...
+
+    def verify_token_request_from_raw(
+        self, get_state: GetStateFnc, anchor: str, raw: bytes
+    ) -> tuple[list, ValidationAttributes]: ...
+
+
+@runtime_checkable
+class Deserializer(Protocol):
+    """Identity-to-verifier resolution (driver/deserializer.go)."""
+
+    def get_owner_verifier(self, identity: Identity) -> Verifier: ...
+
+    def get_issuer_verifier(self, identity: Identity) -> Verifier: ...
+
+    def get_auditor_verifier(self, identity: Identity) -> Verifier: ...
+
+
+class TransferAction(Protocol):
+    """driver/action.go transfer surface."""
+
+    def get_inputs(self) -> list[ID]: ...
+
+    def get_serialized_outputs(self) -> list[bytes]: ...
+
+    def get_metadata(self) -> dict[str, bytes]: ...
+
+    def serialize(self) -> bytes: ...
+
+
+class IssueAction(Protocol):
+    def get_serialized_outputs(self) -> list[bytes]: ...
+
+    def get_metadata(self) -> dict[str, bytes]: ...
+
+    def serialize(self) -> bytes: ...

@@ -1,0 +1,29 @@
+"""Identity: an opaque serialized identity (reference token/driver/identity.go).
+
+Identities are raw bytes (serialized MSP/X.509/Idemix material or a script
+wrapper); equality and hashing are by content. `UniqueID` mirrors the
+reference's base64-of-SHA256 short form used for logging/keys.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+
+
+class Identity(bytes):
+    """Opaque identity bytes with convenience helpers."""
+
+    def is_none(self) -> bool:
+        return len(self) == 0
+
+    def unique_id(self) -> str:
+        if len(self) == 0:
+            return ""
+        return base64.b64encode(hashlib.sha256(self).digest()).decode("ascii")
+
+    def __repr__(self) -> str:  # keep logs short
+        return f"Identity({self.unique_id()[:12]}…)" if self else "Identity(∅)"
+
+
+NONE = Identity(b"")

@@ -1,0 +1,94 @@
+"""TokenRequest wire format.
+
+Byte-compatible with reference token/driver/protos/request.proto +
+token/driver/request.go:26-104: a proto3 TokenRequest{version, actions,
+signatures, auditor_signatures} and the ASN.1 message-to-sign
+(Go asn1.Marshal of the 4-slice struct with only Issues/Transfers populated,
+with the anchor appended).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import serialization as ser
+from ..utils import protowire as pw
+
+VERSION = 1
+
+ACTION_ISSUE = 0
+ACTION_TRANSFER = 1
+
+
+class RequestError(ValueError):
+    pass
+
+
+@dataclass
+class TokenRequest:
+    """Collection of independent actions + witnesses (request.go:26-36).
+
+    Actions in one request are independent: an action cannot spend tokens
+    created by another action in the same request.
+    """
+
+    issues: list[bytes] = field(default_factory=list)
+    transfers: list[bytes] = field(default_factory=list)
+    signatures: list[bytes] = field(default_factory=list)
+    auditor_signatures: list[bytes] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        """proto3 request.TokenRequest (request.go:38-66)."""
+        out = [pw.uint64_field(1, VERSION)]
+        for raw in self.issues:
+            body = pw.uint64_field(1, ACTION_ISSUE) + pw.bytes_field(2, raw)
+            out.append(pw.message_field(2, body))
+        for raw in self.transfers:
+            body = pw.uint64_field(1, ACTION_TRANSFER) + pw.bytes_field(2, raw)
+            out.append(pw.message_field(2, body))
+        for sig in self.signatures:
+            out.append(pw.message_field(3, pw.bytes_field(1, sig)))
+        for sig in self.auditor_signatures:
+            out.append(pw.message_field(4, pw.bytes_field(1, sig)))
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "TokenRequest":
+        """request.go:46-53,68-96 (nil/empty signature rejection included)."""
+        req = cls()
+        for num, _, value in pw.iter_fields(raw):
+            if num == 2:
+                fields = pw.parse_fields(value)
+                a_type = fields.get(1, [0])[0]
+                a_raw = bytes(fields.get(2, [b""])[0])
+                if a_type == ACTION_ISSUE:
+                    req.issues.append(a_raw)
+                elif a_type == ACTION_TRANSFER:
+                    req.transfers.append(a_raw)
+                else:
+                    raise RequestError(f"unknown action type [{a_type}]")
+            elif num in (3, 4):
+                fields = pw.parse_fields(value)
+                sig = bytes(fields.get(1, [b""])[0])
+                if len(sig) == 0:
+                    which = "signature" if num == 3 else "auditor signature"
+                    raise RequestError(f"nil {which} found")
+                if num == 3:
+                    req.signatures.append(sig)
+                else:
+                    req.auditor_signatures.append(sig)
+        return req
+
+    def message_to_sign(self, anchor: bytes) -> bytes:
+        """ASN.1 of {Issues, Transfers, [], []} + anchor (request.go:98-104).
+
+        Go asn1.Marshal of the driver.TokenRequest struct: SEQUENCE of four
+        SEQUENCE OF OCTET STRING (signatures empty at signing time).
+        """
+        body = ser.der_sequence(
+            ser.der_sequence(*[ser.der_octet_string(b) for b in self.issues]),
+            ser.der_sequence(*[ser.der_octet_string(b) for b in self.transfers]),
+            ser.der_sequence(),
+            ser.der_sequence(),
+        )
+        return body + anchor

@@ -1,0 +1,8 @@
+"""Batched TPU verifiers — the "model" layer of the framework.
+
+Each verifier lowers a zkatdlog proof-system check to batched multi-scalar
+multiplications executed on device (SURVEY.md §7 item 3), replacing the
+reference's sequential per-proof Go loops (rangecorrectness.go:137-162).
+"""
+
+from . import range_verifier  # noqa: F401

@@ -5,8 +5,8 @@ layer (github.com/IBM/mathlib -> consensys/gnark-crypto assembly BN254; see
 reference token/core/zkatdlog/nogh/v1/crypto/setup.go:14 and SURVEY.md §2.2).
 All arrays are uint32 with 16-bit limbs so every partial product and lazy
 column sum stays inside a 32-bit lane — the layout XLA:TPU vectorizes well.
-"""
 
-from . import limbs  # noqa: F401
-from . import field  # noqa: F401
-from . import ec  # noqa: F401
+Submodules are imported explicitly by consumers (`from ..ops import field`),
+not here: `limbs` is numpy-only and must stay importable without pulling in
+jax (control-plane paths), while `field`/`ec` require a jax backend.
+"""

@@ -1,32 +1,45 @@
-"""Branchless BN254 G1 Jacobian arithmetic + batched MSM on TPU.
+"""BN254 G1 arithmetic + batched MSM on TPU via complete projective formulas.
 
-Points are (..., 3, 16) uint32 arrays: Montgomery-form Jacobian (X, Y, Z)
-with Z == 0 denoting the identity. All control flow is `jnp.where` selects so
-the code traces to a single static XLA graph (SURVEY.md §7: no data-dependent
-control flow under jit); the scalar bit loop uses `lax.fori_loop`.
+Points are (..., 3, 16) uint32 arrays: Montgomery-form homogeneous
+projective (X, Y, Z) with the identity at (0 : y≠0 : 0). Addition uses the
+Renes-Costello-Batina complete formulas for a=0 short-Weierstrass curves
+(eprint 2015/1060, Algorithm 7, b3 = 3*b = 9 for BN254): one unconditional
+14-multiplication sequence valid for EVERY input pair — doubling, identity,
+inverses — so traced graphs contain no case analysis at all. That keeps the
+256-step scalar/MSM loop bodies small enough for fast XLA compiles and all
+lanes doing useful work (SURVEY.md §7: no data-dependent control flow).
 
 Equivalent of the reference's gnark-crypto G1 ops used via IBM/mathlib
 (G1.Mul/Add/Sub, reference token/core/zkatdlog/nogh/v1/crypto files passim).
-The batched `msm_is_identity` is the verification hot loop replacing the
-sequential per-proof loop at reference rp/rangecorrectness.go:137-162.
+The batched `msm_is_identity` replaces the sequential per-proof loop at
+reference rp/rangecorrectness.go:137-162.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import field
+from . import limbs as L
 from .field import FP
 
 # Point component indices.
 _X, _Y, _Z = 0, 1, 2
 
+# b3 = 3*b = 9 in Montgomery form (curve y^2 = x^3 + 3).
+_B3_MONT = tuple(int(v) for v in L.int_to_limbs(L.fp_to_mont_int(9)))
+
+
+def _b3() -> jnp.ndarray:
+    return jnp.asarray(np.array(_B3_MONT, dtype=np.uint32))
+
 
 def identity(batch_shape: tuple[int, ...] = ()) -> jnp.ndarray:
-    """Identity point(s): (batch..., 3, 16) with Z = 0, X = Y = mont(1)."""
-    one = FP.r1_arr
-    pt = jnp.stack([one, one, jnp.zeros_like(one)])
+    """Identity point(s): (batch..., 3, 16) = (0 : 1 : 0) in Montgomery."""
+    zero = jnp.zeros(L.NLIMBS, dtype=jnp.uint32)
+    pt = jnp.stack([zero, FP.r1_arr, zero])
     return jnp.broadcast_to(pt, batch_shape + pt.shape)
 
 
@@ -34,77 +47,53 @@ def is_identity(p: jnp.ndarray) -> jnp.ndarray:
     return field.is_zero(p[..., _Z, :])
 
 
-def double(p: jnp.ndarray) -> jnp.ndarray:
-    """Jacobian doubling (dbl-2009-l); safe for Z=0 (returns Z=0)."""
-    X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
-    A = field.mont_sqr(X1, FP)
-    B = field.mont_sqr(Y1, FP)
-    C = field.mont_sqr(B, FP)
-    t = field.add(X1, B, FP)
-    t = field.mont_sqr(t, FP)
-    t = field.sub(t, A, FP)
-    t = field.sub(t, C, FP)
-    D = field.double_val(t, FP)
-    E = field.add(field.double_val(A, FP), A, FP)
-    F = field.mont_sqr(E, FP)
-    X3 = field.sub(F, field.double_val(D, FP), FP)
-    Y3 = field.sub(D, X3, FP)
-    Y3 = field.mont_mul(E, Y3, FP)
-    C8 = field.double_val(field.double_val(field.double_val(C, FP), FP), FP)
-    Y3 = field.sub(Y3, C8, FP)
-    Z3 = field.double_val(field.mont_mul(Y1, Z1, FP), FP)
-    return jnp.stack([X3, Y3, Z3], axis=-2)
-
-
 def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Branchless general Jacobian addition handling all edge cases.
+    """Complete projective addition (RCB15 Algorithm 7, a=0, b3=9).
 
-    Cases folded in via selects: P=O -> Q; Q=O -> P; P==Q -> double;
-    P==-Q -> O; otherwise add-2007-bl.
+    Valid unconditionally for all inputs, including p == q (doubling),
+    p == -q (yields the identity), and either operand the identity.
+
+    The 14 field multiplications are grouped into THREE stacked mont_mul
+    calls (6 + 2 + 6 independent products batched along a new leading axis):
+    the traced graph shrinks ~3x — which is what keeps the 256-step
+    scalar/MSM loop bodies fast to compile — and the wider batches fill
+    VPU lanes better at small batch sizes.
     """
     X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
     X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
+    addf = lambda a, b: field.add(a, b, FP)
+    subf = lambda a, b: field.sub(a, b, FP)
 
-    Z1Z1 = field.mont_sqr(Z1, FP)
-    Z2Z2 = field.mont_sqr(Z2, FP)
-    U1 = field.mont_mul(X1, Z2Z2, FP)
-    U2 = field.mont_mul(X2, Z1Z1, FP)
-    S1 = field.mont_mul(field.mont_mul(Y1, Z2, FP), Z2Z2, FP)
-    S2 = field.mont_mul(field.mont_mul(Y2, Z1, FP), Z1Z1, FP)
-    H = field.sub(U2, U1, FP)
-    r = field.sub(S2, S1, FP)
+    # round 1: t0=X1X2, t1=Y1Y2, t2=Z1Z2 and the three cross sums.
+    a1 = jnp.stack([X1, Y1, Z1, addf(X1, Y1), addf(Y1, Z1), addf(X1, Z1)])
+    b1 = jnp.stack([X2, Y2, Z2, addf(X2, Y2), addf(Y2, Z2), addf(X2, Z2)])
+    m = field.mont_mul(a1, b1, FP)
+    t0, t1, t2 = m[0], m[1], m[2]
+    t3 = subf(m[3], addf(t0, t1))        # X1Y2 + X2Y1
+    t4 = subf(m[4], addf(t1, t2))        # Y1Z2 + Y2Z1
+    y3 = subf(m[5], addf(t0, t2))        # X1Z2 + X2Z1
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2
 
-    # General addition path.
-    HH = field.mont_sqr(H, FP)
-    HHH = field.mont_mul(H, HH, FP)
-    V = field.mont_mul(U1, HH, FP)
-    X3 = field.mont_sqr(r, FP)
-    X3 = field.sub(X3, HHH, FP)
-    X3 = field.sub(X3, field.double_val(V, FP), FP)
-    Y3 = field.sub(V, X3, FP)
-    Y3 = field.mont_mul(r, Y3, FP)
-    Y3 = field.sub(Y3, field.mont_mul(S1, HHH, FP), FP)
-    Z3 = field.mont_mul(field.mont_mul(Z1, Z2, FP), H, FP)
-    added = jnp.stack([X3, Y3, Z3], axis=-2)
+    # round 2: the two b3 scalings.
+    s = field.mont_mul(jnp.stack([t2, y3]),
+                       jnp.broadcast_to(_b3(), t2.shape), FP)
+    t2, y3 = s[0], s[1]
+    z3 = addf(t1, t2)
+    t1 = subf(t1, t2)
 
-    doubled = double(p)
+    # round 3: the six output products.
+    a3 = jnp.stack([t4, t3, y3, t1, t0, z3])
+    b3v = jnp.stack([y3, t1, t0, z3, t3, t4])
+    o = field.mont_mul(a3, b3v, FP)
+    x3 = subf(o[1], o[0])                # t3*t1 - t4*y3
+    y3o = addf(o[3], o[2])               # t1*z3 + y3*t0
+    z3o = addf(o[5], o[4])               # z3*t4 + t0*t3
+    return jnp.stack([x3, y3o, z3o], axis=-2)
 
-    id1 = is_identity(p)
-    id2 = is_identity(q)
-    h0 = field.is_zero(H)
-    r0 = field.is_zero(r)
 
-    same = jnp.logical_and(jnp.logical_and(h0, r0),
-                           jnp.logical_and(~id1, ~id2))
-    anni = jnp.logical_and(jnp.logical_and(h0, ~r0),
-                           jnp.logical_and(~id1, ~id2))
-
-    out = added
-    out = jnp.where(same[..., None, None], doubled, out)
-    out = jnp.where(anni[..., None, None], identity(p.shape[:-2]), out)
-    out = jnp.where(id2[..., None, None], p, out)
-    out = jnp.where(id1[..., None, None], q, out)
-    return out
+def double(p: jnp.ndarray) -> jnp.ndarray:
+    """Doubling via the complete addition (valid for all inputs)."""
+    return add(p, p)
 
 
 def neg(p: jnp.ndarray) -> jnp.ndarray:
@@ -113,9 +102,11 @@ def neg(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def scale(p: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
-    """p if bit else identity — implemented by masking Z (cheap select)."""
-    Z = p[..., _Z, :] * bit[..., None].astype(jnp.uint32)
-    return p.at[..., _Z, :].set(Z)
+    """p if bit else identity — mask X and Z (identity is (0 : y : 0); any
+    y != 0 works, and real curve points never have Y = 0 on BN254)."""
+    b = bit[..., None].astype(jnp.uint32)
+    out = p.at[..., _X, :].set(p[..., _X, :] * b)
+    return out.at[..., _Z, :].set(p[..., _Z, :] * b)
 
 
 def _scalar_bit(scalars: jnp.ndarray, bit_index) -> jnp.ndarray:
@@ -127,19 +118,18 @@ def _scalar_bit(scalars: jnp.ndarray, bit_index) -> jnp.ndarray:
 
 
 def scalar_mul(p: jnp.ndarray, scalar: jnp.ndarray) -> jnp.ndarray:
-    """Double-and-add scalar multiplication (256 fixed iterations).
+    """Double-and-always-add over 256 fixed iterations (branchless).
 
     p: (..., 3, 16) point(s); scalar: (..., 16) plain-integer limbs.
-    Not constant-time in value distribution but branchless in structure —
-    verification-side only (SURVEY.md §7: constant-time not required).
+    Verification-side only: constant-time not required (SURVEY.md §7), but
+    the structure is data-oblivious anyway.
     """
     batch = p.shape[:-2]
 
     def body(i, acc):
-        acc = double(acc)
+        acc = add(acc, acc)
         bit = _scalar_bit(scalar, 255 - i)
-        cand = add(acc, p)
-        return jnp.where(bit[..., None, None].astype(bool), cand, acc)
+        return add(acc, scale(p, bit))
 
     return jax.lax.fori_loop(0, 256, body, identity(batch))
 
@@ -148,7 +138,7 @@ def _tree_sum(pts: jnp.ndarray) -> jnp.ndarray:
     """Pairwise tree reduction of points over axis -3 (the term axis).
 
     pts: (..., T, 3, 16) with T a power of two -> (..., 3, 16).
-    log2(T) vectorized point additions.
+    log2(T) vectorized complete additions.
     """
     T = pts.shape[-3]
     while T > 1:
@@ -177,22 +167,37 @@ def _pad_pow2(pts: jnp.ndarray, scalars: jnp.ndarray):
 def msm(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
     """Batched multi-scalar multiplication with shared doublings.
 
-    points: (..., T, 3, 16) Montgomery Jacobian; scalars: (..., T, 16) plain
-    limbs. Returns (..., 3, 16) = sum_t scalars[t] * points[t].
+    points: (..., T, 3, 16) Montgomery projective; scalars: (..., T, 16)
+    plain limbs. Returns (..., 3, 16) = sum_t scalars[t] * points[t].
 
     MSB-first bit scan: per bit, one shared doubling of the accumulator plus
     a masked tree-sum over the T term axis — every op is batch x T wide,
-    which is what keeps the VPU lanes full (SURVEY.md §2.5: batch
-    data-parallel proof verification is the only first-class parallelism).
+    keeping VPU lanes full (SURVEY.md §2.5: batch data-parallel proof
+    verification is the only first-class parallelism).
     """
     points, scalars = _pad_pow2(points, scalars)
     batch = points.shape[:-3]
+    T = points.shape[-3]
+    levels = max(1, T).bit_length() - 1  # log2(T)
+    half = T // 2
+    pad_ids = identity(batch + (half,)) if half else None
+
+    def fold_level(_, x):
+        # Pairwise-add neighbours, refill with identities: the array keeps
+        # shape (..., T, 3, 16) every level, so the whole log2(T)-level tree
+        # is ONE `add` instantiation inside a fori_loop — the key to fast
+        # XLA compiles of the MSM body.
+        xr = x.reshape(batch + (half, 2) + x.shape[-2:])
+        s = add(xr[..., 0, :, :], xr[..., 1, :, :])
+        return jnp.concatenate([s, pad_ids], axis=-3)
 
     def body(i, acc):
-        acc = double(acc)
+        acc = add(acc, acc)
         bits = _scalar_bit(scalars, 255 - i)  # (..., T)
         masked = scale(points, bits)
-        return add(acc, _tree_sum(masked))
+        if half:
+            masked = jax.lax.fori_loop(0, levels, fold_level, masked)
+        return add(acc, masked[..., 0, :, :])
 
     return jax.lax.fori_loop(0, 256, body, identity(batch))
 
@@ -202,18 +207,33 @@ def msm_is_identity(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
     return is_identity(msm(points, scalars))
 
 
+def to_affine(p: jnp.ndarray) -> jnp.ndarray:
+    """Projective Montgomery -> canonical affine limbs (..., 2, 16).
+
+    Identity maps to (0, 0), matching the 64-zero-byte mathlib encoding
+    (reference G1.Bytes() via gnark RawBytes; see crypto/serialization.py).
+    Uses vectorized Fermat inversion — fine for batch post-processing.
+    """
+    X, Y, Z = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    zinv = field.inv(Z, FP)
+    xa = field.from_mont(field.mont_mul(X, zinv, FP), FP)
+    ya = field.from_mont(field.mont_mul(Y, zinv, FP), FP)
+    inf = is_identity(p)[..., None]
+    xa = jnp.where(inf, jnp.zeros_like(xa), xa)
+    ya = jnp.where(inf, jnp.zeros_like(ya), ya)
+    return jnp.stack([xa, ya], axis=-2)
+
+
 def points_equal(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Jacobian equality without inversion: cross-multiplied coordinates."""
+    """Projective equality without inversion: cross-multiplied coordinates."""
     X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
     X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
-    Z1Z1 = field.mont_sqr(Z1, FP)
-    Z2Z2 = field.mont_sqr(Z2, FP)
     x_eq = field.is_zero(
-        field.sub(field.mont_mul(X1, Z2Z2, FP),
-                  field.mont_mul(X2, Z1Z1, FP), FP))
+        field.sub(field.mont_mul(X1, Z2, FP),
+                  field.mont_mul(X2, Z1, FP), FP))
     y_eq = field.is_zero(
-        field.sub(field.mont_mul(field.mont_mul(Y1, Z2, FP), Z2Z2, FP),
-                  field.mont_mul(field.mont_mul(Y2, Z1, FP), Z1Z1, FP), FP))
+        field.sub(field.mont_mul(Y1, Z2, FP),
+                  field.mont_mul(Y2, Z1, FP), FP))
     both_id = jnp.logical_and(is_identity(p), is_identity(q))
     one_id = jnp.logical_xor(is_identity(p), is_identity(q))
     eq = jnp.logical_and(x_eq, y_eq)

@@ -2,26 +2,30 @@
 
 Design notes (why this maps well to TPU / XLA, SURVEY.md §7 item 1):
 
-- All loops below run over the *static* limb index (16 or 32 iterations) and
-  are unrolled at trace time; the batch dimensions are the vector axes, so
-  every emitted op is a full-width VPU op over the batch.
-- 16x16-bit products fit exactly in uint32 ((2^16-1)^2 < 2^32), and lazy
-  column accumulation adds at most ~2^6 such 16-bit half-terms, keeping
-  every lane < 2^23 — no 64-bit integers anywhere, which TPUs lack natively.
-- Montgomery (radix 2^256) keeps reduction multiplication-only; the single
-  carry chain per mul is a 16-step scalar-dependency but each step is a
-  batch-wide vector op.
+- 16x16-bit products fit exactly in uint32 ((2^16-1)^2 < 2^32) and lazy
+  column accumulation adds at most ~2^5 such 16-bit half-terms, keeping every
+  lane < 2^22 — no 64-bit integers anywhere, which TPUs lack natively.
+- Column sums use a shift-and-add schedule (one jnp.pad + add per limb row):
+  no dynamic-update-slices, so traced graphs stay small and XLA compiles
+  them quickly; the batch dimensions are the vector axes and every emitted
+  op is a full-width VPU op over the batch.
+- Carry/borrow chains are `lax.scan` over the limb axis: sequential by
+  nature (16-33 steps) but each step is one batch-wide vector op and the
+  scan body compiles once.
+- Montgomery reduction is the separated (SOS) form: m = T_lo * N' mod 2^256,
+  then (T + m*N) >> 256 — three shift-and-add products per modular multiply.
 
-The functions are modulus-generic: `FieldSpec` bundles the limb constants for
-Fp (point coordinates) and Fr (scalars). Equivalent of the reference's
-IBM/mathlib -> gnark-crypto assembly field layer (reference
-token/core/zkatdlog/nogh/v1/crypto/setup.go:14).
+The functions are modulus-generic: `FieldSpec` bundles the limb constants
+for Fp (point coordinates) and Fr (scalars). This layer is the TPU-native
+equivalent of the reference's IBM/mathlib -> gnark-crypto assembly field
+layer (reference token/core/zkatdlog/nogh/v1/crypto/setup.go:14).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,10 +41,10 @@ class FieldSpec:
     """Static limb constants for one prime field (hashable -> jit-static)."""
 
     name: str
-    mod: tuple[int, ...]       # modulus limbs
-    r1: tuple[int, ...]        # montgomery 1
-    r2: tuple[int, ...]        # montgomery R^2 (for to_mont)
-    n0inv: int                 # -mod^-1 mod 2^16
+    mod: tuple[int, ...]        # modulus limbs
+    r1: tuple[int, ...]         # montgomery 1
+    r2: tuple[int, ...]         # montgomery R^2 (for to_mont)
+    nprime: tuple[int, ...]     # -mod^-1 mod 2^256, full 16 limbs
 
     @property
     def mod_arr(self) -> jnp.ndarray:
@@ -54,76 +58,162 @@ class FieldSpec:
     def r2_arr(self) -> jnp.ndarray:
         return jnp.asarray(np.array(self.r2, dtype=np.uint32))
 
+    @property
+    def nprime_arr(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.nprime, dtype=np.uint32))
 
-FP = FieldSpec(
-    name="fp",
-    mod=tuple(int(v) for v in L.P_LIMBS),
-    r1=tuple(int(v) for v in L.P_R1_LIMBS),
-    r2=tuple(int(v) for v in L.P_R2_LIMBS),
-    n0inv=int(L.P_N0INV),
-)
+    @property
+    def mod_int(self) -> int:
+        v = 0
+        for limb in reversed(self.mod):
+            v = (v << BITS) | limb
+        return v
 
-FR = FieldSpec(
-    name="fr",
-    mod=tuple(int(v) for v in L.R_LIMBS),
-    r1=tuple(int(v) for v in L.R_R1_LIMBS),
-    r2=tuple(int(v) for v in L.R_R2_LIMBS),
-    n0inv=int(L.R_N0INV),
-)
+
+def _spec(name, mod_limbs, r1, r2, mod_int) -> FieldSpec:
+    nprime = (-pow(mod_int, -1, L.MONT_R)) % L.MONT_R
+    return FieldSpec(
+        name=name,
+        mod=tuple(int(v) for v in mod_limbs),
+        r1=tuple(int(v) for v in r1),
+        r2=tuple(int(v) for v in r2),
+        nprime=tuple(int(v) for v in L.int_to_limbs(nprime)),
+    )
+
+
+FP = _spec("fp", L.P_LIMBS, L.P_R1_LIMBS, L.P_R2_LIMBS, L.P_INT)
+FR = _spec("fr", L.R_LIMBS, L.R_R1_LIMBS, L.R_R2_LIMBS, L.R_INT)
+
+
+def _shift_right_one(x: jnp.ndarray) -> jnp.ndarray:
+    """x_i -> x_{i-1} along the limb axis, zero-filled at i=0."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    return jnp.pad(x[..., :-1], pad)
+
+
+def _lookahead(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive carry/borrow-lookahead prefix over the limb axis.
+
+    Kogge-Stone generate/propagate: carry_{0..i} = g_i | (p_i & carry_{0..i-1}).
+    Returns carry_in per limb (exclusive prefix). Loop-free: log2(limbs)
+    combine steps via associative_scan.
+    """
+    def combine(left, right):
+        lg, lp = left
+        rg, rp = right
+        return rg | (rp & lg), lp & rp
+
+    inc_g, _ = jax.lax.associative_scan(combine, (g, p), axis=-1)
+    return _shift_right_one(inc_g.astype(jnp.uint32))
 
 
 def _carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
     """Propagate lazy column sums (< 2^32) into canonical 16-bit limbs.
 
-    t: (..., K) uint32. Returns (..., out_limbs); caller guarantees the value
-    fits (any final carry would be dropped).
+    t: (..., K) uint32. Returns (..., out_limbs); caller guarantees the
+    value fits (any final carry is dropped). Two shift-folds bring every
+    lane to <= 2^16, then one exact lookahead pass resolves ripples.
     """
-    cols = []
-    carry = jnp.zeros(t.shape[:-1], dtype=jnp.uint32)
     k = t.shape[-1]
-    for i in range(out_limbs):
-        cur = (t[..., i] if i < k else jnp.zeros_like(carry)) + carry
-        cols.append(cur & MASK)
-        carry = cur >> BITS
-    return jnp.stack(cols, axis=-1)
+    if k < out_limbs:
+        t = jnp.concatenate(
+            [t, jnp.zeros(t.shape[:-1] + (out_limbs - k,), dtype=t.dtype)],
+            axis=-1)
+    else:
+        t = t[..., :out_limbs]
+    v = (t & MASK) + _shift_right_one(t >> BITS)      # <= 2^17
+    v = (v & MASK) + _shift_right_one(v >> BITS)      # <= 2^16
+    g = (v >> BITS).astype(bool)                      # v == 2^16 exactly
+    p = v == MASK
+    carry_in = _lookahead(g, p)
+    return (v + carry_in) & MASK
 
 
 def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """a - b over canonical limbs; returns (diff, borrow_out in {0,1})."""
-    cols = []
-    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
-    for i in range(a.shape[-1]):
-        cur = a[..., i] + jnp.uint32(1 << BITS) - b[..., i] - borrow
-        cols.append(cur & MASK)
-        borrow = jnp.uint32(1) - (cur >> BITS)
-    return jnp.stack(cols, axis=-1), borrow
+    b = jnp.broadcast_to(b, a.shape)
+    g = a < b
+    p = a == b
+    borrow_in = _lookahead(g, p)
+    diff = (a + jnp.uint32(1 << BITS) - b - borrow_in) & MASK
+    # total borrow-out: generate at the top limb after including borrow chain
+    last_g = jnp.logical_or(g[..., -1],
+                            jnp.logical_and(p[..., -1],
+                                            borrow_in[..., -1].astype(bool)))
+    return diff, last_g.astype(jnp.uint32)
+
+
+_DIAG_MATS: dict = {}
+
+
+def _diag_mats(na: int, nb: int, out_cols: int):
+    """0/1 f32 matrices mapping flattened partial products to columns.
+
+    M_lo[(i*nb+j), k] = 1 iff i+j == k; M_hi shifts by one limb. Column sums
+    are < 2^22, exactly representable in f32 — so the whole diagonal-sum
+    reduction is one f32 matmul (MXU-eligible on TPU).
+    """
+    key = (na, nb, out_cols)
+    if key not in _DIAG_MATS:
+        lo = np.zeros((na * nb, out_cols), dtype=np.float32)
+        hi = np.zeros((na * nb, out_cols), dtype=np.float32)
+        for i in range(na):
+            for j in range(nb):
+                if i + j < out_cols:
+                    lo[i * nb + j, i + j] = 1.0
+                if i + j + 1 < out_cols:
+                    hi[i * nb + j, i + j + 1] = 1.0
+        _DIAG_MATS[key] = (lo, hi)  # numpy: safe to cache across traces
+    m_lo, m_hi = _DIAG_MATS[key]
+    return jnp.asarray(m_lo), jnp.asarray(m_hi)
+
+
+def _shift_add_product(a: jnp.ndarray, b: jnp.ndarray, nb: int,
+                       out_cols: int) -> jnp.ndarray:
+    """Lazy column sums of the product a * b.
+
+    a: (..., na) canonical limbs; b: (nb,) constant or (..., nb) limbs.
+    Returns (..., out_cols) lazy columns (each < 2^22). Partial products are
+    split lo/hi 16-bit halves and reduced along anti-diagonals with two f32
+    matmuls — exact (sums < 2^22 < 2^24) and compile-friendly.
+    """
+    na = a.shape[-1]
+    p = a[..., :, None] * jnp.broadcast_to(b, a.shape[:-1] + (nb,))[..., None, :]
+    lo = (p & MASK).astype(jnp.float32).reshape(*a.shape[:-1], na * nb)
+    hi = (p >> BITS).astype(jnp.float32).reshape(*a.shape[:-1], na * nb)
+    m_lo, m_hi = _diag_mats(na, nb, out_cols)
+    # Precision.HIGHEST: TPU matmuls default to bf16 passes, which would
+    # corrupt the exact integer sums; HIGHEST gives true-f32 accumulation.
+    cols = (jnp.matmul(lo, m_lo, precision=jax.lax.Precision.HIGHEST)
+            + jnp.matmul(hi, m_hi, precision=jax.lax.Precision.HIGHEST))
+    return cols.astype(jnp.uint32)
+
+
+def _cond_sub_mod(res: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """One conditional subtract of mod over N+1 canonical limbs -> N limbs."""
+    mod_ext = jnp.concatenate([spec.mod_arr, jnp.zeros(1, dtype=jnp.uint32)])
+    diff, borrow = _sub_limbs(res, mod_ext)
+    keep = (borrow != 0)[..., None]
+    return jnp.where(keep, res, diff)[..., :N]
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Modular addition of canonical-limb values < mod."""
     s = _carry_propagate(a + b, N + 1)
-    # value < 2 * mod < 2^257: compare/subtract over 17 limbs.
-    mod17 = jnp.concatenate(
-        [spec.mod_arr, jnp.zeros(1, dtype=jnp.uint32)]).astype(jnp.uint32)
-    mod17 = jnp.broadcast_to(mod17, s.shape)
-    diff, borrow = _sub_limbs(s, mod17)
-    keep = (borrow != 0)[..., None]
-    return jnp.where(keep, s, diff)[..., :N]
+    return _cond_sub_mod(s, spec)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Modular subtraction of canonical-limb values < mod."""
-    diff, borrow = _sub_limbs(a, b)
-    mod = jnp.broadcast_to(spec.mod_arr, a.shape)
-    fixed = _carry_propagate(diff + mod, N)
+    diff, borrow = _sub_limbs(a, jnp.broadcast_to(b, a.shape))
+    fixed = _carry_propagate(diff + spec.mod_arr, N)
     need_fix = (borrow != 0)[..., None]
     return jnp.where(need_fix, fixed, diff)
 
 
 def neg(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Modular negation: mod - a, with -0 = 0."""
-    mod = jnp.broadcast_to(spec.mod_arr, a.shape)
-    diff, _ = _sub_limbs(mod, a)
+    diff, _ = _sub_limbs(jnp.broadcast_to(spec.mod_arr, a.shape), a)
     zero = is_zero(a)[..., None]
     return jnp.where(zero, jnp.zeros_like(a), diff)
 
@@ -136,41 +226,27 @@ def is_zero(a: jnp.ndarray) -> jnp.ndarray:
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Montgomery product a*b*R^-1 mod m over (..., 16) uint32 limbs.
 
-    Product scanning with lo/hi split lazy columns, then an interleaved
-    word-by-word Montgomery reduction. Output canonical (< mod).
+    Separated (SOS) reduction:
+      T  = a*b                      (canonical, 2N+1 cols)
+      m  = (T mod 2^256) * N' mod 2^256
+      S  = (T + m*mod) >> 256      (exact division; low half cancels)
+    Output canonical (< mod): standard bound (p^2 + 2^256 p)/2^256 < 2p.
     """
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
-    batch = shape[:-1]
-    t = jnp.zeros(batch + (2 * N + 1,), dtype=jnp.uint32)
 
-    # Schoolbook partial products, lazily accumulated per column.
-    for i in range(N):
-        p = a[..., i : i + 1] * b  # (..., N) full 32-bit products
-        t = t.at[..., i : i + N].add(p & MASK)
-        t = t.at[..., i + 1 : i + N + 1].add(p >> BITS)
+    t_cols = _shift_add_product(a, b, N, 2 * N)
+    T = _carry_propagate(t_cols, 2 * N + 1)
 
-    # Interleaved Montgomery reduction: one m_i per low limb.
-    mod = spec.mod_arr
-    n0inv = jnp.uint32(spec.n0inv)
-    carry = jnp.zeros(batch, dtype=jnp.uint32)
-    for i in range(N):
-        cur = t[..., i] + carry
-        m = ((cur & MASK) * n0inv) & MASK
-        pm = m[..., None] * mod  # (..., N)
-        t = t.at[..., i : i + N].add(pm & MASK)
-        t = t.at[..., i + 1 : i + N + 1].add(pm >> BITS)
-        carry = (cur + ((m * mod[0]) & MASK)) >> BITS
+    m_cols = _shift_add_product(T[..., :N], spec.nprime_arr, N, N)
+    m = _carry_propagate(m_cols, N)
 
-    hi = t[..., N:]
-    hi = hi.at[..., 0].add(carry)
-    res = _carry_propagate(hi, N + 1)
-    mod17 = jnp.concatenate([spec.mod_arr, jnp.zeros(1, dtype=jnp.uint32)])
-    mod17 = jnp.broadcast_to(mod17, res.shape)
-    diff, borrow = _sub_limbs(res, mod17)
-    keep = (borrow != 0)[..., None]
-    return jnp.where(keep, res, diff)[..., :N]
+    u_cols = _shift_add_product(m, spec.mod_arr, N, 2 * N)
+    s = _carry_propagate(T + jnp.pad(u_cols, [(0, 0)] * (T.ndim - 1) + [(0, 1)]),
+                         2 * N + 1)
+    res = s[..., N:]  # (..., N+1); low N limbs are zero by construction
+    return _cond_sub_mod(res, spec)
 
 
 def mont_sqr(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
@@ -193,3 +269,28 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def double_val(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     return add(a, a, spec)
+
+
+def pow_const(a: jnp.ndarray, exponent: int, spec: FieldSpec) -> jnp.ndarray:
+    """a^exponent for a fixed public exponent (Montgomery in/out).
+
+    Square-and-multiply via lax.fori_loop with the exponent bits as a
+    constant device array — one compact loop body.
+    """
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        np.array([(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                 dtype=np.uint32))
+    one = jnp.broadcast_to(spec.r1_arr, a.shape)
+
+    def body(i, acc):
+        acc = mont_mul(acc, acc, spec)
+        mul = mont_mul(acc, a, spec)
+        return jnp.where(bits[i].astype(bool), mul, acc)
+
+    return jax.lax.fori_loop(0, nbits, body, one)
+
+
+def inv(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Fermat inverse a^(mod-2); Montgomery in/out. inv(0) = 0."""
+    return pow_const(a, spec.mod_int - 2, spec)

@@ -28,16 +28,13 @@ R_INT = bn254.R
 MONT_R = 1 << (LIMB_BITS * NLIMBS)
 
 
-def _mont_consts(mod: int) -> tuple[int, int, int]:
-    """(R mod m, R^2 mod m, -m^-1 mod 2^LIMB_BITS)."""
-    r1 = MONT_R % mod
-    r2 = (MONT_R * MONT_R) % mod
-    n0inv = (-pow(mod, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
-    return r1, r2, n0inv
+def _mont_consts(mod: int) -> tuple[int, int]:
+    """(R mod m, R^2 mod m)."""
+    return MONT_R % mod, (MONT_R * MONT_R) % mod
 
 
-P_R1_INT, P_R2_INT, P_N0INV = _mont_consts(P_INT)
-R_R1_INT, R_R2_INT, R_N0INV = _mont_consts(R_INT)
+P_R1_INT, P_R2_INT = _mont_consts(P_INT)
+R_R1_INT, R_R2_INT = _mont_consts(R_INT)
 
 
 def int_to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
@@ -85,14 +82,14 @@ def fp_from_mont_int(x: int) -> int:
     return (x * pow(MONT_R, -1, P_INT)) % P_INT
 
 
-def point_to_jacobian_limbs(p: bn254.G1) -> np.ndarray:
-    """Affine host point -> (3, NLIMBS) Montgomery Jacobian uint32 limbs.
+def point_to_projective_limbs(p: bn254.G1) -> np.ndarray:
+    """Affine host point -> (3, NLIMBS) Montgomery projective uint32 limbs.
 
-    Identity encodes as Z = 0 (X, Y arbitrary non-garbage: montgomery 1).
+    Identity encodes as (0 : 1 : 0) — the representation the complete
+    RCB15 addition formulas in ops.ec expect.
     """
     if p.inf:
-        one = int_to_limbs(P_R1_INT)
-        return np.stack([one, one, ZERO_LIMBS])
+        return np.stack([ZERO_LIMBS, int_to_limbs(P_R1_INT), ZERO_LIMBS])
     return np.stack([
         int_to_limbs(fp_to_mont_int(p.x)),
         int_to_limbs(fp_to_mont_int(p.y)),
@@ -100,17 +97,20 @@ def point_to_jacobian_limbs(p: bn254.G1) -> np.ndarray:
     ])
 
 
-def points_to_jacobian_limbs(points) -> np.ndarray:
+def points_to_projective_limbs(points) -> np.ndarray:
     """(N, 3, NLIMBS) uint32 from a list of host points."""
-    return np.stack([point_to_jacobian_limbs(p) for p in points])
+    return np.stack([point_to_projective_limbs(p) for p in points])
 
 
-def jacobian_limbs_to_point(arr: np.ndarray) -> bn254.G1:
-    """Device (3, NLIMBS) Montgomery Jacobian -> host affine point."""
+def projective_limbs_to_point(arr: np.ndarray) -> bn254.G1:
+    """Device (3, NLIMBS) Montgomery projective -> host affine point."""
     X = fp_from_mont_int(limbs_to_int(arr[0]))
     Y = fp_from_mont_int(limbs_to_int(arr[1]))
     Z = fp_from_mont_int(limbs_to_int(arr[2]))
-    return bn254._jac_to_affine(X, Y, Z)
+    if Z == 0:
+        return bn254.G1_IDENTITY
+    zinv = pow(Z, P_INT - 2, P_INT)
+    return bn254.G1(X * zinv % P_INT, Y * zinv % P_INT)
 
 
 def scalars_to_limbs(scalars) -> np.ndarray:

@@ -1,0 +1,10 @@
+"""Device-mesh parallelism for batched proof verification.
+
+The reference's only first-class parallelism is goroutine concurrency plus a
+sequential per-proof verify loop (SURVEY.md §2.5); here verification scales
+over a jax.sharding.Mesh: proofs are data-parallel ('dp') and the MSM term
+axis is model-parallel ('tp') with an all-gather + point-fold combine over
+ICI (XLA collectives, not NCCL/MPI — SURVEY.md §2.5 "TPU-native equivalent").
+"""
+
+from .mesh import make_mesh, sharded_msm_is_identity  # noqa: F401

@@ -1,0 +1,4 @@
+"""Services tier: transaction lifecycle, auditing, storage, identity, network.
+
+Mirrors the capability surface of reference token/services (SURVEY.md §2.4).
+"""

@@ -1,0 +1,63 @@
+"""Auditor service: application-level audit of token requests.
+
+Behavioral mirror of reference token/services/auditor/auditor.go:73-151 and
+ttx/auditor.go:128-254: on an audit request the auditor Validates the
+request (driver AuditorCheck), locks the involved enrollment IDs, appends
+the records to its auditdb, endorses (signs) the request, and releases the
+locks when finality arrives.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .db.sqldb import AuditDB, TxStatus, TxRecord
+from .node import TokenNode
+from .ttx import Transaction, TtxError
+
+
+class AuditError(Exception):
+    pass
+
+
+class AuditorNode(TokenNode):
+    """A TokenNode playing the auditor role."""
+
+    def __init__(self, *args, audit_check=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.auditdb = AuditDB(":memory:")
+        # audit_check(tx) -> None: driver-specific inspection. fabtoken has
+        # plaintext actions (nothing to open); zkatdlog plugs the
+        # commitment-reopen batch check here (crypto/audit/auditor.go:135).
+        self.audit_check = audit_check
+
+    # responder view (ttx/auditor.go:265-282 AuditApproveView)
+    def audit(self, tx: Transaction) -> bytes:
+        # 1. validate (auditor/auditor.go:73: Validate -> Request.AuditCheck)
+        if self.audit_check is not None:
+            try:
+                self.audit_check(tx)
+            except Exception as e:
+                raise AuditError(f"audit check failed: {e}") from e
+        # 2. lock enrollment IDs (auditor/auditor.go:80-100)
+        eids = sorted({name for name in tx.input_owners})
+        self.auditdb.acquire_locks(tx.tx_id, eids)
+        # 3. append records + subscribe finality (auditor/auditor.go:102)
+        for rec in tx.records:
+            self.auditdb.add_transaction(rec)
+        self.auditdb.add_token_request(tx.tx_id, tx.request.to_bytes())
+        self._watched[tx.tx_id] = tx.request
+        # 4. endorse: sign the request (crypto/audit/auditor.go:117-132)
+        return self.keys.sign(tx.message_to_sign())
+
+    def _on_commit(self, ev) -> None:
+        super()._on_commit(ev)
+        # release EID locks at finality (auditor/auditor.go:117-151)
+        self.auditdb.release_locks(ev.tx_id)
+        status = (TxStatus.CONFIRMED if ev.status == "VALID"
+                  else TxStatus.DELETED)
+        self.auditdb.set_status(ev.tx_id, status, ev.message)
+
+    # reporting API (auditdb payments/holdings filters)
+    def audited_payments(self, party: str) -> list[TxRecord]:
+        return self.auditdb.payments(party)

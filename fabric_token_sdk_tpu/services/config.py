@@ -1,0 +1,150 @@
+"""Config service: typed access to the `token:` configuration tree.
+
+Behavioral mirror of reference token/services/config/config.go:80-147 over
+the YAML schema documented at reference docs/core-token.md:1-200: TMS
+enumeration keyed by (network, channel, namespace), selector and finality
+tuning, db driver choice, wallet trees. YAML parsing uses a small built-in
+subset loader when PyYAML is unavailable (zero new dependencies).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class TMSID:
+    """(network, channel, namespace) triple identifying one TMS."""
+
+    network: str
+    channel: str = ""
+    namespace: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.network},{self.channel},{self.namespace}"
+
+
+@dataclass
+class TMSConfig:
+    tms_id: TMSID
+    driver: str = "fabtoken"
+    public_params_path: str = ""
+    db_driver: str = "sqlite"
+    db_path: str = ":memory:"
+    selector: dict = field(default_factory=lambda: {
+        # docs/core-token.md:13-31 selector tree
+        "driver": "sherdlock",
+        "retryInterval": "5s",
+        "numRetries": 3,
+        "leaseExpiry": "180s",
+        "leaseCleanupTickPeriod": "60s",
+    })
+    finality: dict = field(default_factory=lambda: {
+        # docs/core-token.md:33-77 finality/delivery tuning
+        "committerParallelism": 8,
+        "mapperParallelism": 8,
+        "blockProcessParallelism": 1,
+    })
+    wallets: dict = field(default_factory=dict)
+    raw: dict = field(default_factory=dict)
+
+
+class Config:
+    """config.go:80-147: the `token:` section of the node config."""
+
+    def __init__(self, tree: dict | None = None):
+        self.tree = tree or {}
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path) as f:
+            text = f.read()
+        try:
+            import yaml  # type: ignore
+
+            return cls(yaml.safe_load(text) or {})
+        except ImportError:
+            try:
+                return cls(json.loads(text))
+            except json.JSONDecodeError as e:
+                raise ConfigError(
+                    "config must be JSON when PyYAML is unavailable") from e
+
+    def token_enabled(self) -> bool:
+        return bool(self.tree.get("token", {}).get("enabled", True))
+
+    def version(self) -> str:
+        return str(self.tree.get("token", {}).get("version", "v1"))
+
+    def tms_configs(self) -> list[TMSConfig]:
+        """Enumerate configured TMSs (config.go:96-147)."""
+        out = []
+        tms_tree = self.tree.get("token", {}).get("tms", {})
+        for key, entry in tms_tree.items():
+            entry = entry or {}
+            tms_id = TMSID(
+                network=entry.get("network", key),
+                channel=entry.get("channel", ""),
+                namespace=entry.get("namespace", ""),
+            )
+            cfg = TMSConfig(tms_id=tms_id, raw=entry)
+            if "driver" in entry:
+                cfg.driver = entry["driver"]
+            if "publicParameters" in entry:
+                cfg.public_params_path = (
+                    entry["publicParameters"].get("path", ""))
+            db = entry.get("db", {}).get("persistence", {})
+            if db:
+                cfg.db_driver = db.get("type", cfg.db_driver)
+                opts = db.get("opts", {})
+                cfg.db_path = opts.get("dataSource", cfg.db_path)
+            if "selector" in entry:
+                cfg.selector.update(entry["selector"])
+            if "finality" in entry:
+                cfg.finality.update(entry["finality"])
+            if "wallets" in entry:
+                cfg.wallets = entry["wallets"]
+            out.append(cfg)
+        return out
+
+    def tms(self, tms_id: TMSID) -> TMSConfig:
+        for cfg in self.tms_configs():
+            if cfg.tms_id == tms_id:
+                return cfg
+        raise ConfigError(f"no TMS configured for [{tms_id}]")
+
+
+def parse_duration(s: str | float | int) -> float:
+    """Go-style duration strings ("5s", "1m30s", "500ms") -> seconds."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    total = 0.0
+    num = ""
+    i = 0
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6,
+             "ns": 1e-9}
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c == ".":
+            num += c
+            i += 1
+            continue
+        unit = c
+        if s[i : i + 2] in ("ms", "us", "ns"):
+            unit = s[i : i + 2]
+            i += 2
+        else:
+            i += 1
+        if not num or unit not in units:
+            raise ConfigError(f"invalid duration [{s}]")
+        total += float(num) * units[unit]
+        num = ""
+    if num:
+        raise ConfigError(f"invalid duration [{s}]")
+    return total

@@ -1,0 +1,17 @@
+"""Database facades: token, transaction, audit, identity, token-lock stores.
+
+Mirrors reference token/services/db + db/sql (SURVEY.md §2.4 "db/sql"): one
+schema + query layer serving all five DBs, with sqlite (file or :memory:)
+as the default backend — the reference's sqlite/postgres/unity/memory driver
+matrix collapses to sqlite-file and sqlite-memory here, behind the same
+facade API so a postgres driver can slot in later.
+"""
+
+from .sqldb import (  # noqa: F401
+    TokenDB,
+    TransactionDB,
+    AuditDB,
+    TokenLockDB,
+    IdentityDB,
+    TxStatus,
+)

@@ -1,0 +1,462 @@
+"""SQLite-backed stores for tokens, transactions, audit records, locks.
+
+Behavioral mirror of the reference SQL layer (token/services/db/sql/common:
+tokens.go:38-560, transactions, auditdb, tokenlockdb) over Python sqlite3.
+All stores accept a path or ":memory:"; connections are serialized behind a
+lock (sqlite3 default isolation), which stands in for the reference's
+per-driver connection pools.
+
+Quantities are stored as the canonical "0x" hex string plus a numeric
+column for range/balance queries (precision <= 64 fits SQLite INTEGER).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass
+
+from ...token.model import ID, UnspentToken
+
+
+class DBError(Exception):
+    pass
+
+
+class TxStatus:
+    """ttxdb status machine (reference ttxdb/db.go:60-100)."""
+
+    UNKNOWN = "Unknown"
+    PENDING = "Pending"
+    CONFIRMED = "Confirmed"
+    DELETED = "Deleted"
+
+
+class _Base:
+    def __init__(self, path: str = ":memory:"):
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self._mu = threading.RLock()
+        with self._mu:
+            self.conn.executescript(self.SCHEMA)
+            self.conn.commit()
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+class TokenDB(_Base):
+    """Unspent-token store + ownership index (db/sql/common/tokens.go)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS tokens (
+        tx_id TEXT NOT NULL,
+        idx INTEGER NOT NULL,
+        owner_raw BLOB NOT NULL,
+        token_type TEXT NOT NULL,
+        quantity TEXT NOT NULL,
+        amount INTEGER NOT NULL,
+        ledger_format TEXT NOT NULL DEFAULT '',
+        ledger_token BLOB NOT NULL DEFAULT x'',
+        ledger_metadata BLOB NOT NULL DEFAULT x'',
+        is_deleted INTEGER NOT NULL DEFAULT 0,
+        spent_by TEXT NOT NULL DEFAULT '',
+        spendable INTEGER NOT NULL DEFAULT 1,
+        PRIMARY KEY (tx_id, idx)
+    );
+    CREATE TABLE IF NOT EXISTS ownership (
+        tx_id TEXT NOT NULL,
+        idx INTEGER NOT NULL,
+        wallet_id TEXT NOT NULL,
+        PRIMARY KEY (tx_id, idx, wallet_id)
+    );
+    CREATE INDEX IF NOT EXISTS idx_tokens_live
+        ON tokens (is_deleted, token_type);
+    """
+
+    def store_token(self, token_id: ID, owner_raw: bytes, token_type: str,
+                    quantity_hex: str, owners: list[str],
+                    ledger_format: str = "", ledger_token: bytes = b"",
+                    ledger_metadata: bytes = b"",
+                    spendable: bool = True) -> None:
+        amount = int(quantity_hex, 16)
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO tokens (tx_id, idx, owner_raw, "
+                "token_type, quantity, amount, ledger_format, ledger_token, "
+                "ledger_metadata, spendable) VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (token_id.tx_id, token_id.index, owner_raw, token_type,
+                 quantity_hex, amount, ledger_format, ledger_token,
+                 ledger_metadata, int(spendable)))
+            for wid in owners:
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO ownership (tx_id, idx, wallet_id)"
+                    " VALUES (?,?,?)", (token_id.tx_id, token_id.index, wid))
+            self.conn.commit()
+
+    def delete_token(self, token_id: ID, spent_by: str) -> None:
+        with self._mu:
+            self.conn.execute(
+                "UPDATE tokens SET is_deleted = 1, spent_by = ? "
+                "WHERE tx_id = ? AND idx = ?",
+                (spent_by, token_id.tx_id, token_id.index))
+            self.conn.commit()
+
+    def is_mine(self, token_id: ID, wallet_id: str) -> bool:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT 1 FROM ownership WHERE tx_id=? AND idx=? AND "
+                "wallet_id=?",
+                (token_id.tx_id, token_id.index, wallet_id)).fetchone()
+        return row is not None
+
+    def unspent_tokens(self, wallet_id: str | None = None,
+                       token_type: str | None = None) -> list[UnspentToken]:
+        q = ("SELECT t.tx_id, t.idx, t.owner_raw, t.token_type, t.quantity "
+             "FROM tokens t")
+        params: list = []
+        clauses = ["t.is_deleted = 0"]
+        if wallet_id is not None:
+            q += " JOIN ownership o ON t.tx_id=o.tx_id AND t.idx=o.idx"
+            clauses.append("o.wallet_id = ?")
+            params.append(wallet_id)
+        if token_type is not None:
+            clauses.append("t.token_type = ?")
+            params.append(token_type)
+        q += " WHERE " + " AND ".join(clauses) + " ORDER BY t.tx_id, t.idx"
+        with self._mu:
+            rows = self.conn.execute(q, params).fetchall()
+        return [UnspentToken(id=ID(r[0], r[1]), owner=r[2], type=r[3],
+                             quantity=r[4]) for r in rows]
+
+    def balance(self, wallet_id: str | None, token_type: str) -> int:
+        q = "SELECT COALESCE(SUM(t.amount), 0) FROM tokens t"
+        params: list = []
+        clauses = ["t.is_deleted = 0", "t.token_type = ?"]
+        params2 = [token_type]
+        if wallet_id is not None:
+            q += " JOIN ownership o ON t.tx_id=o.tx_id AND t.idx=o.idx"
+            clauses.append("o.wallet_id = ?")
+            params2.append(wallet_id)
+        q += " WHERE " + " AND ".join(clauses)
+        with self._mu:
+            row = self.conn.execute(q, params + params2).fetchone()
+        return int(row[0])
+
+    def get_token(self, token_id: ID, include_deleted: bool = False):
+        q = ("SELECT tx_id, idx, owner_raw, token_type, quantity, is_deleted "
+             "FROM tokens WHERE tx_id=? AND idx=?")
+        with self._mu:
+            row = self.conn.execute(
+                q, (token_id.tx_id, token_id.index)).fetchone()
+        if row is None or (row[5] and not include_deleted):
+            return None
+        return UnspentToken(id=ID(row[0], row[1]), owner=row[2], type=row[3],
+                            quantity=row[4])
+
+    def get_ledger_token(self, token_id: ID) -> tuple[bytes, bytes] | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT ledger_token, ledger_metadata FROM tokens WHERE "
+                "tx_id=? AND idx=? AND is_deleted=0",
+                (token_id.tx_id, token_id.index)).fetchone()
+        return (row[0], row[1]) if row else None
+
+    def whose(self, token_id: ID) -> list[str]:
+        with self._mu:
+            rows = self.conn.execute(
+                "SELECT wallet_id FROM ownership WHERE tx_id=? AND idx=?",
+                (token_id.tx_id, token_id.index)).fetchall()
+        return [r[0] for r in rows]
+
+
+@dataclass
+class TxRecord:
+    tx_id: str
+    action_type: str  # "issue" | "transfer" | "redeem"
+    sender: str
+    recipient: str
+    token_type: str
+    amount: int
+    status: str
+    timestamp: float
+    application_metadata: bytes = b""
+
+
+class TransactionDB(_Base):
+    """ttxdb: transaction records + endorsement acks (ttxdb/db.go:159-327)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS transactions (
+        tx_id TEXT NOT NULL,
+        action_type TEXT NOT NULL,
+        sender TEXT NOT NULL DEFAULT '',
+        recipient TEXT NOT NULL DEFAULT '',
+        token_type TEXT NOT NULL DEFAULT '',
+        amount INTEGER NOT NULL DEFAULT 0,
+        status TEXT NOT NULL,
+        status_message TEXT NOT NULL DEFAULT '',
+        timestamp REAL NOT NULL,
+        application_metadata BLOB NOT NULL DEFAULT x'',
+        seq INTEGER PRIMARY KEY AUTOINCREMENT
+    );
+    CREATE INDEX IF NOT EXISTS idx_tx_id ON transactions (tx_id);
+    CREATE TABLE IF NOT EXISTS token_requests (
+        tx_id TEXT PRIMARY KEY,
+        request BLOB NOT NULL,
+        status TEXT NOT NULL
+    );
+    CREATE TABLE IF NOT EXISTS endorsement_acks (
+        tx_id TEXT NOT NULL,
+        endorser BLOB NOT NULL,
+        sigma BLOB NOT NULL,
+        PRIMARY KEY (tx_id, endorser)
+    );
+    CREATE TABLE IF NOT EXISTS validation_records (
+        tx_id TEXT PRIMARY KEY,
+        token_request BLOB NOT NULL,
+        metadata BLOB NOT NULL DEFAULT x'',
+        timestamp REAL NOT NULL
+    );
+    """
+
+    def add_transaction(self, rec: TxRecord) -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT INTO transactions (tx_id, action_type, sender, "
+                "recipient, token_type, amount, status, timestamp, "
+                "application_metadata) VALUES (?,?,?,?,?,?,?,?,?)",
+                (rec.tx_id, rec.action_type, rec.sender, rec.recipient,
+                 rec.token_type, rec.amount, rec.status, rec.timestamp,
+                 rec.application_metadata))
+            self.conn.commit()
+
+    def add_token_request(self, tx_id: str, request: bytes,
+                          status: str = TxStatus.PENDING) -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO token_requests (tx_id, request, "
+                "status) VALUES (?,?,?)", (tx_id, request, status))
+            self.conn.commit()
+
+    def get_token_request(self, tx_id: str) -> bytes | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT request FROM token_requests WHERE tx_id=?",
+                (tx_id,)).fetchone()
+        return row[0] if row else None
+
+    def set_status(self, tx_id: str, status: str, message: str = "") -> None:
+        with self._mu:
+            self.conn.execute(
+                "UPDATE transactions SET status=?, status_message=? "
+                "WHERE tx_id=?", (status, message, tx_id))
+            self.conn.execute(
+                "UPDATE token_requests SET status=? WHERE tx_id=?",
+                (status, tx_id))
+            self.conn.commit()
+
+    def get_status(self, tx_id: str) -> str:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT status FROM transactions WHERE tx_id=? "
+                "ORDER BY seq DESC LIMIT 1", (tx_id,)).fetchone()
+            if row is None:
+                row = self.conn.execute(
+                    "SELECT status FROM token_requests WHERE tx_id=?",
+                    (tx_id,)).fetchone()
+        return row[0] if row else TxStatus.UNKNOWN
+
+    def query_transactions(self, tx_id: str | None = None,
+                           statuses: list[str] | None = None) -> list[TxRecord]:
+        q = ("SELECT tx_id, action_type, sender, recipient, token_type, "
+             "amount, status, timestamp, application_metadata "
+             "FROM transactions")
+        clauses, params = [], []
+        if tx_id is not None:
+            clauses.append("tx_id = ?")
+            params.append(tx_id)
+        if statuses:
+            clauses.append(
+                "status IN (" + ",".join("?" * len(statuses)) + ")")
+            params.extend(statuses)
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        q += " ORDER BY seq"
+        with self._mu:
+            rows = self.conn.execute(q, params).fetchall()
+        return [TxRecord(*r) for r in rows]
+
+    def add_endorsement_ack(self, tx_id: str, endorser: bytes,
+                            sigma: bytes) -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO endorsement_acks VALUES (?,?,?)",
+                (tx_id, endorser, sigma))
+            self.conn.commit()
+
+    def get_endorsement_acks(self, tx_id: str) -> dict[bytes, bytes]:
+        with self._mu:
+            rows = self.conn.execute(
+                "SELECT endorser, sigma FROM endorsement_acks WHERE tx_id=?",
+                (tx_id,)).fetchall()
+        return {r[0]: r[1] for r in rows}
+
+    def add_validation_record(self, tx_id: str, token_request: bytes,
+                              metadata: bytes = b"") -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO validation_records VALUES (?,?,?,?)",
+                (tx_id, token_request, metadata, time.time()))
+            self.conn.commit()
+
+
+class AuditDB(TransactionDB):
+    """auditdb: audit records + enrollment-ID locks (auditdb/db.go)."""
+
+    SCHEMA = TransactionDB.SCHEMA + """
+    CREATE TABLE IF NOT EXISTS eid_locks (
+        eid TEXT NOT NULL,
+        tx_id TEXT NOT NULL,
+        created_at REAL NOT NULL,
+        PRIMARY KEY (eid, tx_id)
+    );
+    """
+
+    def acquire_locks(self, tx_id: str, eids: list[str]) -> None:
+        with self._mu:
+            for eid in eids:
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO eid_locks VALUES (?,?,?)",
+                    (eid, tx_id, time.time()))
+            self.conn.commit()
+
+    def release_locks(self, tx_id: str) -> None:
+        with self._mu:
+            self.conn.execute("DELETE FROM eid_locks WHERE tx_id=?", (tx_id,))
+            self.conn.commit()
+
+    def locked_eids(self) -> list[str]:
+        with self._mu:
+            rows = self.conn.execute(
+                "SELECT DISTINCT eid FROM eid_locks").fetchall()
+        return [r[0] for r in rows]
+
+    # payments/holdings filters (auditdb/db.go payments/holdings)
+    def payments(self, eid_field: str, token_type: str | None = None
+                 ) -> list[TxRecord]:
+        recs = self.query_transactions()
+        out = [r for r in recs
+               if (r.sender == eid_field or r.recipient == eid_field)
+               and (token_type is None or r.token_type == token_type)]
+        return out
+
+
+class TokenLockDB(_Base):
+    """tokenlockdb: selector lease store (db/sql/common tokenlockdb)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS token_locks (
+        tx_id TEXT NOT NULL,
+        idx INTEGER NOT NULL,
+        consumer_tx_id TEXT NOT NULL,
+        created_at REAL NOT NULL,
+        PRIMARY KEY (tx_id, idx)
+    );
+    """
+
+    def lock(self, token_id: ID, consumer_tx_id: str) -> bool:
+        """Returns True if the lock was acquired."""
+        with self._mu:
+            try:
+                self.conn.execute(
+                    "INSERT INTO token_locks VALUES (?,?,?,?)",
+                    (token_id.tx_id, token_id.index, consumer_tx_id,
+                     time.time()))
+                self.conn.commit()
+                return True
+            except sqlite3.IntegrityError:
+                return False
+
+    def unlock_by_consumer(self, consumer_tx_id: str) -> None:
+        with self._mu:
+            self.conn.execute(
+                "DELETE FROM token_locks WHERE consumer_tx_id=?",
+                (consumer_tx_id,))
+            self.conn.commit()
+
+    def holder(self, token_id: ID) -> str | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT consumer_tx_id FROM token_locks WHERE tx_id=? AND "
+                "idx=?", (token_id.tx_id, token_id.index)).fetchone()
+        return row[0] if row else None
+
+    def evict_expired(self, lease_seconds: float) -> int:
+        cutoff = time.time() - lease_seconds
+        with self._mu:
+            cur = self.conn.execute(
+                "DELETE FROM token_locks WHERE created_at < ?", (cutoff,))
+            self.conn.commit()
+            return cur.rowcount
+
+
+class IdentityDB(_Base):
+    """identitydb: wallet/identity persistence (identitydb, SURVEY §2.4)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS wallets (
+        wallet_id TEXT NOT NULL,
+        role TEXT NOT NULL,
+        identity BLOB NOT NULL,
+        enrollment_id TEXT NOT NULL DEFAULT '',
+        created_at REAL NOT NULL,
+        PRIMARY KEY (wallet_id, role)
+    );
+    CREATE TABLE IF NOT EXISTS audit_infos (
+        identity BLOB PRIMARY KEY,
+        audit_info BLOB NOT NULL
+    );
+    CREATE TABLE IF NOT EXISTS signer_infos (
+        identity BLOB PRIMARY KEY,
+        signer_info BLOB NOT NULL
+    );
+    """
+
+    def register_wallet(self, wallet_id: str, role: str, identity: bytes,
+                        enrollment_id: str = "") -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO wallets VALUES (?,?,?,?,?)",
+                (wallet_id, role, identity, enrollment_id, time.time()))
+            self.conn.commit()
+
+    def wallet_identity(self, wallet_id: str, role: str) -> bytes | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT identity FROM wallets WHERE wallet_id=? AND role=?",
+                (wallet_id, role)).fetchone()
+        return row[0] if row else None
+
+    def wallets(self, role: str | None = None) -> list[tuple[str, str, bytes]]:
+        q = "SELECT wallet_id, role, identity FROM wallets"
+        params = []
+        if role is not None:
+            q += " WHERE role=?"
+            params.append(role)
+        with self._mu:
+            return self.conn.execute(q, params).fetchall()
+
+    def store_audit_info(self, identity: bytes, audit_info: bytes) -> None:
+        with self._mu:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO audit_infos VALUES (?,?)",
+                (identity, audit_info))
+            self.conn.commit()
+
+    def get_audit_info(self, identity: bytes) -> bytes | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT audit_info FROM audit_infos WHERE identity=?",
+                (identity,)).fetchone()
+        return row[0] if row else None

@@ -1,0 +1,10 @@
+"""Identity service: typed identities, role-based wallets, signers/verifiers.
+
+Mirrors reference token/services/identity (SURVEY.md §2.4): X.509-style
+signing identities (ECDSA P-256), typed-identity wrapping used by ownership
+scripts (HTLC, multisig), and the deserializer mux that routes identity bytes
+to the right verifier.
+"""
+
+from .typed import TypedIdentity, wrap_with_type, unmarshal_typed_identity  # noqa: F401
+from .x509 import X509KeyPair, X509Verifier, new_signing_identity  # noqa: F401

@@ -1,0 +1,60 @@
+"""Deserializer mux: identity bytes -> verifier, dispatched on identity type.
+
+Mirrors reference token/services/identity/deserializer.go (mux of typed
+verifier deserializers) plus the driver-side wrapping in
+token/core/zkatdlog/nogh/v1/driver/driver.go:69-169 (authorization mux of
+TMS + HTLC script + multisig escrow).
+
+Raw (untyped) identities resolve as X.509 public keys; typed identities
+dispatch on their type tag. HTLC script identities resolve recursively to
+the participant that must sign (sender before deadline has passed is
+handled by the htlc validator; here the script accepts either party's key
+at signature level — the validator enforces which one).
+"""
+
+from __future__ import annotations
+
+from ...driver.identity import Identity
+from . import typed as typed_mod
+from .x509 import X509Verifier
+
+X509_TYPE = "x509"
+
+
+class DeserializerError(Exception):
+    pass
+
+
+class Deserializer:
+    """driver.Deserializer: owner/issuer/auditor verifier resolution."""
+
+    def __init__(self, extra_owner_resolvers: list | None = None):
+        # resolvers: callables (typed_identity) -> Verifier | None
+        self.extra_owner_resolvers = list(extra_owner_resolvers or [])
+
+    # -- plain key identities -------------------------------------------------
+    def _raw_verifier(self, identity: Identity) -> X509Verifier:
+        return X509Verifier.from_identity(identity)
+
+    def get_issuer_verifier(self, identity: Identity):
+        return self._resolve(identity)
+
+    def get_auditor_verifier(self, identity: Identity):
+        return self._resolve(identity)
+
+    def get_owner_verifier(self, identity: Identity):
+        return self._resolve(identity)
+
+    def _resolve(self, identity: Identity):
+        try:
+            ti = typed_mod.unmarshal_typed_identity(bytes(identity))
+        except Exception:
+            return self._raw_verifier(identity)
+        if ti.type == X509_TYPE:
+            return self._raw_verifier(Identity(ti.identity))
+        for resolver in self.extra_owner_resolvers:
+            v = resolver(ti)
+            if v is not None:
+                return v
+        raise DeserializerError(
+            f"no verifier deserializer for identity type [{ti.type}]")

@@ -1,0 +1,51 @@
+"""Typed identities: (type, identity) pairs in Go-asn1-compatible DER.
+
+Behavioral mirror of reference token/services/identity/typed.go:22-49:
+TypedIdentity is ASN.1 SEQUENCE { PrintableString type, OCTET STRING
+identity }. Ownership scripts (HTLC, multisig) and role identities (x509,
+idemix) are dispatched on the type string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...crypto import serialization as ser
+from ...driver.identity import Identity
+
+
+def _der_printable_string(s: str) -> bytes:
+    body = s.encode("ascii")
+    return b"\x13" + ser._der_len(len(body)) + body
+
+
+@dataclass
+class TypedIdentity:
+    type: str
+    identity: bytes
+
+    def to_bytes(self) -> bytes:
+        return ser.der_sequence(
+            _der_printable_string(self.type),
+            ser.der_octet_string(self.identity),
+        )
+
+
+def wrap_with_type(id_type: str, identity: bytes) -> Identity:
+    """identity.WrapWithType (typed.go:42-49)."""
+    return Identity(TypedIdentity(id_type, identity).to_bytes())
+
+
+def unmarshal_typed_identity(raw: bytes) -> TypedIdentity:
+    """identity.UnmarshalTypedIdentity (typed.go:33-40)."""
+    seq = ser.DerReader(raw).read_sequence()
+    tag = seq.raw[seq.pos] if seq.pos < len(seq.raw) else None
+    if tag not in (0x13, 0x0C):  # PrintableString | UTF8String
+        raise ValueError("failed to unmarshal to TypedIdentity")
+    n = seq._read_header(tag)
+    body = seq.raw[seq.pos:seq.pos + n]
+    if len(body) != n:
+        raise ValueError("failed to unmarshal to TypedIdentity: truncated")
+    seq.pos += n
+    identity = seq.read_octet_string()
+    return TypedIdentity(body.decode("utf-8"), identity)

@@ -1,0 +1,68 @@
+"""X.509-style signing identities (ECDSA P-256 + SHA-256).
+
+Mirrors the role of reference token/services/identity/x509 (MSP identities):
+an identity is the DER SubjectPublicKeyInfo of an ECDSA P-256 key; signatures
+are DER-encoded ECDSA over SHA-256 — the same primitive Fabric MSP uses.
+Certificate-chain/MSP validation is intentionally out of scope for the
+in-process trust model (identities are registered, not CA-issued).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from ...driver.identity import Identity
+
+
+class SignatureError(Exception):
+    pass
+
+
+@dataclass
+class X509Verifier:
+    """driver.Verifier for an ECDSA P-256 public identity."""
+
+    public_key: ec.EllipticCurvePublicKey
+
+    @classmethod
+    def from_identity(cls, identity: bytes) -> "X509Verifier":
+        try:
+            key = serialization.load_der_public_key(bytes(identity))
+        except Exception as e:
+            raise SignatureError(f"failed to deserialize identity: {e}") from e
+        if not isinstance(key, ec.EllipticCurvePublicKey):
+            raise SignatureError("identity is not an EC public key")
+        return cls(key)
+
+    def verify(self, message: bytes, signature: bytes) -> None:
+        try:
+            self.public_key.verify(signature, message,
+                                   ec.ECDSA(hashes.SHA256()))
+        except InvalidSignature as e:
+            raise SignatureError("invalid signature") from e
+
+
+@dataclass
+class X509KeyPair:
+    """Signing identity: private key + serialized public identity."""
+
+    private_key: ec.EllipticCurvePrivateKey
+    identity: Identity
+
+    def sign(self, message: bytes) -> bytes:
+        return self.private_key.sign(message, ec.ECDSA(hashes.SHA256()))
+
+    def verifier(self) -> X509Verifier:
+        return X509Verifier(self.private_key.public_key())
+
+
+def new_signing_identity() -> X509KeyPair:
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return X509KeyPair(key, Identity(pub))

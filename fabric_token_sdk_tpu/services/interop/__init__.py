@@ -1,0 +1,1 @@
+"""Interoperability services (HTLC atomic swaps)."""

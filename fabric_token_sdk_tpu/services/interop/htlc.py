@@ -1,0 +1,298 @@
+"""HTLC (hash time-locked contract) scripts-as-owner.
+
+Behavioral mirror of reference token/services/interop/htlc (script.go,
+keys.go, signer.go) + token/services/identity/interop/htlc/validator.go:
+a token owned by an HTLC script can be claimed by the recipient before the
+deadline by revealing the hash pre-image (recorded in the action metadata
+under ClaimKey), or reclaimed by the sender after the deadline; lock actions
+must record LockKey. Driver validators call transfer_htlc_validate from
+their transfer chains (fabtoken validator_transfer.go:96-170, zkatdlog
+validator_transfer.go:112-175).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import time as time_mod
+from dataclasses import dataclass, field
+
+from ...driver.identity import Identity
+from ..identity import typed as typed_mod
+
+SCRIPT_TYPE = "htlc"  # reference htlc/transaction.go:27
+
+CLAIM_PREIMAGE = "htlc.cpi"  # reference htlc/keys.go:14
+LOCK_HASH = "htlc.lh"        # reference htlc/keys.go:15
+
+# OperationType (identity/interop/htlc/validator.go:19-25)
+OP_NONE, OP_CLAIM, OP_RECLAIM = 0, 1, 2
+
+# Supported hash functions (reference uses Go crypto.Hash; SHA-256 is the
+# default used by the interop suites).
+_HASH_FUNCS = {"SHA256": hashlib.sha256, "SHA512": hashlib.sha512}
+
+
+class HTLCError(Exception):
+    pass
+
+
+def claim_key(image: bytes) -> str:
+    return CLAIM_PREIMAGE + image.hex()
+
+
+def lock_key(hash_value: bytes) -> str:
+    return LOCK_HASH + hash_value.hex()
+
+
+def lock_value(hash_value: bytes) -> bytes:
+    return hash_value.hex().encode()
+
+
+@dataclass
+class HashInfo:
+    """reference script.go:24-62 (hex-encoded image by default)."""
+
+    hash: bytes
+    hash_func: str = "SHA256"
+    hash_encoding: str = "hex"
+
+    def validate(self) -> None:
+        if self.hash_func not in _HASH_FUNCS:
+            raise HTLCError("hash function not available")
+        if self.hash_encoding not in ("hex", "none"):
+            raise HTLCError("encoding function not available")
+
+    def image(self, preimage: bytes) -> bytes:
+        self.validate()
+        digest = _HASH_FUNCS[self.hash_func](preimage).digest()
+        if self.hash_encoding == "hex":
+            return digest.hex().encode()
+        return digest
+
+    def compare(self, image: bytes) -> None:
+        if image != self.hash:
+            raise HTLCError(
+                f"passed image does not match the hash")
+
+
+@dataclass
+class Script:
+    """reference script.go:64-95."""
+
+    sender: bytes
+    recipient: bytes
+    deadline: float  # unix seconds
+    hash_info: HashInfo
+
+    def validate(self, time_reference: float) -> None:
+        if len(self.sender) == 0:
+            raise HTLCError("sender not set")
+        if len(self.recipient) == 0:
+            raise HTLCError("recipient not set")
+        if self.deadline < time_reference:
+            raise HTLCError("expiration date has already passed")
+        self.hash_info.validate()
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "sender": base64.b64encode(self.sender).decode(),
+            "recipient": base64.b64encode(self.recipient).decode(),
+            "deadline": self.deadline,
+            "hash_info": {
+                "hash": base64.b64encode(self.hash_info.hash).decode(),
+                "hash_func": self.hash_info.hash_func,
+                "hash_encoding": self.hash_info.hash_encoding,
+            },
+        }).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Script":
+        d = json.loads(raw)
+        hi = d.get("hash_info") or {}
+        return cls(
+            sender=base64.b64decode(d.get("sender", "")),
+            recipient=base64.b64decode(d.get("recipient", "")),
+            deadline=d.get("deadline", 0),
+            hash_info=HashInfo(
+                hash=base64.b64decode(hi.get("hash", "")),
+                hash_func=hi.get("hash_func", "SHA256"),
+                hash_encoding=hi.get("hash_encoding", "hex"),
+            ),
+        )
+
+    def to_owner(self) -> Identity:
+        """Wrap as a typed identity usable as a token owner."""
+        return typed_mod.wrap_with_type(SCRIPT_TYPE, self.to_json())
+
+
+@dataclass
+class ClaimSignature:
+    """reference signer.go:19-22."""
+
+    recipient_signature: bytes
+    preimage: bytes
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "recipient_signature": base64.b64encode(
+                self.recipient_signature).decode(),
+            "preimage": base64.b64encode(self.preimage).decode(),
+        }).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ClaimSignature":
+        d = json.loads(raw)
+        return cls(
+            recipient_signature=base64.b64decode(
+                d.get("recipient_signature", "")),
+            preimage=base64.b64decode(d.get("preimage", "")),
+        )
+
+
+class ScriptVerifier:
+    """driver.Verifier for script-owned tokens: dispatches to sender or
+    recipient key based on claim-signature framing (htlc/signer.go
+    ClaimVerifier semantics)."""
+
+    def __init__(self, script: Script, resolve_verifier):
+        self.script = script
+        self.resolve = resolve_verifier
+
+    def verify(self, message: bytes, signature: bytes) -> None:
+        try:
+            claim = ClaimSignature.from_json(signature)
+            if claim.preimage and claim.recipient_signature:
+                # claim path: recipient signs; image must match the lock
+                self.script.hash_info.compare(
+                    self.script.hash_info.image(claim.preimage))
+                verifier = self.resolve(Identity(self.script.recipient))
+                verifier.verify(message, claim.recipient_signature)
+                return
+        except (ValueError, KeyError):
+            pass
+        # reclaim path: sender signs plainly
+        verifier = self.resolve(Identity(self.script.sender))
+        verifier.verify(message, signature)
+
+
+def script_verifier_resolver(resolve_verifier):
+    """Extra-owner resolver pluggable into identity.Deserializer."""
+    def resolver(ti: typed_mod.TypedIdentity):
+        if ti.type != SCRIPT_TYPE:
+            return None
+        return ScriptVerifier(Script.from_json(ti.identity), resolve_verifier)
+    return resolver
+
+
+def verify_owner(sender_raw_owner: bytes, out_raw_owner: bytes,
+                 now: float) -> tuple[Script, int]:
+    """identity/interop/htlc/validator.go:31-59."""
+    sender = typed_mod.unmarshal_typed_identity(sender_raw_owner)
+    if sender.type != SCRIPT_TYPE:
+        raise HTLCError(
+            f"invalid identity type, expected [{SCRIPT_TYPE}], got "
+            f"[{sender.type}]")
+    script = Script.from_json(sender.identity)
+    if now < script.deadline:
+        if bytes(script.recipient) != bytes(out_raw_owner):
+            raise HTLCError("owner of output token does not correspond to "
+                            "recipient in htlc request")
+        return script, OP_CLAIM
+    if bytes(script.sender) != bytes(out_raw_owner):
+        raise HTLCError("owner of output token does not correspond to "
+                        "sender in htlc request")
+    return script, OP_RECLAIM
+
+
+def metadata_claim_key_check(action, script: Script, op: int,
+                             sig: bytes) -> str:
+    """identity/interop/htlc/validator.go:62-97."""
+    if op == OP_RECLAIM:
+        return ""
+    try:
+        claim = ClaimSignature.from_json(sig)
+    except Exception as e:
+        raise HTLCError(
+            f"failed unmarshalling claim signature: {e}") from e
+    if not claim.preimage or not claim.recipient_signature:
+        raise HTLCError(
+            "expected a valid claim preImage and recipient signature")
+    metadata = action.get_metadata() or {}
+    if not metadata:
+        raise HTLCError("cannot find htlc pre-image, no metadata")
+    image = script.hash_info.image(claim.preimage)
+    key = claim_key(image)
+    if key not in metadata:
+        raise HTLCError("cannot find htlc pre-image, missing metadata entry")
+    if metadata[key] != claim.preimage:
+        raise HTLCError(
+            "invalid action, cannot match htlc pre-image with metadata")
+    return key
+
+
+def metadata_lock_key_check(action, script: Script) -> str:
+    """identity/interop/htlc/validator.go:100-115."""
+    metadata = action.get_metadata() or {}
+    if not metadata:
+        raise HTLCError("cannot find htlc lock, no metadata")
+    key = lock_key(script.hash_info.hash)
+    if key not in metadata:
+        raise HTLCError("cannot find htlc lock, missing metadata entry")
+    if metadata[key] != lock_value(script.hash_info.hash):
+        raise HTLCError("invalid action, cannot match htlc lock with metadata")
+    return key
+
+
+def transfer_htlc_validate(ctx, now: float | None = None) -> None:
+    """Driver-chain step (fabtoken validator_transfer.go:96-170; zkatdlog's
+    variant differs only in how input owners/outputs are surfaced)."""
+    if now is None:
+        now = time_mod.time()
+    action = ctx.transfer_action
+
+    for i, tok in enumerate(ctx.input_tokens):
+        try:
+            owner = typed_mod.unmarshal_typed_identity(tok.get_owner())
+        except Exception:
+            continue  # not a typed identity: plain owner, nothing to check
+        if owner.type != SCRIPT_TYPE:
+            continue
+        outputs = action.get_outputs()
+        if len(outputs) != 1:
+            raise HTLCError("invalid transfer action: an htlc script only "
+                            "transfers the ownership of a token")
+        output = outputs[0]
+        if ctx.input_tokens[0].type != output.type:
+            raise HTLCError("invalid transfer action: type of input does "
+                            "not match type of output")
+        if ctx.input_tokens[0].quantity != output.quantity:
+            raise HTLCError("invalid transfer action: quantity of input "
+                            "does not match quantity of output")
+        if output.is_redeem():
+            raise HTLCError("invalid transfer action: the output "
+                            "corresponding to an htlc spending should not "
+                            "be a redeem")
+        script, op = verify_owner(tok.get_owner(), output.owner, now)
+        sigma = ctx.signatures[i]
+        key = metadata_claim_key_check(action, script, op, sigma)
+        if op != OP_RECLAIM:
+            ctx.count_metadata_key(key)
+
+    for output in action.get_outputs():
+        if output.is_redeem():
+            continue
+        try:
+            owner = typed_mod.unmarshal_typed_identity(output.owner)
+        except Exception:
+            continue
+        if owner.type != SCRIPT_TYPE:
+            continue
+        script = Script.from_json(owner.identity)
+        try:
+            script.validate(now)
+        except HTLCError as e:
+            raise HTLCError(f"htlc script invalid: {e}") from e
+        key = metadata_lock_key_check(action, script)
+        ctx.count_metadata_key(key)

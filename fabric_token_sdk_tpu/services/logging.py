@@ -1,0 +1,40 @@
+"""Logging service: named loggers per driver/TMS.
+
+Behavioral mirror of reference token/services/logging/logger.go:19-39 (zap
+named loggers under the "token-sdk" root) over the stdlib logging module.
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+
+ROOT = "token-sdk"
+
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    if _configured:
+        return
+    root = _logging.getLogger(ROOT)
+    if not root.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(_logging.Formatter(
+            "%(asctime)s %(levelname).4s %(name)s: %(message)s"))
+        root.addHandler(handler)
+        root.setLevel(_logging.INFO)
+        root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "") -> _logging.Logger:
+    """logging.MustGetLogger equivalent: namespaced under token-sdk."""
+    _ensure_configured()
+    full = f"{ROOT}.{name}" if name else ROOT
+    return _logging.getLogger(full)
+
+
+def driver_logger(driver: str, tms_id: str) -> _logging.Logger:
+    """Named logger per (driver, TMS) (logger.go:27-39)."""
+    return get_logger(f"{driver}.{tms_id}")

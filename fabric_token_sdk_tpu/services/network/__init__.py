@@ -1,0 +1,7 @@
+"""Network services: ledger abstraction, RW-set translation, validation entry.
+
+Mirrors reference token/services/network (SURVEY.md §2.4): the driver.Network
+surface, the rws/translator that converts verified actions into ledger
+key/value writes with MVCC double-spend semantics, and the token-chaincode
+(tcc) processing entry point.
+"""

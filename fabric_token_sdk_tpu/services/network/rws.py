@@ -1,0 +1,252 @@
+"""RW-set translation: verified actions -> ledger key/value writes.
+
+Behavioral mirror of reference token/services/network/common/rws/
+{translator,keys} (SURVEY.md §2.4 "rws/translator"): composite keys in the
+Fabric chaincode namespace style, output keys (txID, index), output serial
+numbers hashing the serialized token (existence check at spend time),
+token-request hash storage, setup-key dependency, and metadata keys.
+Double-spend protection is MVCC: spends read-then-delete the SN key, so two
+transactions spending the same token conflict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+from ...token.model import ID
+
+# keys.go constants
+TOKEN_KEY_PREFIX = "ztoken"
+TOKEN_REQUEST_KEY_PREFIX = "token_request"
+TOKEN_SETUP_KEY_PREFIX = "setup"
+TOKEN_SETUP_HASH_KEY_PREFIX = "setup.hash"
+OUTPUT_SN_KEY_PREFIX = "osn"
+INPUT_SN_PREFIX = "sn"
+ISSUE_METADATA_PREFIX = "iam"
+TRANSFER_METADATA_PREFIX = "tam"
+
+_MIN_UNICODE = "\x00"
+_COMPOSITE_NS = "\x00"
+
+NOT_EMPTY = b"\x01"
+
+
+class TranslatorError(Exception):
+    pass
+
+
+def composite_key(object_type: str, attributes: list[str]) -> str:
+    """Fabric shim createCompositeKey (keys.go:96-120)."""
+    ck = _COMPOSITE_NS + object_type + _MIN_UNICODE
+    for attr in attributes:
+        ck += attr + _MIN_UNICODE
+    return ck
+
+
+class KeyTranslator:
+    """keys.go:38-95."""
+
+    def token_request_key(self, tx_id: str) -> str:
+        return composite_key(TOKEN_REQUEST_KEY_PREFIX, [tx_id])
+
+    def setup_key(self) -> str:
+        return composite_key(TOKEN_SETUP_KEY_PREFIX, [])
+
+    def setup_hash_key(self) -> str:
+        return composite_key(TOKEN_SETUP_HASH_KEY_PREFIX, [])
+
+    def output_sn_key(self, tx_id: str, index: int, output: bytes) -> str:
+        h = hashlib.sha256()
+        h.update(OUTPUT_SN_KEY_PREFIX.encode())
+        h.update(tx_id.encode())
+        h.update(struct.pack("<Q", index))
+        h.update(output)
+        return composite_key(OUTPUT_SN_KEY_PREFIX, [h.hexdigest()])
+
+    def output_key(self, tx_id: str, index: int) -> str:
+        return composite_key(tx_id, [str(index)])
+
+    def input_sn_key(self, sn: str) -> str:
+        return composite_key(INPUT_SN_PREFIX, [sn])
+
+    def issue_metadata_key(self, key: str) -> str:
+        return composite_key(ISSUE_METADATA_PREFIX, [key])
+
+    def transfer_metadata_key(self, key: str) -> str:
+        return composite_key(TRANSFER_METADATA_PREFIX, [key])
+
+
+class MemoryRWSet:
+    """In-process read-write set over a backing store dict.
+
+    Mirrors the semantics the translator needs from Fabric's RWSet:
+    GetState / SetState / DeleteState / StateMustExist / StateMustNotExist,
+    with reads recorded against the backing snapshot (MVCC read set) and
+    writes staged until apply().
+    """
+
+    def __init__(self, backing: dict[str, bytes]):
+        self.backing = backing
+        self.writes: dict[str, bytes | None] = {}
+        self.reads: dict[str, bytes | None] = {}
+
+    def get_state(self, key: str) -> bytes | None:
+        if key in self.writes:
+            return self.writes[key]
+        val = self.backing.get(key)
+        self.reads[key] = val
+        return val
+
+    def set_state(self, key: str, value: bytes) -> None:
+        self.writes[key] = value
+
+    def delete_state(self, key: str) -> None:
+        self.writes[key] = None
+
+    def state_must_exist(self, key: str) -> None:
+        if not self.get_state(key):
+            raise TranslatorError(f"state [{key!r}] does not exist")
+
+    def state_must_not_exist(self, key: str) -> None:
+        if self.get_state(key):
+            raise TranslatorError(f"state [{key!r}] already exists")
+
+    def apply(self) -> None:
+        for k, v in self.writes.items():
+            if v is None:
+                self.backing.pop(k, None)
+            else:
+                self.backing[k] = v
+
+
+@dataclass
+class Translator:
+    """translator.go:44-489."""
+
+    tx_id: str
+    rws: MemoryRWSet
+    keys: KeyTranslator = field(default_factory=KeyTranslator)
+    counter: int = 0
+    spent_ids: list[str] = field(default_factory=list)
+
+    # ---- validation-side checks (translator.go:388-437)
+    def write(self, action) -> None:
+        self._check_action(action)
+        self._commit_action(action)
+
+    def _check_action(self, action) -> None:
+        serial_numbers = getattr(action, "get_serial_numbers", lambda: [])()
+        for sn in serial_numbers:
+            try:
+                self.rws.state_must_not_exist(self.keys.input_sn_key(sn))
+            except TranslatorError as e:
+                raise TranslatorError(
+                    f"invalid transfer: serial number must not exist: {e}"
+                ) from e
+        inputs = action.get_inputs()
+        serialized = (action.get_serialized_inputs()
+                      if hasattr(action, "get_serialized_inputs") else [])
+        if inputs:
+            if len(serialized) != len(inputs):
+                raise TranslatorError(
+                    "inputs and serialized inputs length mismatch")
+            for tid, raw in zip(inputs, serialized):
+                key = self.keys.output_sn_key(tid.tx_id, tid.index, raw)
+                try:
+                    self.rws.state_must_exist(key)
+                except TranslatorError as e:
+                    raise TranslatorError(
+                        f"invalid transfer: input must exist: {e}") from e
+
+    # ---- commit (translator.go:242-385)
+    def _commit_action(self, action) -> None:
+        base = self.counter
+        graph_hiding = getattr(action, "is_graph_hiding", lambda: False)()
+        outputs = action.get_serialized_outputs()
+        is_redeem_at = getattr(action, "is_redeem_at", lambda i: False)
+        for i, output in enumerate(outputs):
+            if is_redeem_at(i):
+                continue
+            self.rws.set_state(self.keys.output_key(self.tx_id, base + i),
+                               output)
+            if not graph_hiding:
+                sn = self.keys.output_sn_key(self.tx_id, base + i, output)
+                self.rws.set_state(sn, NOT_EMPTY)
+        self._spend_inputs(action)
+        metadata = action.get_metadata() or {}
+        for key, value in metadata.items():
+            k = (self.keys.transfer_metadata_key(key)
+                 if hasattr(action, "is_redeem_at")
+                 else self.keys.issue_metadata_key(key))
+            try:
+                self.rws.state_must_not_exist(k)
+            except TranslatorError:
+                raise TranslatorError(
+                    f"entry with metadata key [{key}] is already occupied")
+            self.rws.set_state(k, value)
+        self.counter += len(outputs)
+
+    def _spend_inputs(self, action) -> None:
+        inputs = action.get_inputs()
+        if inputs:
+            serialized = action.get_serialized_inputs()
+            for tid, raw in zip(inputs, serialized):
+                sn_key = self.keys.output_sn_key(tid.tx_id, tid.index, raw)
+                self.rws.delete_state(sn_key)
+                out_key = self.keys.output_key(tid.tx_id, tid.index)
+                self.rws.delete_state(out_key)
+                self.spent_ids.append(out_key)
+        for sn in getattr(action, "get_serial_numbers", lambda: [])():
+            self.rws.set_state(self.keys.input_sn_key(sn), NOT_EMPTY)
+            self.spent_ids.append(sn)
+
+    # ---- request bookkeeping (translator.go:62-102)
+    def commit_token_request(self, raw: bytes, store_hash: bool = True) -> bytes:
+        key = self.keys.token_request_key(self.tx_id)
+        self.rws.state_must_not_exist(key)
+        stored = hashlib.sha256(raw).digest() if store_hash else raw
+        self.rws.set_state(key, stored)
+        return stored if store_hash else b""
+
+    def read_token_request(self) -> bytes | None:
+        return self.rws.get_state(self.keys.token_request_key(self.tx_id))
+
+    # ---- setup (translator.go:254-289)
+    def commit_setup(self, pp_raw: bytes) -> None:
+        self.rws.set_state(self.keys.setup_key(), pp_raw)
+        self.rws.set_state(self.keys.setup_hash_key(),
+                           hashlib.sha256(pp_raw).digest())
+
+    def read_setup_parameters(self) -> bytes | None:
+        return self.rws.get_state(self.keys.setup_key())
+
+    def add_public_params_dependency(self) -> None:
+        self.rws.state_must_exist(self.keys.setup_hash_key())
+
+    # ---- queries (translator.go:126-186)
+    def query_tokens(self, ids: list[ID]) -> list[bytes]:
+        res = []
+        errs = []
+        for tid in ids:
+            raw = self.rws.get_state(self.keys.output_key(tid.tx_id, tid.index))
+            if not raw:
+                errs.append(f"output for key [{tid}] does not exist")
+                continue
+            res.append(raw)
+        if errs:
+            raise TranslatorError(
+                f"failed querying tokens with errs [{len(errs)}][{errs}]")
+        return res
+
+    def are_tokens_spent(self, ids: list[str], graph_hiding: bool) -> list[bool]:
+        out = []
+        for key in ids:
+            if graph_hiding:
+                v = self.rws.get_state(self.keys.input_sn_key(key))
+                out.append(bool(v))
+            else:
+                v = self.rws.get_state(key)
+                out.append(not v)
+        return out

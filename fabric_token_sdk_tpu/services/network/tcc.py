@@ -1,0 +1,148 @@
+"""Token chaincode: the on-ledger validation + commit entry point.
+
+Behavioral mirror of reference token/services/network/fabric/tcc/tcc.go:
+ProcessRequest reads the token request, runs the driver Validator, feeds the
+verified actions through the Translator into the RW set, and stores the
+request hash. Queries: public params, tokens, spent-status
+(tcc.go:90-255,126-143).
+
+`MemoryLedger` is the standalone backend (the "fake-ledger multi-process
+harness on one TPU host" of SURVEY.md §4 last row); commit applies the
+RW set atomically with MVCC conflict detection against the read set.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ...token.model import ID
+from .rws import KeyTranslator, MemoryRWSet, Translator, TranslatorError
+
+
+class LedgerError(Exception):
+    pass
+
+
+class MVCCConflict(LedgerError):
+    pass
+
+
+@dataclass
+class CommitEvent:
+    tx_id: str
+    status: str  # "VALID" | "INVALID"
+    message: str = ""
+
+
+class MemoryLedger:
+    """Single-host ordered ledger with MVCC commit and finality events."""
+
+    def __init__(self):
+        self.state: dict[str, bytes] = {}
+        self.blocks: list[CommitEvent] = []
+        self.listeners: list = []
+        self.lock = threading.RLock()
+        self.keys = KeyTranslator()
+
+    def new_rwset(self) -> MemoryRWSet:
+        return MemoryRWSet(self.state)
+
+    def commit(self, tx_id: str, rws: MemoryRWSet) -> CommitEvent:
+        """Atomically validate the read set and apply writes (total order)."""
+        with self.lock:
+            for key, seen in rws.reads.items():
+                if self.state.get(key) != seen:
+                    ev = CommitEvent(tx_id, "INVALID",
+                                     f"MVCC conflict on [{key!r}]")
+                    self._emit(ev)
+                    return ev
+            rws.apply()
+            ev = CommitEvent(tx_id, "VALID")
+            self._emit(ev)
+            return ev
+
+    def _emit(self, ev: CommitEvent) -> None:
+        self.blocks.append(ev)
+        for listener in list(self.listeners):
+            listener(ev)
+
+    def add_finality_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def remove_finality_listener(self, listener) -> None:
+        if listener in self.listeners:
+            self.listeners.remove(listener)
+
+    # -- convenience direct reads (committed state)
+    def get_state(self, key: str) -> bytes | None:
+        with self.lock:
+            return self.state.get(key)
+
+
+class TokenChaincode:
+    """tcc.go:59-255 equivalent bound to one validator + ledger."""
+
+    def __init__(self, validator, ledger: MemoryLedger, pp_raw: bytes):
+        self.validator = validator
+        self.ledger = ledger
+        self.keys = KeyTranslator()
+        # init: store public parameters (tcc.go Init path)
+        rws = ledger.new_rwset()
+        tr = Translator(tx_id="genesis", rws=rws)
+        tr.commit_setup(pp_raw)
+        ledger.commit("genesis", rws)
+
+    # ---- invoke("invoke") -------------------------------------------------
+    def process_request(self, tx_id: str, request_raw: bytes) -> CommitEvent:
+        """Validate + translate + commit one token request (tcc.go:220-255)."""
+        rws = self.ledger.new_rwset()
+        translator = Translator(tx_id=tx_id, rws=rws)
+
+        def get_state(token_id: ID) -> bytes | None:
+            return rws.get_state(self.keys.output_key(token_id.tx_id,
+                                                      token_id.index))
+
+        try:
+            actions, _attrs = self.validator.verify_token_request_from_raw(
+                get_state, tx_id, request_raw)
+        except Exception as e:
+            ev = CommitEvent(tx_id, "INVALID", f"validation failed: {e}")
+            self.ledger._emit(ev)
+            return ev
+        try:
+            translator.add_public_params_dependency()
+            for action in actions:
+                translator.write(action)
+            translator.commit_token_request(request_raw)
+        except TranslatorError as e:
+            ev = CommitEvent(tx_id, "INVALID", f"translation failed: {e}")
+            self.ledger._emit(ev)
+            return ev
+        return self.ledger.commit(tx_id, rws)
+
+    # ---- queries (tcc.go:126-143) ----------------------------------------
+    def query_public_params(self) -> bytes | None:
+        return self.ledger.get_state(self.keys.setup_key())
+
+    def query_tokens(self, ids: list[ID]) -> list[bytes]:
+        out = []
+        missing = []
+        for tid in ids:
+            raw = self.ledger.get_state(self.keys.output_key(tid.tx_id,
+                                                             tid.index))
+            if raw is None:
+                missing.append(str(tid))
+            else:
+                out.append(raw)
+        if missing:
+            raise LedgerError(f"tokens not found: {missing}")
+        return out
+
+    def are_tokens_spent(self, ids: list[ID]) -> list[bool]:
+        out = []
+        for tid in ids:
+            raw = self.ledger.get_state(self.keys.output_key(tid.tx_id,
+                                                             tid.index))
+            out.append(raw is None)
+        return out

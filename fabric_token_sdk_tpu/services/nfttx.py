@@ -1,0 +1,97 @@
+"""nfttx: non-fungible tokens over the fungible API.
+
+Behavioral mirror of reference token/services/nfttx (SURVEY.md §2.4): an NFT
+is a quantity-1 token whose Type carries the marshalled JSON state with a
+unique ID; queries filter unspent tokens by JSON key/value (qe.go:52), and
+transfers move the whole state to a new owner.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import uuid
+
+from ..token.model import UnspentToken
+
+
+class NFTError(Exception):
+    pass
+
+
+class NoResults(NFTError):
+    """qe.go:20 ErrNoResults."""
+
+
+UNIQUE_ID_KEY = "_ID"
+
+
+def marshal_state(state: dict) -> str:
+    """nfttx/marshaller: stamp a unique ID and encode state as the token
+    type (base64 keeps the Type a clean string)."""
+    if UNIQUE_ID_KEY not in state or not state[UNIQUE_ID_KEY]:
+        state = dict(state)
+        state[UNIQUE_ID_KEY] = uuid.uuid4().hex
+    raw = json.dumps(state, sort_keys=True)
+    return base64.urlsafe_b64encode(raw.encode()).decode("ascii")
+
+
+def unmarshal_state(token_type: str) -> dict:
+    try:
+        return json.loads(base64.urlsafe_b64decode(token_type.encode()))
+    except Exception as e:
+        raise NFTError(f"failed unmarshalling NFT state: {e}") from e
+
+
+def state_id(state: dict) -> str:
+    sid = state.get(UNIQUE_ID_KEY)
+    if not sid:
+        raise NFTError("state has no unique ID")
+    return sid
+
+
+class NFTService:
+    """NFT views over a TokenNode (nfttx/transaction.go:80-116)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def issue(self, issuer_node: str, to_node: str, state: dict):
+        """Issue a fresh NFT carrying `state` to `to_node`."""
+        token_type = marshal_state(state)
+        tx = self.node.issue(issuer_node, to_node, token_type, hex(1))
+        ev = self.node.execute(tx)
+        if ev.status != "VALID":
+            raise NFTError(f"NFT issue failed: {ev.message}")
+        return unmarshal_state(token_type)
+
+    def transfer(self, state_or_id, to_node: str):
+        """Transfer the NFT matching the state/id to a new owner."""
+        sid = (state_or_id if isinstance(state_or_id, str)
+               else state_id(state_or_id))
+        tok = self._find(sid)
+        tx = self.node.transfer(tok.type, hex(1), to_node)
+        ev = self.node.execute(tx)
+        if ev.status != "VALID":
+            raise NFTError(f"NFT transfer failed: {ev.message}")
+
+    def query_by_key(self, key: str, value) -> dict:
+        """qe.go:52-78: first unspent NFT whose state[key] == value."""
+        for tok in self.node.tokendb.unspent_tokens(self.node.name):
+            try:
+                state = unmarshal_state(tok.type)
+            except NFTError:
+                continue
+            if state.get(key) == value:
+                return state
+        raise NoResults("no results found")
+
+    def _find(self, sid: str) -> UnspentToken:
+        for tok in self.node.tokendb.unspent_tokens(self.node.name):
+            try:
+                state = unmarshal_state(tok.type)
+            except NFTError:
+                continue
+            if state.get(UNIQUE_ID_KEY) == sid:
+                return tok
+        raise NoResults("no results found")

@@ -1,0 +1,194 @@
+"""TokenNode: one participant's full runtime.
+
+The standalone equivalent of an FSC node with the token SDK installed
+(reference token/sdk/dig/sdk.go:84 wires the same pieces): signing identity,
+wallets, token store, transaction store, selector, tokens-ingestion service,
+and views for the ttx choreography (sign/audit/issue/transfer/redeem).
+Nodes share a MemoryLedger + TokenChaincode (the ledger consensus plane) and
+a SessionBus (the view/session plane).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from ..driver import TokenRequest
+from ..token import quantity as q
+from ..token.model import ID
+from .db.sqldb import (AuditDB, TokenDB, TokenLockDB, TransactionDB,
+                       TxRecord, TxStatus)
+from .selector import SherdLockSelector
+from .tokens import Tokens
+from .ttx import SessionBus, Transaction, TtxError, collect_endorsements, \
+    ordering_and_finality
+
+
+class TokenNode:
+    """One party: wallet + stores + ttx views over the shared backends."""
+
+    def __init__(self, name: str, keys, bus: SessionBus, chaincode,
+                 precision: int = 64, auditor_name: str | None = None,
+                 action_module=None):
+        from ..core.fabtoken import actions as fabtoken_actions
+
+        self.name = name
+        self.keys = keys
+        self.bus = bus
+        self.cc = chaincode
+        self.precision = precision
+        self.auditor_name = auditor_name
+        self.actions = action_module or fabtoken_actions
+
+        self.tokendb = TokenDB(":memory:")
+        self.ttxdb = TransactionDB(":memory:")
+        self.lockdb = TokenLockDB(":memory:")
+        self.selector = SherdLockSelector(self.tokendb, self.lockdb,
+                                          precision=precision)
+        self.tokens = Tokens(self.tokendb, self._ownership)
+        bus.register(name, self)
+        chaincode.ledger.add_finality_listener(self._on_commit)
+        # txs this node assembled or endorsed: refresh ttxdb on finality
+        self._watched: dict[str, TokenRequest] = {}
+
+    # ------------------------------------------------------------------ util
+    def _ownership(self, owner_raw: bytes) -> list[str]:
+        return [self.name] if owner_raw == bytes(self.keys.identity) else []
+
+    def identity(self) -> bytes:
+        return bytes(self.keys.identity)
+
+    def balance(self, token_type: str) -> int:
+        return self.tokendb.balance(self.name, token_type)
+
+    # ------------------------------------------------- responder views (ttx)
+    def sign_transfer(self, tx_id: str, message: bytes) -> bytes:
+        """Owner-side endorsement view (ttx/endorse.go:719-726)."""
+        sigma = self.keys.sign(message)
+        self.ttxdb.add_endorsement_ack(tx_id, self.identity(), sigma)
+        return sigma
+
+    def sign_issue(self, tx_id: str, message: bytes) -> bytes:
+        return self.keys.sign(message)
+
+    def audit(self, tx: Transaction) -> bytes:
+        """Auditor-side view (ttx/auditor.go:265; auditor service semantics
+        live in services/auditor.py — plain signing here for non-auditor
+        nodes is an error)."""
+        raise TtxError(f"node [{self.name}] is not an auditor")
+
+    # ------------------------------------------------- initiator views (ttx)
+    def issue(self, issuer_node: str, to_node: str, token_type: str,
+              amount_hex: str) -> Transaction:
+        """Withdrawal flow: ask the issuer node to issue to `to_node`."""
+        issuer = self.bus.node(issuer_node)
+        recipient = self.bus.node(to_node)
+        action = self.actions.IssueAction(
+            issuer=issuer.keys.identity,
+            outputs=[self.actions.Output(
+                owner=recipient.identity(), type=token_type,
+                quantity=amount_hex)],
+        )
+        tx = Transaction(tx_id=Transaction.new_anchor(),
+                         request=TokenRequest(issues=[action.serialize()]),
+                         issuer_node=issuer_node)
+        tx.records.append(TxRecord(
+            tx_id=tx.tx_id, action_type="issue", sender="",
+            recipient=to_node, token_type=token_type,
+            amount=int(amount_hex, 16), status=TxStatus.PENDING,
+            timestamp=time.time()))
+        return tx
+
+    def transfer(self, token_type: str, amount_hex: str, to_node: str,
+                 redeem: bool = False) -> Transaction:
+        """Assemble a transfer spending this node's tokens
+        (token/request.go:287 prepareTransfer + driver Transfer)."""
+        tx_id = Transaction.new_anchor()
+        selection = self.selector.select(self.name, token_type, amount_hex,
+                                         tx_id)
+        target = q.to_quantity(amount_hex, self.precision).value
+        change = selection.sum - target
+        recipient_owner = b"" if redeem else \
+            self.bus.node(to_node).identity()
+        outputs = [self.actions.Output(owner=recipient_owner,
+                                       type=token_type,
+                                       quantity=hex(target))]
+        if change > 0:
+            outputs.append(self.actions.Output(
+                owner=self.identity(), type=token_type,
+                quantity=hex(change)))
+        input_tokens = []
+        for tok in selection.tokens:
+            input_tokens.append(self.actions.Output(
+                owner=bytes(tok.owner), type=tok.type,
+                quantity=tok.quantity))
+        action = self.actions.TransferAction(
+            inputs=[t.id for t in selection.tokens],
+            input_tokens=input_tokens,
+            outputs=outputs,
+        )
+        tx = Transaction(
+            tx_id=tx_id,
+            request=TokenRequest(transfers=[action.serialize()]),
+            input_owners=[self.name] * len(selection.tokens),
+        )
+        tx.records.append(TxRecord(
+            tx_id=tx_id, action_type="redeem" if redeem else "transfer",
+            sender=self.name, recipient="" if redeem else to_node,
+            token_type=token_type, amount=target, status=TxStatus.PENDING,
+            timestamp=time.time()))
+        return tx
+
+    def execute(self, tx: Transaction):
+        """collect endorsements -> order -> wait finality (SURVEY §3.1)."""
+        collect_endorsements(tx, self.bus, self.auditor_name)
+        self._watched[tx.tx_id] = tx.request
+        self.ttxdb.add_token_request(tx.tx_id, tx.request.to_bytes())
+        for rec in tx.records:
+            self.ttxdb.add_transaction(rec)
+        ev = ordering_and_finality(tx, self.cc)
+        if ev.status != "VALID":
+            self.selector.unselect(tx.tx_id)
+        return ev
+
+    # ------------------------------------------------- finality (vault sync)
+    def _on_commit(self, ev) -> None:
+        """network/common/finality.go:57-121 + tokens.Append (SURVEY §3.5).
+
+        Every node observes every commit; it ingests outputs owned by it.
+        """
+        if ev.status != "VALID":
+            self.ttxdb.set_status(ev.tx_id, TxStatus.DELETED, ev.message)
+            return
+        raw = self.cc.ledger.get_state(
+            self.cc.keys.token_request_key(ev.tx_id))
+        if raw is None:
+            return  # genesis/setup
+        request_raw = self._watched.get(ev.tx_id)
+        if request_raw is None:
+            # fetch from a peer that assembled it (finality.go:65-121 fetch
+            # escalation); standalone: read tokens directly from the ledger
+            self._ingest_from_ledger(ev.tx_id)
+        else:
+            actions = self.cc.validator.unmarshal_actions(
+                request_raw.to_bytes())
+            self.tokens.append_transaction(ev.tx_id, actions)
+        self.ttxdb.set_status(ev.tx_id, TxStatus.CONFIRMED)
+
+    def _ingest_from_ledger(self, tx_id: str) -> None:
+        """Scan ledger outputs of tx_id (processor.go:40 RW-set indexing)."""
+        idx = 0
+        while True:
+            raw = self.cc.ledger.get_state(self.cc.keys.output_key(tx_id, idx))
+            if raw is None:
+                break
+            out = self.actions.Output.deserialize(raw)
+            owners = self._ownership(out.owner)
+            self.tokendb.store_token(ID(tx_id, idx), out.owner, out.type,
+                                     out.quantity, owners)
+            idx += 1
+        # mark spent inputs: any of my unspent tokens no longer on ledger
+        for tok in self.tokendb.unspent_tokens(self.name):
+            key = self.cc.keys.output_key(tok.id.tx_id, tok.id.index)
+            if self.cc.ledger.get_state(key) is None:
+                self.tokendb.delete_token(tok.id, spent_by=tx_id)

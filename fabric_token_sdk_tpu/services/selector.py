@@ -1,0 +1,83 @@
+"""Token selectors: the double-spend guard at tx-assembly time.
+
+Behavioral mirror of reference token/services/selector (SURVEY.md §2.4):
+
+- SimpleSelector ~ selector/simple: in-process mutex + lock table.
+- SherdLockSelector ~ selector/sherdlock: DB-lease-based distributed lock
+  that is safe across replicas sharing one lock DB; leases expire so stuck
+  locks recover (docs/core-token.md:25-31). Eager fetcher with retry/backoff
+  (sherdlock/selector.go:92-157).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from ..token import quantity as q
+from ..token.model import ID, UnspentToken
+from .db.sqldb import TokenDB, TokenLockDB
+
+
+class SelectorError(Exception):
+    pass
+
+
+class InsufficientFunds(SelectorError):
+    pass
+
+
+@dataclass
+class Selection:
+    tokens: list[UnspentToken]
+    sum: int
+
+
+class SherdLockSelector:
+    """Lease-based selector over (tokendb, tokenlockdb)."""
+
+    def __init__(self, tokendb: TokenDB, lockdb: TokenLockDB,
+                 precision: int = 64, lease_seconds: float = 180.0,
+                 retries: int = 3, backoff: float = 0.05):
+        self.tokendb = tokendb
+        self.lockdb = lockdb
+        self.precision = precision
+        self.lease_seconds = lease_seconds
+        self.retries = retries
+        self.backoff = backoff
+
+    def select(self, wallet_id: str, token_type: str, amount_hex: str,
+               consumer_tx_id: str) -> Selection:
+        """Lock enough tokens to cover `amount`; all-or-nothing."""
+        target = q.to_quantity(amount_hex, self.precision).value
+        for attempt in range(self.retries):
+            picked: list[UnspentToken] = []
+            total = 0
+            for tok in self.tokendb.unspent_tokens(wallet_id, token_type):
+                if total >= target:
+                    break
+                if self.lockdb.lock(tok.id, consumer_tx_id):
+                    picked.append(tok)
+                    total += int(tok.quantity, 16)
+            if total >= target:
+                return Selection(tokens=picked, sum=total)
+            # not enough: release and retry after lease eviction/backoff
+            self.lockdb.unlock_by_consumer(consumer_tx_id)
+            self.lockdb.evict_expired(self.lease_seconds)
+            if attempt < self.retries - 1:
+                time.sleep(self.backoff * (2 ** attempt))
+        raise InsufficientFunds(
+            f"insufficient funds, only [{total}] tokens of type [{token_type}] "
+            f"are available, but [{target}] were requested and "
+            f"[{len(picked)}] were locked")
+
+    def unselect(self, consumer_tx_id: str) -> None:
+        self.lockdb.unlock_by_consumer(consumer_tx_id)
+
+
+class SimpleSelector(SherdLockSelector):
+    """selector/simple equivalent: same behavior over an in-memory lock DB."""
+
+    def __init__(self, tokendb: TokenDB, precision: int = 64):
+        super().__init__(tokendb, TokenLockDB(":memory:"),
+                         precision=precision)

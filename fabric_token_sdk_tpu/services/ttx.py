@@ -1,0 +1,114 @@
+"""ttx: token transaction lifecycle choreography.
+
+Behavioral mirror of reference token/services/ttx (SURVEY.md §2.4, §3.1):
+Transaction{tx_id, anchor, TokenRequest}; collect-endorsements (owner
+signatures -> auditor audit+endorse -> approval -> distribution); ordering
+broadcast; finality wait. The FSC view/session plane collapses to an
+in-process SessionBus between named nodes — the same paired
+initiator/responder steps, minus the websocket transport (SURVEY.md §2.5:
+the session plane is control-plane and stays on CPU).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+
+from ..driver import TokenRequest
+from ..token.model import ID
+from .db.sqldb import TxRecord, TxStatus
+from .network.tcc import CommitEvent
+
+
+class TtxError(Exception):
+    pass
+
+
+@dataclass
+class Transaction:
+    """ttx/transaction.go:24-46: payload of one token transaction."""
+
+    tx_id: str
+    request: TokenRequest
+    # client-side bookkeeping: which signer nodes own each transfer input,
+    # populated at assembly time (mirror of TokenRequest metadata)
+    input_owners: list[str] = field(default_factory=list)
+    issuer_node: str | None = None
+    # record stream for ttxdb
+    records: list[TxRecord] = field(default_factory=list)
+
+    @staticmethod
+    def new_anchor() -> str:
+        return uuid.uuid4().hex
+
+    def message_to_sign(self) -> bytes:
+        return self.request.message_to_sign(self.tx_id.encode())
+
+
+class SessionBus:
+    """In-process replacement for FSC sessions: named nodes, direct calls.
+
+    Every multi-party step in the reference runs as paired views over
+    sessions (ttx/endorse.go:190-296); here a session is a method dispatch
+    to the responder node object, preserving the request/response shape.
+    """
+
+    def __init__(self):
+        self.nodes: dict[str, object] = {}
+        self.lock = threading.RLock()
+
+    def register(self, name: str, node) -> None:
+        with self.lock:
+            self.nodes[name] = node
+
+    def node(self, name: str):
+        with self.lock:
+            if name not in self.nodes:
+                raise TtxError(f"unknown node [{name}]")
+            return self.nodes[name]
+
+
+def collect_endorsements(tx: Transaction, bus: SessionBus,
+                         auditor_node: str | None) -> None:
+    """ttx/endorse.go:86-163: sign -> audit -> (approval happens at
+    ordering in the standalone backend) -> distribute.
+
+    Mutates tx.request with collected signatures.
+    """
+    msg = tx.message_to_sign()
+
+    # 1. request signatures from each input owner (endorse.go:177-296)
+    for owner_name in tx.input_owners:
+        responder = bus.node(owner_name)
+        sigma = responder.sign_transfer(tx.tx_id, msg)
+        tx.request.signatures.append(sigma)
+    # issuer signs its own issue action (withdrawal flow)
+    if tx.issuer_node is not None:
+        responder = bus.node(tx.issuer_node)
+        sigma = responder.sign_issue(tx.tx_id, msg)
+        tx.request.signatures.append(sigma)
+
+    # 2. request audit (endorse.go:409; ttx/auditor.go:128-254)
+    if auditor_node is not None:
+        auditor = bus.node(auditor_node)
+        sigma = auditor.audit(tx)
+        tx.request.auditor_signatures.append(sigma)
+
+
+def ordering_and_finality(tx: Transaction, chaincode,
+                          timeout: float = 10.0) -> CommitEvent:
+    """ttx/ordering.go:36-66 + ttx/finality.go:50-140 against the
+    standalone ordered ledger: broadcast == process + commit; the commit
+    event is the finality signal (listeners fire synchronously)."""
+    return chaincode.process_request(tx.tx_id, tx.request.to_bytes())
+
+
+class FinalityListener:
+    """network/common/finality.go:57-121: re-extract tokens on commit."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def __call__(self, ev: CommitEvent) -> None:
+        self.node.on_finality(ev)

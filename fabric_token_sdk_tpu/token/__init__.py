@@ -1,0 +1,9 @@
+"""Token API layer — the application-facing surface.
+
+Mirrors the capability surface of the reference Token API (reference
+token/*.go and token/token/*.go; SURVEY.md §2.1): token model, quantity
+arithmetic, token requests, and the management service façade.
+"""
+
+from .model import ID, Token, UnspentToken, IssuedToken, LedgerToken  # noqa: F401
+from .quantity import Quantity, to_quantity, uint64_to_quantity  # noqa: F401

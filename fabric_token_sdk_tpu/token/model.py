@@ -1,0 +1,104 @@
+"""Core token model.
+
+Behavioral mirror of reference token/token/token.go:13-140: a token ID is
+(tx_id, index); a Token carries (owner, type, quantity-hex); Format (ledger
+encoding) and Type (currency) are distinct concepts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import quantity as q
+
+
+@dataclass(frozen=True)
+class ID:
+    """Token identity: creating transaction + output index (token.go:13-27)."""
+
+    tx_id: str
+    index: int = 0
+
+    def __str__(self) -> str:
+        return f"[{self.tx_id}:{self.index}]"
+
+
+# Type is the currency (e.g. "USD"); Format is the on-ledger encoding
+# (e.g. "fabtoken128", "comm") — a many-to-many relation with drivers
+# (token.go:29-36).
+Type = str
+Format = str
+
+
+@dataclass
+class Token:
+    """Result of issue/transfer: owner, type, base-16 "0x" quantity
+    (token.go:38-47)."""
+
+    owner: bytes
+    type: Type
+    quantity: str
+
+    def quantity_int(self, precision: int) -> int:
+        return q.to_quantity(self.quantity, precision).value
+
+
+@dataclass
+class IssuedToken:
+    """Issued token view for the issuer wallet (token.go:49-62)."""
+
+    id: ID | None
+    owner: bytes
+    type: Type
+    quantity: str
+    issuer: bytes = b""
+
+
+@dataclass
+class UnspentToken:
+    """Unspent token view (token.go:113-124)."""
+
+    id: ID | None
+    owner: bytes
+    type: Type
+    quantity: str
+
+
+@dataclass
+class UnspentTokenInWallet:
+    """Unspent token owned solely by one wallet (token.go:95-105)."""
+
+    id: ID | None
+    wallet_id: str
+    type: Type
+    quantity: str
+
+
+@dataclass
+class LedgerToken:
+    """Raw on-ledger token: format + opaque payloads (token.go:107-112)."""
+
+    id: ID
+    format: Format
+    token: bytes
+    token_metadata: bytes
+
+
+@dataclass
+class TokensCollection:
+    """Common container with Sum/ByType helpers (token.go:64-93,126-140)."""
+
+    tokens: list = field(default_factory=list)
+
+    def count(self) -> int:
+        return len(self.tokens)
+
+    def sum(self, precision: int) -> "q.Quantity":
+        total = q.new_zero(precision)
+        for t in self.tokens:
+            total = total.add(q.to_quantity(t.quantity, precision))
+        return total
+
+    def by_type(self, token_type: Type) -> "TokensCollection":
+        return TokensCollection(
+            [t for t in self.tokens if t.type == token_type])

@@ -1,0 +1,103 @@
+"""Precision-bounded token quantity arithmetic.
+
+Behavioral mirror of reference token/token/quantity.go: quantities are
+non-negative integers bounded to a bit precision (16/32/64 in shipped
+drivers); string parsing follows Go big.Int#scan (base prefixes 0x/0o/0b,
+underscores rejected unless base 0 allows them), hex output is "0x"-prefixed,
+and add/sub fail on precision overflow or negative results.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class QuantityError(ValueError):
+    pass
+
+
+def _parse_scan(s: str) -> int | None:
+    """Go big.Int.SetString(s, 0) semantics: sign + base prefix + digits,
+    with optional '_' separators between digits (base 0 only)."""
+    s = s.strip()
+    if not s:
+        return None
+    try:
+        return int(s, 0)
+    except ValueError:
+        return None
+
+
+@dataclass(frozen=True)
+class Quantity:
+    """Immutable non-negative integer bounded to `precision` bits."""
+
+    value: int
+    precision: int
+
+    def add(self, other: "Quantity") -> "Quantity":
+        res = self.value + other.value
+        if res.bit_length() > self.precision:
+            raise QuantityError(
+                f"{res} has precision {res.bit_length()} > {self.precision}")
+        return Quantity(res, self.precision)
+
+    def sub(self, other: "Quantity") -> "Quantity":
+        res = self.value - other.value
+        if res < 0:
+            raise QuantityError(f"{self.value} < {other.value}")
+        return Quantity(res, self.precision)
+
+    def cmp(self, other: "Quantity") -> int:
+        return (self.value > other.value) - (self.value < other.value)
+
+    def hex(self) -> str:
+        return hex(self.value)
+
+    def decimal(self) -> str:
+        return str(self.value)
+
+    def __str__(self) -> str:
+        return self.decimal()
+
+
+def to_quantity(s: str, precision: int) -> Quantity:
+    """Parse per big.Int#scan; reject negatives and precision overflow
+    (quantity.go:46-69)."""
+    if precision == 0:
+        raise QuantityError("precision must be larger than 0")
+    v = _parse_scan(s)
+    if v is None:
+        raise QuantityError(f"invalid input [{s},{precision}]")
+    if v < 0:
+        raise QuantityError("quantity must be larger than 0")
+    if v.bit_length() > precision:
+        raise QuantityError(
+            f"{s} has precision {v.bit_length()} > {precision}")
+    return Quantity(v, precision)
+
+
+def uint64_to_quantity(v: int, precision: int) -> Quantity:
+    """quantity.go:71-93."""
+    if precision == 0:
+        raise QuantityError("precision must be larger than 0")
+    if v < 0:
+        raise QuantityError("quantity must be larger than 0")
+    if v.bit_length() > precision:
+        raise QuantityError(f"{v} has precision {v.bit_length()} > {precision}")
+    return Quantity(v, precision)
+
+
+def new_zero(precision: int) -> Quantity:
+    return Quantity(0, precision)
+
+
+def new_one(precision: int) -> Quantity:
+    return Quantity(1, precision)
+
+
+def sum_quantities(hex_values: list[str], precision: int) -> Quantity:
+    total = new_zero(precision)
+    for h in hex_values:
+        total = total.add(to_quantity(h, precision))
+    return total

@@ -3,6 +3,10 @@
 Real-TPU execution is exercised by bench.py and the driver's graft entry;
 the test suite validates numerics and sharding on the CPU backend so it runs
 anywhere (SURVEY.md §7: multi-chip is tested via virtual devices).
+
+The environment ships an `axon` TPU plugin that imports jax from
+sitecustomize at interpreter start with JAX_PLATFORMS=axon — env vars set
+here are too late, so the platform override must go through jax.config.
 """
 
 import os
@@ -10,10 +14,13 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+import jax  # noqa: E402  (after XLA_FLAGS so the CPU client sees it)
+
+jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the limbed EC kernels trace to large graphs
-# (256-step fori_loop bodies of ~20k HLO ops); caching makes re-runs cheap.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+# (256-step fori_loop bodies); caching makes re-runs cheap. Set via config,
+# not env — jax is already imported (sitecustomize), so env vars are too late.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -1,0 +1,175 @@
+"""End-to-end fabtoken slice: issue -> transfer -> ledger -> queries.
+
+Exercises the full validation pipeline (SURVEY.md §3.2) against the
+in-memory ledger: request wire format, auditor + owner/issuer signatures,
+balance checks, RW-set translation, MVCC double-spend protection.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.core.fabtoken.actions import (IssueAction, Output,
+                                                        TransferAction)
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.driver.identity import Identity
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.token.model import ID
+
+
+@pytest.fixture
+def world():
+    issuer = new_signing_identity()
+    alice = new_signing_identity()
+    bob = new_signing_identity()
+    auditor = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer.identity]
+    pp.auditor = bytes(auditor.identity)
+    validator = fabtoken.new_validator(pp, Deserializer())
+    ledger = MemoryLedger()
+    cc = TokenChaincode(validator, ledger, pp.serialize())
+    return dict(issuer=issuer, alice=alice, bob=bob, auditor=auditor,
+                pp=pp, cc=cc)
+
+
+def _signed_request(world, tx_id, issues=(), transfers=(), signers=()):
+    req = TokenRequest(issues=[a.serialize() for a in issues],
+                       transfers=[a.serialize() for a in transfers])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [s.sign(msg) for s in signers]
+    return req
+
+
+def _issue(world, tx_id="tx1", value="0x64", owner=None):
+    owner = owner if owner is not None else world["alice"]
+    action = IssueAction(
+        issuer=world["issuer"].identity,
+        outputs=[Output(owner=bytes(owner.identity), type="USD",
+                        quantity=value)],
+    )
+    req = _signed_request(world, tx_id, issues=[action],
+                          signers=[world["issuer"]])
+    return world["cc"].process_request(tx_id, req.to_bytes()), action
+
+
+def test_issue_and_query(world):
+    ev, action = _issue(world)
+    assert ev.status == "VALID", ev.message
+    toks = world["cc"].query_tokens([ID("tx1", 0)])
+    assert len(toks) == 1
+    out = Output.deserialize(toks[0])
+    assert out.quantity == "0x64" and out.type == "USD"
+    assert world["cc"].are_tokens_spent([ID("tx1", 0)]) == [False]
+
+
+def test_transfer_moves_value_and_burns_input(world):
+    ev, issue_action = _issue(world)
+    assert ev.status == "VALID"
+    in_token = issue_action.outputs[0]
+    transfer = TransferAction(
+        inputs=[ID("tx1", 0)],
+        input_tokens=[in_token],
+        outputs=[
+            Output(owner=bytes(world["bob"].identity), type="USD",
+                   quantity="0x60"),
+            Output(owner=bytes(world["alice"].identity), type="USD",
+                   quantity="0x4"),
+        ],
+    )
+    req = _signed_request(world, "tx2", transfers=[transfer],
+                          signers=[world["alice"]])
+    ev = world["cc"].process_request("tx2", req.to_bytes())
+    assert ev.status == "VALID", ev.message
+    # input burnt; outputs live
+    assert world["cc"].are_tokens_spent([ID("tx1", 0)]) == [True]
+    bob_tok = Output.deserialize(world["cc"].query_tokens([ID("tx2", 0)])[0])
+    assert bob_tok.quantity == "0x60"
+    assert bob_tok.owner == bytes(world["bob"].identity)
+
+    # double spend of tx1:0 must be rejected
+    transfer2 = TransferAction(
+        inputs=[ID("tx1", 0)], input_tokens=[in_token],
+        outputs=[Output(owner=bytes(world["bob"].identity), type="USD",
+                        quantity="0x64")],
+    )
+    req2 = _signed_request(world, "tx3", transfers=[transfer2],
+                           signers=[world["alice"]])
+    ev = world["cc"].process_request("tx3", req2.to_bytes())
+    assert ev.status == "INVALID"
+    assert "input must exist" in ev.message
+
+
+def test_unbalanced_transfer_rejected(world):
+    _, issue_action = _issue(world)
+    transfer = TransferAction(
+        inputs=[ID("tx1", 0)],
+        input_tokens=[issue_action.outputs[0]],
+        outputs=[Output(owner=bytes(world["bob"].identity), type="USD",
+                        quantity="0x65")],  # 0x64 in, 0x65 out
+    )
+    req = _signed_request(world, "tx2", transfers=[transfer],
+                          signers=[world["alice"]])
+    ev = world["cc"].process_request("tx2", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "does not match output sum" in ev.message
+
+
+def test_wrong_owner_signature_rejected(world):
+    _, issue_action = _issue(world)
+    transfer = TransferAction(
+        inputs=[ID("tx1", 0)],
+        input_tokens=[issue_action.outputs[0]],
+        outputs=[Output(owner=bytes(world["bob"].identity), type="USD",
+                        quantity="0x64")],
+    )
+    # bob signs instead of alice (the owner)
+    req = _signed_request(world, "tx2", transfers=[transfer],
+                          signers=[world["bob"]])
+    ev = world["cc"].process_request("tx2", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "signature" in ev.message
+
+
+def test_unauthorized_issuer_rejected(world):
+    rogue = new_signing_identity()
+    action = IssueAction(
+        issuer=rogue.identity,
+        outputs=[Output(owner=bytes(world["alice"].identity), type="USD",
+                        quantity="0x10")],
+    )
+    req = _signed_request(world, "tx9", issues=[action], signers=[rogue])
+    ev = world["cc"].process_request("tx9", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "is not in issuers" in ev.message
+
+
+def test_missing_auditor_signature_rejected(world):
+    action = IssueAction(
+        issuer=world["issuer"].identity,
+        outputs=[Output(owner=bytes(world["alice"].identity), type="USD",
+                        quantity="0x10")],
+    )
+    req = TokenRequest(issues=[action.serialize()])
+    msg = req.message_to_sign(b"txA")
+    req.signatures = [world["issuer"].sign(msg)]
+    # auditor signature absent entirely
+    ev = world["cc"].process_request("txA", req.to_bytes())
+    assert ev.status == "INVALID"
+
+
+def test_request_roundtrip_bytes(world):
+    action = IssueAction(
+        issuer=world["issuer"].identity,
+        outputs=[Output(owner=bytes(world["alice"].identity), type="USD",
+                        quantity="0x10")],
+    )
+    req = _signed_request(world, "txB", issues=[action],
+                          signers=[world["issuer"]])
+    raw = req.to_bytes()
+    restored = TokenRequest.from_bytes(raw)
+    assert restored.to_bytes() == raw
+    assert restored.issues == req.issues
+    assert restored.auditor_signatures == req.auditor_signatures

@@ -1,0 +1,111 @@
+"""Multi-party ttx lifecycle over the in-process session bus + ledger.
+
+Mirrors the reference's fungible integration flow (integration/token/
+fungible/tests.go:277 TestAll shape): issue -> transfer -> redeem with
+balance and audit assertions, plus failure paths (insufficient funds,
+non-auditor refusing audits).
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.selector import InsufficientFunds
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    validator = fabtoken.new_validator(pp, Deserializer())
+    ledger = MemoryLedger()
+    cc = TokenChaincode(validator, ledger, pp.serialize())
+    bus = SessionBus()
+    nodes = {}
+    nodes["issuer"] = TokenNode("issuer", issuer_keys, bus, cc,
+                                auditor_name="auditor")
+    nodes["auditor"] = AuditorNode("auditor", auditor_keys, bus, cc,
+                                   auditor_name="auditor")
+    for name in ("alice", "bob", "charlie"):
+        nodes[name] = TokenNode(name, new_signing_identity(), bus, cc,
+                                auditor_name="auditor")
+    return nodes
+
+
+def test_issue_transfer_redeem_with_balances(net):
+    alice, bob = net["alice"], net["bob"]
+    # issue 1000 USD to alice
+    tx = alice.issue("issuer", "alice", "USD", hex(1000))
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 1000
+    assert bob.balance("USD") == 0
+
+    # alice -> bob 300 (change 700 back to alice)
+    tx2 = alice.transfer("USD", hex(300), "bob")
+    ev = alice.execute(tx2)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 700
+    assert bob.balance("USD") == 300
+
+    # bob redeems 100
+    tx3 = bob.transfer("USD", hex(100), "", redeem=True)
+    ev = bob.execute(tx3)
+    assert ev.status == "VALID", ev.message
+    assert bob.balance("USD") == 200
+
+    # audit trail covers all three transactions
+    auditor = net["auditor"]
+    recs = auditor.auditdb.query_transactions()
+    assert {r.tx_id for r in recs} == {tx.tx_id, tx2.tx_id, tx3.tx_id}
+    assert auditor.auditdb.locked_eids() == []  # released at finality
+
+
+def test_insufficient_funds(net):
+    alice = net["alice"]
+    tx = alice.issue("issuer", "alice", "USD", hex(50))
+    assert alice.execute(tx).status == "VALID"
+    with pytest.raises(InsufficientFunds):
+        alice.transfer("USD", hex(100), "bob")
+    # funds untouched and locks released
+    assert alice.balance("USD") == 50
+    tx2 = alice.transfer("USD", hex(25), "bob")
+    assert alice.execute(tx2).status == "VALID"
+
+
+def test_transfer_multiple_inputs_gathers_coins(net):
+    alice, bob = net["alice"], net["bob"]
+    for amount in (10, 20, 30):
+        assert alice.execute(
+            alice.issue("issuer", "alice", "USD", hex(amount))
+        ).status == "VALID"
+    tx = alice.transfer("USD", hex(55), "bob")
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 5
+    assert bob.balance("USD") == 55
+
+
+def test_status_tracking(net):
+    alice = net["alice"]
+    tx = alice.issue("issuer", "alice", "USD", hex(10))
+    assert alice.execute(tx).status == "VALID"
+    from fabric_token_sdk_tpu.services.db.sqldb import TxStatus
+    assert alice.ttxdb.get_status(tx.tx_id) == TxStatus.CONFIRMED
+
+
+def test_non_auditor_node_refuses_audit(net):
+    from fabric_token_sdk_tpu.services.ttx import TtxError
+    alice = net["alice"]
+    tx = alice.issue("issuer", "alice", "USD", hex(10))
+    alice.auditor_name = "bob"  # bob is not an auditor
+    with pytest.raises(TtxError):
+        alice.execute(tx)

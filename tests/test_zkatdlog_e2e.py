@@ -1,0 +1,176 @@
+"""End-to-end zkatdlog slice: ZK issue -> ZK transfer through the validator.
+
+The full SURVEY.md §3.2 pipeline with real proofs: commitment tokens,
+same-type + range proofs on issue, type-and-sum + range proofs on transfer,
+owner/issuer/auditor signatures, RW-set translation — with the range proofs
+verified in a single TPU batch behind the validator boundary (device=True on
+the CPU test mesh).
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import zkatdlog
+from fabric_token_sdk_tpu.core.zkatdlog.actions import (ActionInput,
+                                                        IssueAction, Token,
+                                                        TransferAction)
+from fabric_token_sdk_tpu.crypto import bn254, issue_proof, setup, token_commit, \
+    transfer_proof
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.token.model import ID
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def world():
+    issuer = new_signing_identity()
+    auditor = new_signing_identity()
+    alice = new_signing_identity()
+    bob = new_signing_identity()
+    pp = setup.setup(BIT_LENGTH)
+    pp.add_issuer(bytes(issuer.identity))
+    pp.add_auditor(bytes(auditor.identity))
+    validator = zkatdlog.new_validator(pp, Deserializer(), device=True)
+    ledger = MemoryLedger()
+    cc = TokenChaincode(validator, ledger, pp.serialize())
+    return dict(pp=pp, cc=cc, issuer=issuer, auditor=auditor, alice=alice,
+                bob=bob)
+
+
+def _signed(world, tx_id, issues=(), transfers=(), signers=()):
+    req = TokenRequest(issues=[a.serialize() for a in issues],
+                       transfers=[a.serialize() for a in transfers])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [s.sign(msg) for s in signers]
+    return req
+
+
+def _issue(world, tx_id, values, owner):
+    pp = world["pp"]
+    coms, wits = token_commit.get_tokens_with_witness(
+        values, "USD", pp.pedersen_generators)
+    proof = issue_proof.issue_prove([w.as_tuple() for w in wits], coms, pp)
+    action = IssueAction(
+        issuer=world["issuer"].identity,
+        outputs=[Token(owner=bytes(owner.identity), data=c) for c in coms],
+        proof=proof,
+    )
+    req = _signed(world, tx_id, issues=[action], signers=[world["issuer"]])
+    ev = world["cc"].process_request(tx_id, req.to_bytes())
+    return ev, action, wits
+
+
+def test_zk_issue_and_transfer(world):
+    pp = world["pp"]
+    alice, bob = world["alice"], world["bob"]
+    ev, issue_action, wits = _issue(world, "ztx1", [600, 400], alice)
+    assert ev.status == "VALID", ev.message
+
+    # transfer: spend both outputs -> 900 to bob, 100 change to alice
+    in_tokens = issue_action.outputs
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [900, 100], "USD", pp.pedersen_generators)
+    proof = transfer_proof.transfer_prove(
+        [w.as_tuple() for w in wits], [w.as_tuple() for w in out_wits],
+        [t.data for t in in_tokens], out_coms, pp)
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("ztx1", i), token=in_tokens[i])
+                for i in range(2)],
+        outputs=[Token(owner=bytes(bob.identity), data=out_coms[0]),
+                 Token(owner=bytes(alice.identity), data=out_coms[1])],
+        proof=proof,
+    )
+    req = _signed(world, "ztx2", transfers=[action], signers=[alice, alice])
+    ev = world["cc"].process_request("ztx2", req.to_bytes())
+    assert ev.status == "VALID", ev.message
+
+    # inputs burnt on ledger
+    assert world["cc"].are_tokens_spent([ID("ztx1", 0), ID("ztx1", 1)]) == \
+        [True, True]
+
+    # double spend rejected
+    req2 = _signed(world, "ztx3", transfers=[action], signers=[alice, alice])
+    ev = world["cc"].process_request("ztx3", req2.to_bytes())
+    assert ev.status == "INVALID"
+
+
+def test_unbalanced_zk_transfer_rejected(world):
+    """Prover cheats: outputs sum to more than inputs -> proof fails."""
+    pp = world["pp"]
+    alice, bob = world["alice"], world["bob"]
+    ev, issue_action, wits = _issue(world, "ztx4", [50], alice)
+    assert ev.status == "VALID", ev.message
+
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [60, 5], "USD", pp.pedersen_generators)
+    # honest prove fails the sigma protocol only at verify time, so craft the
+    # proof against *claimed* input value 65 (lying about the opening).
+    lying_wits = [("USD", 65, wits[0].blinding_factor)]
+    proof = transfer_proof.transfer_prove(
+        lying_wits, [w.as_tuple() for w in out_wits],
+        [issue_action.outputs[0].data], out_coms, pp)
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("ztx4", 0), token=issue_action.outputs[0])],
+        outputs=[Token(owner=bytes(bob.identity), data=out_coms[0]),
+                 Token(owner=bytes(alice.identity), data=out_coms[1])],
+        proof=proof,
+    )
+    req = _signed(world, "ztx5", transfers=[action], signers=[alice])
+    ev = world["cc"].process_request("ztx5", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "proof" in ev.message
+
+
+def test_out_of_range_output_rejected(world):
+    """Output value >= 2^BitLength must fail the range proof."""
+    pp = world["pp"]
+    alice, bob = world["alice"], world["bob"]
+    big = (1 << BIT_LENGTH)  # one past the max
+    ev, issue_action, wits = _issue(world, "ztx6", [3, 2], alice)
+    assert ev.status == "VALID", ev.message
+    # outputs: big and (5 - big) mod r -> sums match mod r, range must catch
+    out_vals = [big, (5 - big) % bn254.R]
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        out_vals, "USD", pp.pedersen_generators)
+    proof = transfer_proof.transfer_prove(
+        [w.as_tuple() for w in wits], [w.as_tuple() for w in out_wits],
+        [t.data for t in issue_action.outputs], out_coms, pp)
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("ztx6", i), token=issue_action.outputs[i])
+                for i in range(2)],
+        outputs=[Token(owner=bytes(bob.identity), data=out_coms[0]),
+                 Token(owner=bytes(alice.identity), data=out_coms[1])],
+        proof=proof,
+    )
+    req = _signed(world, "ztx7", transfers=[action], signers=[alice, alice])
+    ev = world["cc"].process_request("ztx7", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "range" in ev.message or "proof" in ev.message
+
+
+def test_one_in_one_out_skips_range(world):
+    """1-in/1-out ownership transfer has no range proofs
+    (transfer.go:53-57,101-112)."""
+    pp = world["pp"]
+    alice, bob = world["alice"], world["bob"]
+    ev, issue_action, wits = _issue(world, "ztx8", [77], alice)
+    assert ev.status == "VALID", ev.message
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [77], "USD", pp.pedersen_generators)
+    proof = transfer_proof.transfer_prove(
+        [w.as_tuple() for w in wits], [w.as_tuple() for w in out_wits],
+        [issue_action.outputs[0].data], out_coms, pp)
+    parsed = transfer_proof.TransferProof.deserialize(proof)
+    assert not parsed.range_correctness.proofs  # skipped for 1-1
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("ztx8", 0), token=issue_action.outputs[0])],
+        outputs=[Token(owner=bytes(bob.identity), data=out_coms[0])],
+        proof=proof,
+    )
+    req = _signed(world, "ztx9", transfers=[action], signers=[alice])
+    ev = world["cc"].process_request("ztx9", req.to_bytes())
+    assert ev.status == "VALID", ev.message
