@@ -9,6 +9,7 @@ TPU batch verifier (SURVEY.md §3.2 "where the TPU backend plugs in").
 
 from __future__ import annotations
 
+import base64
 import json
 from dataclasses import dataclass, field
 from typing import Callable
@@ -119,10 +120,12 @@ class Validator:
             signatures = list(tr.auditor_signatures) + list(tr.signatures)
         else:
             signatures = list(tr.signatures)
+        # Signatures attribute mirrors Go json.Marshal of [][]byte, which
+        # emits base64 strings (validator.go ValidationAttributes).
         attributes: ValidationAttributes = {
             TOKEN_REQUEST_TO_SIGN: signed,
             TOKEN_REQUEST_SIGNATURES: json.dumps(
-                [s.hex() for s in signatures]).encode(),
+                [base64.b64encode(s).decode() for s in signatures]).encode(),
         }
         backend = Backend(get_state, signed, signatures)
         return self.verify_token_request(backend, backend, anchor, tr,

@@ -90,7 +90,7 @@ def transfer_htlc_validate(ctx: Context) -> None:
     """validator_transfer.go:96-170; deferred to the htlc service module."""
     from ...services.interop import htlc
 
-    htlc.transfer_htlc_validate(ctx, now=time_mod.time())
+    htlc.transfer_htlc_validate_fabtoken(ctx, now=time_mod.time())
 
 
 def issue_validate(ctx: Context) -> None:

@@ -50,16 +50,6 @@ class Token:
     def is_redeem(self) -> bool:
         return len(self.owner) == 0
 
-    # surface expected by the generic HTLC validator step: commitment tokens
-    # hide type/quantity, so equality checks compare the commitment itself.
-    @property
-    def type(self) -> str:
-        return ""
-
-    @property
-    def quantity(self) -> str:
-        return ser.g1_to_bytes(self.data).hex()
-
 
 @dataclass
 class ActionInput:

@@ -70,10 +70,11 @@ def transfer_zk_proof_validate(ctx: Context) -> None:
 
 
 def transfer_htlc_validate(ctx: Context) -> None:
-    """validator_transfer.go:112-175 (commitment-token variant)."""
+    """validator_transfer.go:112-175 (commitment-token variant: exactly
+    1-in/1-out, no plaintext type/quantity checks)."""
     from ...services.interop import htlc
 
-    htlc.transfer_htlc_validate(ctx, now=time_mod.time())
+    htlc.transfer_htlc_validate_zkatdlog(ctx, now=time_mod.time())
 
 
 def issue_validate(ctx: Context) -> None:

@@ -11,9 +11,17 @@ error message (SURVEY.md north star: bit-identical accept/reject).
 
 from __future__ import annotations
 
+import logging
+
 from ...crypto import issue_proof, rp, transfer_proof
 from ...crypto.bn254 import G1, g1_add, g1_neg
 from ...crypto.rp import ProofError
+
+logger = logging.getLogger("fabric_token_sdk_tpu.zkverifier")
+
+#: Count of device-reject / host-accept disagreements (should stay 0; tests
+#: assert it never moves on honest input). Exposed for metrics scraping.
+DEVICE_DISAGREEMENTS = 0
 
 
 class ZKVerifier:
@@ -97,6 +105,14 @@ class ZKVerifier:
                                 rpp.bit_length)
             except ProofError as e:
                 raise ProofError(f"invalid range proof at index {i}: {e}") from e
-        # Device said reject but host accepts everything: trust the host
-        # oracle (exactness) — should be unreachable; tested for parity.
+        # Device said reject but host accepts everything: a device/oracle
+        # disagreement is a kernel bug, never a bad proof. Count and log it
+        # loudly so it can't silently mask a broken device path, then trust
+        # the host oracle for the accept/reject decision (exactness).
+        global DEVICE_DISAGREEMENTS
+        DEVICE_DISAGREEMENTS += 1
+        logger.error(
+            "device/oracle disagreement: device rejected index %d of a "
+            "%d-proof batch the host oracle fully accepts (kernel bug?)",
+            first_bad, len(rc.proofs))
         return

@@ -2,32 +2,42 @@
 
 Replaces the reference's sequential verifier loop (reference
 token/core/zkatdlog/nogh/v1/crypto/rp/rangecorrectness.go:137-162 and
-rp/bulletproof.go:252-333, rp/ipa.go:190-262) with two device passes over a
+rp/bulletproof.go:252-333, rp/ipa.go:190-262) with device passes over a
 whole batch of proofs:
 
   Pass 1 (device): for every proof, compute the IPA input commitment K and
     the primed right generators H'_i = y^-i * H_i, returned as canonical
     affine limbs. These are the only group elements the Fiat-Shamir
-    transcript needs that are not literal proof bytes.
+    transcript needs that are not literal proof bytes. Both ride the
+    precomputed 8-bit fixed-base tables of the pp generators — no doublings.
 
   Host: recompute every challenge (x, y, z from proof bytes; the first IPA
     challenge from pass-1 bytes; round challenges from L_r/R_r bytes) and
     expand the whole verification — including the log-round generator
     folding — into per-proof scalar vectors over fixed term lists.
 
-  Pass 2 (device): two MSM-is-identity checks per proof:
+  Pass 2 (device), fast path: ONE random-linear-combination MSM. Every
+    proof's two checks
       eq1 (5 terms):   cg0^(ip-polEval) cg1^tau T1^-x T2^-x^2 Com^-z^2 == O
       eq2 (2n+2r+5):   folded IPA + commitment equation == O
-    (derivation in _eq2_scalars below).
+    is weighted by fresh per-proof random scalars (w1_b for eq1, w2_b for
+    eq2) and summed; fixed-generator coefficients collapse on host, so the
+    device sees one fixed-base MSM plus one windowed MSM over the per-proof
+    points (D, C, L_r, R_r, T1, T2, Com). Identity => every proof accepted
+    (soundness: a false accept requires predicting the weights; failure
+    probability <= 2/r per invalid proof, standard batch verification).
 
-Accept iff both hold. The decision is exactly the oracle's accept/reject
-(tests assert agreement, including tampered proofs); error *messages* for
-rejected proofs are produced by re-running the host verifier, preserving the
-reference's observable error ordering.
+  Pass 2, exact path: when the combined check rejects — or when the caller
+    asks — per-proof windowed MSM identity checks give the bit-exact
+    accept/reject vector of the host oracle, proof by proof.
+
+Error *messages* for rejected proofs are produced by re-running the host
+verifier, preserving the reference's observable error ordering.
 """
 
 from __future__ import annotations
 
+import secrets
 from dataclasses import dataclass
 
 import jax
@@ -79,43 +89,52 @@ def affine_batch_to_bytes(arr: np.ndarray) -> np.ndarray:
 # --------------------------------------------------------------------------
 
 # Kernels are jitted separately: fusing them into one graph makes XLA:CPU
-# compile superlinearly (three 256-step loops in one module); split, each
-# compiles in seconds and the persistent cache reuses them across runs.
-_rgp_kernel = jax.jit(
-    jax.vmap(jax.vmap(ec.scalar_mul, in_axes=(0, 0)), in_axes=(None, 0)))
-_msm_kernel = jax.jit(ec.msm)
+# compile superlinearly; split, each compiles in seconds and the persistent
+# cache reuses them across runs.
+_tables_kernel = jax.jit(ec.fixed_base_tables)
+_rgp_kernel = jax.jit(ec.fixed_base_gather)
+_affine_rows_kernel = jax.jit(ec.to_affine_batch)
 _affine_kernel = jax.jit(ec.to_affine)
-_msm_id_kernel = jax.jit(ec.msm_is_identity)
 
 
-def _pass1_kernel(h_pts, yinv_pows, k_pts, k_scalars):
-    """Compute right_gen' points and K commitments for the whole batch.
-
-    h_pts:     (n, 3, 16) shared right generators (Jacobian Montgomery)
-    yinv_pows: (B, n, 16) scalars y^-i per proof
-    k_pts:     (B, T_k, 3, 16) K-equation term points
-    k_scalars: (B, T_k, 16)
-    Returns (rgp_affine (B, n, 2, 16), k_affine (B, 2, 16)) canonical limbs.
-    """
-    rgp = _rgp_kernel(h_pts, yinv_pows)
-    k = _msm_kernel(k_pts, k_scalars)
-    return _affine_kernel(rgp), _affine_kernel(k)
+@jax.jit
+def _k_pass_kernel(k_tables, k_fixed_sc, dc_pts, dc_sc):
+    """K = fixed-base part + x*D + C, per proof: (B, 3, 16)."""
+    fixed = ec.fixed_base_msm(k_tables, k_fixed_sc)
+    var = ec.msm_windowed(dc_pts, dc_sc)
+    return ec.add(fixed, var)
 
 
-def _pass2_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
-    """Two batched MSM identity checks; returns (B,) bool accept vector."""
-    ok1 = _msm_id_kernel(eq1_pts, eq1_sc)
-    ok2 = _msm_id_kernel(eq2_pts, eq2_sc)
+@jax.jit
+def _combined_kernel(tables, fixed_sc, var_pts, var_sc):
+    """RLC of every proof's eq1+eq2 == identity? -> () bool."""
+    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
+    var_pt = ec.msm_windowed(var_pts, var_sc)
+    return ec.is_identity(ec.add(fixed_pt, var_pt))
+
+
+@jax.jit
+def _exact_pass_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
+    """Two per-proof MSM identity checks; returns (B,) bool accept vector."""
+    ok1 = ec.is_identity(ec.msm_windowed(eq1_pts, eq1_sc))
+    ok2 = ec.is_identity(ec.msm_windowed(eq2_pts, eq2_sc))
     return jnp.logical_and(ok1, ok2)
 
 
 # --------------------------------------------------------------------------
-# verifier
+# verifier parameters (device-resident, cached per pp)
 # --------------------------------------------------------------------------
 
 @dataclass(frozen=True)
 class RangeVerifierParams:
-    """Device-resident public parameters for one (pp, bit_length) config."""
+    """Device-resident public parameters for one (pp, bit_length) config.
+
+    Fixed-base table layout (one 8-bit windowed table per generator,
+    ec.fixed_base_tables): index order is
+        [G_0..G_{n-1}, H_0..H_{n-1}, P, Q, cg0, cg1, S_G]
+    where S_G = sum_i G_i (K's G-coefficients are all -z, so the whole G
+    block collapses to one term in the K equation).
+    """
 
     bit_length: int
     rounds: int
@@ -124,8 +143,9 @@ class RangeVerifierParams:
     P: object
     Q: object
     commitment_gen: list    # [cg0, cg1] (pedersen_generators[1:3])
-    left_gen_dev: jnp.ndarray      # (n, 3, 16)
-    right_gen_dev: jnp.ndarray     # (n, 3, 16)
+    tables: jnp.ndarray     # (2n+5, 32, 256, 3, 16) all generators
+    k_tables: jnp.ndarray   # (n+2, 32, 256, 3, 16): H_i ++ [P, S_G]
+    rgp_tables: jnp.ndarray  # (n, 32, 256, 3, 16): H_i
     # precomputed transcript prefix: bytes of right_gen' are per-proof, but
     # left_gen ++ [Q] bytes are pp constants.
     left_gen_bytes: tuple
@@ -134,23 +154,46 @@ class RangeVerifierParams:
     @classmethod
     def from_pp(cls, pp) -> "RangeVerifierParams":
         rpp = pp.range_proof_params
+        n = rpp.bit_length
+        s_g = bn254.G1_IDENTITY
+        for g in rpp.left_generators:
+            s_g = bn254.g1_add(s_g, g)
+        gen_points = (list(rpp.left_generators) + list(rpp.right_generators)
+                      + [rpp.P, rpp.Q] + list(pp.pedersen_generators[1:3])
+                      + [s_g])
+        gen_dev = jnp.asarray(limbs.points_to_projective_limbs(gen_points))
+        tables = _tables_kernel(gen_dev)
+        k_idx = list(range(n, 2 * n)) + [2 * n, 2 * n + 4]  # H_i ++ [P, S_G]
         return cls(
-            bit_length=rpp.bit_length,
+            bit_length=n,
             rounds=rpp.number_of_rounds,
             left_gen=list(rpp.left_generators),
             right_gen=list(rpp.right_generators),
             P=rpp.P,
             Q=rpp.Q,
             commitment_gen=list(pp.pedersen_generators[1:3]),
-            left_gen_dev=jnp.asarray(
-                limbs.points_to_projective_limbs(rpp.left_generators)),
-            right_gen_dev=jnp.asarray(
-                limbs.points_to_projective_limbs(rpp.right_generators)),
+            tables=tables,
+            k_tables=jnp.take(tables, jnp.asarray(k_idx), axis=0),
+            rgp_tables=tables[n : 2 * n],
             left_gen_bytes=tuple(
                 ser.g1_to_bytes(p).hex().encode("ascii")
                 for p in rpp.left_generators),
             q_bytes=ser.g1_to_bytes(rpp.Q).hex().encode("ascii"),
         )
+
+
+# Cache params per pp identity: table construction costs one device pass and
+# ~hundreds of MB; validator instances sharing a pp share the tables.
+_PARAMS_CACHE: dict = {}
+
+
+def _params_for(pp) -> RangeVerifierParams:
+    rpp = pp.range_proof_params
+    key = (rpp.bit_length, ser.g1_to_bytes(rpp.P),
+           ser.g1_to_bytes(pp.pedersen_generators[1]))
+    if key not in _PARAMS_CACHE:
+        _PARAMS_CACHE[key] = RangeVerifierParams.from_pp(pp)
+    return _PARAMS_CACHE[key]
 
 
 def _next_pow2(n: int) -> int:
@@ -175,8 +218,7 @@ def _pad_terms(pts: np.ndarray, sc: np.ndarray, t_target: int):
 
 
 # Batch-dimension buckets: every request size pads up to one of these so the
-# device kernels compile for a handful of shapes total (compiles of the
-# 256-step loop kernels are expensive; see module docstring).
+# device kernels compile for a handful of shapes total.
 _B_BUCKETS = (16, 128, 1024, 4096)
 
 
@@ -224,9 +266,14 @@ def _fold_coefficients(round_challenges: list[int], n: int,
     (reference ipa.go:343-356), so coefficient of G_j is the product over
     rounds of x_r when j falls in the high half at round r, else x_r^-1.
     Right generators fold with x and x^-1 swapped.
+
+    Round 1 splits on the full-width halves, so its challenge binds to the
+    index's MOST-significant bit; building the coefficient table by repeated
+    doubling appends one bit per step with the last-processed challenge on
+    the MSB — hence the challenges are consumed in reverse round order.
     """
     coeffs = [1]
-    for x in round_challenges:
+    for x in reversed(round_challenges):
         x_inv = fr_inv(x)
         lo, hi = (x_inv, x) if invert_first_half else (x, x_inv)
         coeffs = [fr_mul(c, lo) for c in coeffs] + \
@@ -243,7 +290,8 @@ class _ProofTranscript:
     y_pows: list[int]
     yinv_pows: list[int]
     pol_eval: int
-    k_scalars: list[int]
+    k_fixed_scalars: list[int]
+    k_var_scalars: list[int]
 
 
 def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
@@ -272,31 +320,42 @@ def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
     pol_eval = fr_sub(fr_mul(fr_sub(z, z_sq), ipy), fr_mul(z_cube, ip2))
 
     # K = x*D + C - z*sum G_i + sum (z + z^2 2^i y^-i) H_i - delta*P
-    # term order: [D, C, P] ++ G_i ++ H_i
-    k_scalars = [x, 1, fr_sub(0, d.delta)]
-    k_scalars += [fr_sub(0, z)] * n
+    # fixed term order (k_tables): H_i ++ [P, S_G]; variable: [D, C].
+    k_fixed = []
     for i in range(n):
-        k_scalars.append(
+        k_fixed.append(
             fr_add(z, fr_mul(z_sq, fr_mul(pow(2, i, R), yinv_pows[i]))))
+    k_fixed.append(fr_sub(0, d.delta))   # P
+    k_fixed.append(fr_sub(0, z))         # S_G = sum G_i
+    k_var = [x, 1]
     return _ProofTranscript(x=x, y=y, z=z, y_pows=y_pows,
                             yinv_pows=yinv_pows, pol_eval=pol_eval,
-                            k_scalars=k_scalars)
+                            k_fixed_scalars=k_fixed, k_var_scalars=k_var)
+
+
+@dataclass
+class _ProofEquations:
+    """Per-proof eq1/eq2 scalars, split fixed-generator vs proof points.
+
+    fixed order (matches RangeVerifierParams.tables):
+        G_0..G_{n-1}, H_0..H_{n-1}, P, Q, cg0, cg1, S_G(unused->0)
+    var order: D, C, L_0..L_{r-1}, R_0..R_{r-1}, T1, T2, Com
+    """
+
+    fixed: list[int]
+    var: list[int]
 
 
 def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
                   rgp_bytes_hex: list[bytes], k_bytes_hex: bytes,
-                  params) -> tuple[list[int], list[int]]:
-    """First IPA challenge + round folding -> eq1/eq2 scalar vectors."""
+                  params) -> _ProofEquations:
+    """First IPA challenge + round folding -> combined scalar vectors."""
     n = params.bit_length
     d = proof.data
     ipa = proof.ipa
     x, z = ts.x, ts.z
     z_sq = fr_mul(z, z)
     x_sq = fr_mul(x, x)
-
-    # eq1 term order: [cg0, cg1, T1, T2, commitment]
-    eq1 = [fr_sub(d.inner_product, ts.pol_eval), d.tau,
-           fr_sub(0, x), fr_sub(0, x_sq), fr_sub(0, z_sq)]
 
     # first IPA challenge: hash(right_gen' ++ left_gen ++ [Q, K], ip)
     # (reference ipa.go:159-173 — right generators first).
@@ -312,38 +371,54 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     b_coeffs = _fold_coefficients(round_ch, n, invert_first_half=False)
 
     a, b = ipa.left, ipa.right
-    # eq2 term order: G_i ++ H_i ++ [Q, D, C, P] ++ L_r ++ R_r
-    eq2 = []
-    for j in range(n):
-        eq2.append(fr_add(fr_mul(a, a_coeffs[j]), z))
-    for j in range(n):
+    fixed = []
+    for j in range(n):                                   # G_j  (eq2)
+        fixed.append(fr_add(fr_mul(a, a_coeffs[j]), z))
+    for j in range(n):                                   # H_j  (eq2)
         coeff = fr_mul(fr_mul(b, b_coeffs[j]), ts.yinv_pows[j])
         coeff = fr_sub(coeff, z)
         coeff = fr_sub(coeff, fr_mul(z_sq,
                                      fr_mul(pow(2, j, R), ts.yinv_pows[j])))
-        eq2.append(coeff)
-    eq2.append(fr_mul(x_ipa, fr_sub(fr_mul(a, b), d.inner_product)))
-    eq2.append(fr_sub(0, x))
-    eq2.append(R - 1)
-    eq2.append(d.delta)
-    for xr in round_ch:
-        eq2.append(fr_sub(0, fr_mul(xr, xr)))
-    for xr in round_ch:
+        fixed.append(coeff)
+    fixed.append(d.delta)                                # P    (eq2)
+    fixed.append(fr_mul(x_ipa, fr_sub(fr_mul(a, b), d.inner_product)))  # Q
+    fixed.append(fr_sub(d.inner_product, ts.pol_eval))   # cg0  (eq1)
+    fixed.append(d.tau)                                  # cg1  (eq1)
+    fixed.append(0)                                      # S_G  (unused here)
+
+    var = [fr_sub(0, x), R - 1]                          # D, C (eq2)
+    for xr in round_ch:                                  # L_r
+        var.append(fr_sub(0, fr_mul(xr, xr)))
+    for xr in round_ch:                                  # R_r
         xr_inv = fr_inv(xr)
-        eq2.append(fr_sub(0, fr_mul(xr_inv, xr_inv)))
-    return eq1, eq2
+        var.append(fr_sub(0, fr_mul(xr_inv, xr_inv)))
+    var.append(fr_sub(0, x))                             # T1   (eq1)
+    var.append(fr_sub(0, x_sq))                          # T2   (eq1)
+    var.append(fr_sub(0, z_sq))                          # Com  (eq1)
+    return _ProofEquations(fixed=fixed, var=var)
 
 
 class BatchRangeVerifier:
     """Vectorized range-proof verification for one public-parameter set."""
 
     def __init__(self, pp):
-        self.params = RangeVerifierParams.from_pp(pp)
+        self.params = _params_for(pp)
+        #: which pass-2 strategy the last verify() used ("combined",
+        #: "exact", or "structure-only"); exposed for tests/metrics.
+        self.last_path: str | None = None
 
-    def verify(self, proofs: list[rp.RangeProof], commitments: list) -> np.ndarray:
-        """Returns a bool accept vector, one entry per (proof, commitment)."""
+    # ------------------------------------------------------------------
+    def verify(self, proofs: list[rp.RangeProof], commitments: list,
+               exact: bool = False) -> np.ndarray:
+        """Returns a bool accept vector, one entry per (proof, commitment).
+
+        Fast path: one random-linear-combination identity check for the
+        whole batch; falls back to per-proof exact checks when it rejects
+        (or when exact=True).
+        """
         params = self.params
         n = params.bit_length
+        r = params.rounds
         B = len(proofs)
         if B == 0:
             return np.zeros(0, dtype=bool)
@@ -352,76 +427,154 @@ class BatchRangeVerifier:
              for i in range(B)])
         live = [i for i in range(B) if ok_structure[i]]
         if not live:
+            self.last_path = "structure-only"
             return ok_structure
 
         transcripts = {i: _host_phase_a(proofs[i], commitments[i], params)
                        for i in live}
 
-        # ---- pass 1: K + right_gen' on device
-        k_point_list = {}
-        for i in live:
-            d = proofs[i].data
-            pts = [d.D, d.C, params.P] + params.left_gen + params.right_gen
-            k_point_list[i] = pts
-        # K and eq2 share one padded term bucket -> one compiled MSM shape;
-        # the batch axis pads to a size bucket for the same reason.
-        t_bucket = _next_pow2(2 * n + 2 * params.rounds + 5)
+        # ---- pass 1: K + right_gen' via fixed-base tables
         b_bucket = _bucket_rows(len(live))
-        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
-        k_pts_np = np.stack(
-            [limbs.points_to_projective_limbs(k_point_list[i]) for i in live])
-        k_sc_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].k_scalars) for i in live])
-        k_pts_np, k_sc_np = _pad_terms(k_pts_np, k_sc_np, t_bucket)
-        k_pts = jnp.asarray(_pad_rows(k_pts_np, b_bucket, id_pt))
-        k_sc = jnp.asarray(_pad_rows(k_sc_np, b_bucket, zero_sc))
+        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+
         yinv_np = np.stack(
             [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
         yinv = jnp.asarray(_pad_rows(yinv_np, b_bucket, zero_sc))
-        rgp_aff, k_aff = _pass1_kernel(params.right_gen_dev, yinv, k_pts, k_sc)
+        k_fixed_np = np.stack(
+            [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
+             for i in live])
+        k_fixed = jnp.asarray(_pad_rows(k_fixed_np, b_bucket, zero_sc))
+        dc_pts_np = np.stack(
+            [limbs.points_to_projective_limbs(
+                [proofs[i].data.D, proofs[i].data.C]) for i in live])
+        dc_pts = jnp.asarray(_pad_rows(dc_pts_np, b_bucket, id_pt))
+        dc_sc_np = np.stack(
+            [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
+             for i in live])
+        dc_sc = jnp.asarray(_pad_rows(dc_sc_np, b_bucket, zero_sc))
+
+        rgp_aff = _affine_rows_kernel(_rgp_kernel(params.rgp_tables, yinv))
+        k_aff = _affine_kernel(
+            _k_pass_kernel(params.k_tables, k_fixed, dc_pts, dc_sc))
         rgp_bytes = affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
         k_bytes = affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
 
         # ---- host: challenges + scalar expansion
-        eq1_sc_rows, eq2_sc_rows = [], []
-        eq1_pt_rows, eq2_pt_rows = [], []
+        equations: dict[int, _ProofEquations] = {}
         for row, i in enumerate(live):
-            d = proofs[i].data
             rgp_hex = [bytes(rgp_bytes[row, j]).hex().encode("ascii")
                        for j in range(n)]
             k_hex = bytes(k_bytes[row]).hex().encode("ascii")
-            eq1, eq2 = _host_phase_b(proofs[i], transcripts[i], rgp_hex,
-                                     k_hex, params)
-            eq1_sc_rows.append(eq1)
-            eq2_sc_rows.append(eq2)
+            equations[i] = _host_phase_b(proofs[i], transcripts[i], rgp_hex,
+                                         k_hex, params)
+
+        # ---- pass 2
+        if not exact:
+            if self._verify_combined(proofs, commitments, live, equations):
+                self.last_path = "combined"
+                return ok_structure
+        accepts_live = self._verify_exact(proofs, commitments, live,
+                                          equations)
+        self.last_path = "exact"
+        out = np.zeros(B, dtype=bool)
+        for row, i in enumerate(live):
+            out[i] = bool(accepts_live[row])
+        return out
+
+    # ------------------------------------------------------------------
+    def _verify_combined(self, proofs, commitments, live,
+                         equations) -> bool:
+        """One RLC MSM over every live proof's eq1+eq2; True iff identity.
+
+        Per-proof weights w1 (eq1 terms) and w2 (eq2 terms) are fresh
+        uniform randoms, so cross-proof or cross-equation cancellation of
+        invalid proofs has probability <= 2/r.
+        """
+        params = self.params
+        n = params.bit_length
+        rr = params.rounds
+        n_fixed = 2 * n + 5
+
+        fixed_acc = [0] * n_fixed
+        var_pts: list = []
+        var_sc: list[int] = []
+        for i in live:
+            w1 = 1 + secrets.randbelow(R - 1)
+            w2 = 1 + secrets.randbelow(R - 1)
+            eq = equations[i]
+            # fixed layout: G(n), H(n) @ w2 | P, Q @ w2 | cg0, cg1 @ w1
+            for j in range(2 * n + 2):
+                fixed_acc[j] = fr_add(fixed_acc[j], fr_mul(w2, eq.fixed[j]))
+            for j in (2 * n + 2, 2 * n + 3):
+                fixed_acc[j] = fr_add(fixed_acc[j], fr_mul(w1, eq.fixed[j]))
+            d = proofs[i].data
+            pts = [d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R \
+                + [d.T1, d.T2, commitments[i]]
+            n_eq2 = 2 + 2 * rr
+            weights = [w2] * n_eq2 + [w1] * 3
+            var_pts.extend(pts)
+            var_sc.extend(fr_mul(w, s) for w, s in zip(weights, eq.var))
+
+        # pad the variable MSM to a bucketed size (multiple of 128)
+        v = len(var_pts)
+        v_target = max(128, ((v + 127) // 128) * 128)
+        pts_np = limbs.points_to_projective_limbs(
+            var_pts + [bn254.G1_IDENTITY] * (v_target - v))
+        sc_np = limbs.scalars_to_limbs(var_sc + [0] * (v_target - v))
+        ok = _combined_kernel(params.tables, jnp.asarray(
+            limbs.scalars_to_limbs(fixed_acc)), jnp.asarray(pts_np),
+            jnp.asarray(sc_np))
+        return bool(ok)
+
+    # ------------------------------------------------------------------
+    def _verify_exact(self, proofs, commitments, live, equations) -> np.ndarray:
+        """Per-proof eq1/eq2 identity checks (bit-exact vs the oracle)."""
+        params = self.params
+        n = params.bit_length
+        rr = params.rounds
+        t_bucket = _next_pow2(2 * n + 2 * rr + 5)
+        b_bucket = _bucket_rows(len(live))
+        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+        zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
+
+        eq1_pt_rows, eq1_sc_rows = [], []
+        eq2_pt_rows, eq2_sc_rows = [], []
+        for i in live:
+            eq = equations[i]
+            d = proofs[i].data
+            # eq1: [cg0, cg1, T1, T2, Com]
             eq1_pt_rows.append([params.commitment_gen[0],
                                 params.commitment_gen[1],
                                 d.T1, d.T2, commitments[i]])
+            eq1_sc_rows.append([eq.fixed[2 * n + 2], eq.fixed[2 * n + 3],
+                                eq.var[-3], eq.var[-2], eq.var[-1]])
+            # eq2: G_i ++ H_i ++ [P, Q, D, C] ++ L_r ++ R_r
             eq2_pt_rows.append(
-                params.left_gen + params.right_gen
-                + [params.Q, d.D, d.C, params.P]
+                params.left_gen + params.right_gen + [params.P, params.Q,
+                                                      d.D, d.C]
                 + proofs[i].ipa.L + proofs[i].ipa.R)
+            eq2_sc_rows.append(
+                eq.fixed[: 2 * n + 2] + eq.var[:2]
+                + eq.var[2 : 2 + 2 * rr])
 
         eq1_pts_np = np.stack(
-            [limbs.points_to_projective_limbs(r) for r in eq1_pt_rows])
+            [limbs.points_to_projective_limbs(rw) for rw in eq1_pt_rows])
         eq1_sc_np = np.stack(
-            [limbs.scalars_to_limbs(r) for r in eq1_sc_rows])
+            [limbs.scalars_to_limbs(rw) for rw in eq1_sc_rows])
         eq2_pts_np = np.stack(
-            [limbs.points_to_projective_limbs(r) for r in eq2_pt_rows])
+            [limbs.points_to_projective_limbs(rw) for rw in eq2_pt_rows])
         eq2_sc_np = np.stack(
-            [limbs.scalars_to_limbs(r) for r in eq2_sc_rows])
+            [limbs.scalars_to_limbs(rw) for rw in eq2_sc_rows])
+        eq1_pts_np, eq1_sc_np = _pad_terms(eq1_pts_np, eq1_sc_np, 8)
         eq2_pts_np, eq2_sc_np = _pad_terms(eq2_pts_np, eq2_sc_np, t_bucket)
 
-        accept_live = np.asarray(_pass2_kernel(
+        accept = np.asarray(_exact_pass_kernel(
             jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
             jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
             jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),
-            jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))[:len(live)]
-        out = np.zeros(B, dtype=bool)
-        for row, i in enumerate(live):
-            out[i] = bool(accept_live[row])
-        return out
+            jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))
+        return accept[:len(live)]
 
     def verify_range_correctness(self, rc: rp.RangeCorrectness,
                                  commitments: list) -> np.ndarray:

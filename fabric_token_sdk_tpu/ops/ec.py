@@ -207,6 +207,182 @@ def msm_is_identity(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
     return is_identity(msm(points, scalars))
 
 
+# --------------------------------------------------------------------------
+# Windowed kernels: the throughput path.
+#
+# The bit-serial kernels above cost ~2 point-adds per scalar bit per term.
+# The windowed forms below trade a small precomputed multiple table per term
+# for 4-bit digits: 64 windows x (1 table-select + tree-sum) + 4 shared
+# doublings per window — ~8x fewer complete additions for the same MSM.
+# Fixed public-parameter generators go further: an 8-bit fixed-base table
+# (built once per pp on device) turns each scalar mul into 32 gathers + 31
+# adds with no doublings at all. (VERDICT round 1, Weak #7.)
+# --------------------------------------------------------------------------
+
+_W4_WINDOWS = 64   # 256 bits / 4
+_W8_WINDOWS = 32   # 256 bits / 8
+
+
+def window_digits4(scalars: jnp.ndarray) -> jnp.ndarray:
+    """(..., 16) uint32 limbs -> (..., 64) int32 4-bit digits, LSB first."""
+    l = scalars.astype(jnp.int32)
+    d = jnp.stack([l & 0xF, (l >> 4) & 0xF, (l >> 8) & 0xF, (l >> 12) & 0xF],
+                  axis=-1)
+    return d.reshape(*scalars.shape[:-1], _W4_WINDOWS)
+
+
+def window_digits8(scalars: jnp.ndarray) -> jnp.ndarray:
+    """(..., 16) uint32 limbs -> (..., 32) int32 8-bit digits, LSB first."""
+    l = scalars.astype(jnp.int32)
+    d = jnp.stack([l & 0xFF, (l >> 8) & 0xFF], axis=-1)
+    return d.reshape(*scalars.shape[:-1], _W8_WINDOWS)
+
+
+def _multiple_table(points: jnp.ndarray, entries: int) -> jnp.ndarray:
+    """(..., 3, 16) -> (..., entries, 3, 16): v -> v*P for v in [0, entries).
+
+    Sequential adds via lax.scan (entries-1 steps, each batch-wide)."""
+    idp = identity(points.shape[:-2])
+
+    def step(cur, _):
+        nxt = add(cur, points)
+        return nxt, nxt
+
+    _, chain = jax.lax.scan(step, idp, None, length=entries - 1)
+    # chain: (entries-1, ..., 3, 16) with chain[k] = (k+1)*P
+    chain = jnp.moveaxis(chain, 0, -3)
+    return jnp.concatenate([idp[..., None, :, :], chain], axis=-3)
+
+
+def _tree_sum_shrink(pts: jnp.ndarray) -> jnp.ndarray:
+    """Tree reduction over axis -3 with shrinking shapes (odd tail carried)."""
+    T = pts.shape[-3]
+    while T > 1:
+        half = T // 2
+        s = add(pts[..., :half, :, :], pts[..., half : 2 * half, :, :])
+        if T % 2:
+            s = jnp.concatenate([s, pts[..., 2 * half :, :, :]], axis=-3)
+        pts = s
+        T = pts.shape[-3]
+    return pts[..., 0, :, :]
+
+
+def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Windowed batched MSM: (..., T, 3, 16) x (..., T, 16) -> (..., 3, 16).
+
+    Builds a 16-entry multiple table per term (15 sequential adds, T-wide),
+    then scans 64 4-bit windows MSB-first: 4 shared doublings + per-term
+    table select + tree-sum per window.
+    """
+    batch = points.shape[:-3]
+    tables = _multiple_table(points, 16)           # (..., T, 16, 3, 16)
+    digits = window_digits4(scalars)               # (..., T, 64)
+
+    def body(i, acc):
+        for _ in range(4):
+            acc = add(acc, acc)
+        d = jax.lax.dynamic_slice_in_dim(
+            digits, _W4_WINDOWS - 1 - i, 1, axis=-1)   # (..., T, 1)
+        sel = jnp.take_along_axis(
+            tables, d[..., None, None].astype(jnp.int32),
+            axis=-3)                                   # (..., T, 1, 3, 16)
+        term = _tree_sum_shrink(sel[..., 0, :, :])
+        return add(acc, term)
+
+    return jax.lax.fori_loop(0, _W4_WINDOWS, body, identity(batch))
+
+
+def fixed_base_tables(points: jnp.ndarray) -> jnp.ndarray:
+    """Precompute 8-bit fixed-base tables for pp-constant generators.
+
+    points: (T, 3, 16) -> (T, 32, 256, 3, 16) with
+    table[t, w, v] = v * 2^(8w) * P_t. Built once per PublicParams set;
+    ~204MB device-resident for T=129 (the n=64 K-equation generators).
+    """
+    T = points.shape[0]
+
+    def dbl8(cur, _):
+        for _ in range(8):
+            cur = add(cur, cur)
+        return cur, cur
+
+    # bases[w] = 2^(8w) * P : (32, T, 3, 16)
+    _, shifted = jax.lax.scan(dbl8, points, None, length=_W8_WINDOWS - 1)
+    bases = jnp.concatenate([points[None], shifted], axis=0)
+    bases = jnp.moveaxis(bases, 0, 1)              # (T, 32, 3, 16)
+    return _multiple_table(bases, 256)             # (T, 32, 256, 3, 16)
+
+
+def fixed_base_gather(tables: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Per-term fixed-base scalar mul via table gather.
+
+    tables: (T, 32, 256, 3, 16); scalars: (..., T, 16) plain limbs.
+    Returns (..., T, 3, 16) = scalars[t] * P_t. 31 complete adds per term.
+    """
+    digits = window_digits8(scalars)               # (..., T, 32)
+    lead = digits.ndim - 2
+    tb = tables.reshape((1,) * lead + tables.shape)
+    sel = jnp.take_along_axis(tb, digits[..., None, None, None].astype(jnp.int32),
+                              axis=-3)             # (..., T, 32, 1, 3, 16)
+    return _tree_sum_shrink(sel[..., 0, :, :])     # fold the 32-window axis
+
+
+def fixed_base_msm(tables: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-base MSM: sum_t scalars[t] * P_t over precomputed tables.
+
+    tables: (T, 32, 256, 3, 16); scalars: (..., T, 16) -> (..., 3, 16).
+    Folds the window and term axes in one tree (31 + T-1 adds total depth
+    log2(32*T))."""
+    digits = window_digits8(scalars)               # (..., T, 32)
+    lead = digits.ndim - 2
+    tb = tables.reshape((1,) * lead + tables.shape)
+    sel = jnp.take_along_axis(tb, digits[..., None, None, None].astype(jnp.int32),
+                              axis=-3)[..., 0, :, :]  # (..., T, 32, 3, 16)
+    flat = sel.reshape(*sel.shape[:-4], -1, 3, L.NLIMBS)
+    return _tree_sum_shrink(flat)
+
+
+def to_affine_batch(p: jnp.ndarray) -> jnp.ndarray:
+    """Projective -> canonical affine over a trailing point axis, using one
+    Fermat inversion per row via the Montgomery batch-inversion trick.
+
+    p: (..., K, 3, 16) -> (..., K, 2, 16). Identity maps to (0, 0).
+    """
+    X, Y, Z = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    inf = is_identity(p)                           # (..., K)
+    one = jnp.broadcast_to(FP.r1_arr, Z.shape)
+    z_safe = jnp.where(inf[..., None], one, Z)
+
+    # Inclusive prefix products along K (log2 K levels of mont_mul).
+    def combine(a, b):
+        return field.mont_mul(a, b, FP)
+
+    prefix = jax.lax.associative_scan(combine, z_safe, axis=-2)
+    total_inv = field.inv(prefix[..., -1, :], FP)  # one Fermat per row
+
+    # zinv[k] = total_inv(k..K-1 suffix) * prefix[k-1]; walk backwards.
+    def step(carry, xs):
+        z_k, prefix_km1 = xs
+        zinv_k = field.mont_mul(carry, prefix_km1, FP)
+        carry = field.mont_mul(carry, z_k, FP)
+        return carry, zinv_k
+
+    K = p.shape[-3]
+    ones = jnp.broadcast_to(FP.r1_arr, z_safe[..., :1, :].shape)
+    prefix_shift = jnp.concatenate([ones, prefix[..., :-1, :]], axis=-2)
+    # scan over the K axis, reversed: move K to axis 0.
+    z_t = jnp.moveaxis(z_safe, -2, 0)
+    pr_t = jnp.moveaxis(prefix_shift, -2, 0)
+    _, zinv_t = jax.lax.scan(step, total_inv, (z_t, pr_t), reverse=True)
+    zinv = jnp.moveaxis(zinv_t, 0, -2)
+
+    xa = field.from_mont(field.mont_mul(X, zinv, FP), FP)
+    ya = field.from_mont(field.mont_mul(Y, zinv, FP), FP)
+    xa = jnp.where(inf[..., None], jnp.zeros_like(xa), xa)
+    ya = jnp.where(inf[..., None], jnp.zeros_like(ya), ya)
+    return jnp.stack([xa, ya], axis=-2)
+
+
 def to_affine(p: jnp.ndarray) -> jnp.ndarray:
     """Projective Montgomery -> canonical affine limbs (..., 2, 16).
 

@@ -245,49 +245,40 @@ def metadata_lock_key_check(action, script: Script) -> str:
     return key
 
 
-def transfer_htlc_validate(ctx, now: float | None = None) -> None:
-    """Driver-chain step (fabtoken validator_transfer.go:96-170; zkatdlog's
-    variant differs only in how input owners/outputs are surfaced)."""
-    if now is None:
-        now = time_mod.time()
-    action = ctx.transfer_action
+def _unmarshal_owner_or_plain(raw: bytes, what: str) -> typed_mod.TypedIdentity | None:
+    """Owner bytes -> TypedIdentity, None for plain keys, error otherwise.
 
-    for i, tok in enumerate(ctx.input_tokens):
-        try:
-            owner = typed_mod.unmarshal_typed_identity(tok.get_owner())
-        except Exception:
-            continue  # not a typed identity: plain owner, nothing to check
-        if owner.type != SCRIPT_TYPE:
-            continue
-        outputs = action.get_outputs()
-        if len(outputs) != 1:
-            raise HTLCError("invalid transfer action: an htlc script only "
-                            "transfers the ownership of a token")
-        output = outputs[0]
-        if ctx.input_tokens[0].type != output.type:
-            raise HTLCError("invalid transfer action: type of input does "
-                            "not match type of output")
-        if ctx.input_tokens[0].quantity != output.quantity:
-            raise HTLCError("invalid transfer action: quantity of input "
-                            "does not match quantity of output")
-        if output.is_redeem():
-            raise HTLCError("invalid transfer action: the output "
-                            "corresponding to an htlc spending should not "
-                            "be a redeem")
-        script, op = verify_owner(tok.get_owner(), output.owner, now)
-        sigma = ctx.signatures[i]
-        key = metadata_claim_key_check(action, script, op, sigma)
-        if op != OP_RECLAIM:
-            ctx.count_metadata_key(key)
+    The reference validators fail hard when an owner does not parse as a
+    TypedIdentity ("failed to unmarshal owner of input token",
+    fabtoken/zkatdlog validator_transfer.go). Deliberate divergence: this
+    framework also admits raw (untyped) EC public keys as owners
+    (identity/deserializer.py falls back to X509Verifier); those are
+    demonstrably plain — they parse as a public key — carry no script, and
+    are skipped. Malformed bytes that are neither remain an error, matching
+    the reference.
+    """
+    try:
+        return typed_mod.unmarshal_typed_identity(raw)
+    except Exception:
+        pass
+    from ..identity.x509 import X509Verifier
 
+    try:
+        X509Verifier.from_identity(Identity(raw))
+        return None
+    except Exception:
+        raise HTLCError(f"failed to unmarshal owner of {what} token")
+
+
+def _validate_output_scripts(ctx, action, now: float) -> None:
+    """Shared output-side loop (both reference validators are identical
+    here): every non-redeem output owned by a live script must carry the
+    matching LockKey metadata entry."""
     for output in action.get_outputs():
         if output.is_redeem():
             continue
-        try:
-            owner = typed_mod.unmarshal_typed_identity(output.owner)
-        except Exception:
-            continue
-        if owner.type != SCRIPT_TYPE:
+        owner = _unmarshal_owner_or_plain(output.owner, "output")
+        if owner is None or owner.type != SCRIPT_TYPE:
             continue
         script = Script.from_json(owner.identity)
         try:
@@ -296,3 +287,67 @@ def transfer_htlc_validate(ctx, now: float | None = None) -> None:
             raise HTLCError(f"htlc script invalid: {e}") from e
         key = metadata_lock_key_check(action, script)
         ctx.count_metadata_key(key)
+
+
+def transfer_htlc_validate_fabtoken(ctx, now: float | None = None) -> None:
+    """fabtoken driver-chain step (fabtoken validator_transfer.go:96-170):
+    a script spend must be the action's only output with identical plaintext
+    type and quantity, and must not redeem."""
+    if now is None:
+        now = time_mod.time()
+    action = ctx.transfer_action
+
+    for i, tok in enumerate(ctx.input_tokens):
+        owner = _unmarshal_owner_or_plain(tok.get_owner(), "input")
+        if owner is None or owner.type != SCRIPT_TYPE:
+            continue
+        outputs = action.get_outputs()
+        if len(outputs) != 1:
+            raise HTLCError("invalid transfer action: an htlc script only "
+                            "transfers the ownership of a token")
+        output = outputs[0]
+        first = ctx.input_tokens[0]
+        if first.type != output.type:
+            raise HTLCError("invalid transfer action: type of input does "
+                            "not match type of output")
+        if first.quantity != output.quantity:
+            raise HTLCError("invalid transfer action: quantity of input "
+                            "does not match quantity of output")
+        if output.is_redeem():
+            raise HTLCError("invalid transfer action: the output "
+                            "corresponding to an htlc spending should not "
+                            "be a redeem")
+        script, op = verify_owner(first.get_owner(), output.owner, now)
+        sigma = ctx.signatures[i]
+        key = metadata_claim_key_check(action, script, op, sigma)
+        if op != OP_RECLAIM:
+            ctx.count_metadata_key(key)
+
+    _validate_output_scripts(ctx, action, now)
+
+
+def transfer_htlc_validate_zkatdlog(ctx, now: float | None = None) -> None:
+    """zkatdlog driver-chain step (zkatdlog validator_transfer.go:112-175):
+    a script spend must be exactly 1-in/1-out; commitment tokens hide type
+    and quantity, so no plaintext equality checks exist (value conservation
+    is enforced by the ZK proof)."""
+    if now is None:
+        now = time_mod.time()
+    action = ctx.transfer_action
+
+    for i, tok in enumerate(ctx.input_tokens):
+        owner = _unmarshal_owner_or_plain(tok.get_owner(), "input")
+        if owner is None or owner.type != SCRIPT_TYPE:
+            continue
+        if len(ctx.input_tokens) != 1 or len(action.get_outputs()) != 1:
+            raise HTLCError("invalid transfer action: an htlc script only "
+                            "transfers the ownership of a token")
+        output = action.get_outputs()[0]
+        script, op = verify_owner(ctx.input_tokens[0].get_owner(),
+                                  output.owner, now)
+        sigma = ctx.signatures[i]
+        key = metadata_claim_key_check(action, script, op, sigma)
+        if op != OP_RECLAIM:
+            ctx.count_metadata_key(key)
+
+    _validate_output_scripts(ctx, action, now)

@@ -78,15 +78,18 @@ def collect_endorsements(tx: Transaction, bus: SessionBus,
     """
     msg = tx.message_to_sign()
 
-    # 1. request signatures from each input owner (endorse.go:177-296)
-    for owner_name in tx.input_owners:
-        responder = bus.node(owner_name)
-        sigma = responder.sign_transfer(tx.tx_id, msg)
-        tx.request.signatures.append(sigma)
-    # issuer signs its own issue action (withdrawal flow)
+    # 1. collect action signatures. The validator consumes the signature
+    # list with one cursor in validation order — issues first, then
+    # transfers (common/validator.go verifies issues before transfers;
+    # reference ttx/endorse.go:93-99 likewise collects issue signatures
+    # first) — so the issuer signature must precede the owner signatures.
     if tx.issuer_node is not None:
         responder = bus.node(tx.issuer_node)
         sigma = responder.sign_issue(tx.tx_id, msg)
+        tx.request.signatures.append(sigma)
+    for owner_name in tx.input_owners:
+        responder = bus.node(owner_name)
+        sigma = responder.sign_transfer(tx.tx_id, msg)
         tx.request.signatures.append(sigma)
 
     # 2. request audit (endorse.go:409; ttx/auditor.go:128-254)

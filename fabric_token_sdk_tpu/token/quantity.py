@@ -16,16 +16,56 @@ class QuantityError(ValueError):
     pass
 
 
+def _digit_val(ch: str) -> int | None:
+    if "0" <= ch <= "9":
+        return ord(ch) - ord("0")
+    if "a" <= ch <= "z":
+        return ord(ch) - ord("a") + 10
+    if "A" <= ch <= "Z":
+        return ord(ch) - ord("A") + 10
+    return None
+
+
 def _parse_scan(s: str) -> int | None:
-    """Go big.Int.SetString(s, 0) semantics: sign + base prefix + digits,
-    with optional '_' separators between digits (base 0 only)."""
-    s = s.strip()
+    """Go big.Int.SetString(s, 0) semantics, implemented exactly (not via
+    Python int(s, 0), which diverges): no whitespace is accepted; a leading
+    "0" with more digits is the legacy OCTAL prefix ("010" == 8); "0x"/"0o"/
+    "0b" select hex/octal/binary; '_' separators are permitted only between
+    a base prefix and a digit or between successive digits; the whole string
+    must be consumed."""
     if not s:
         return None
-    try:
-        return int(s, 0)
-    except ValueError:
+    neg = s[0] == "-"
+    body = s[1:] if s[0] in "+-" else s
+    if not body:
         return None
+    base, digits, prefixed = 10, body, False
+    if body[0] == "0" and len(body) > 1:
+        c = body[1]
+        if c in "xX":
+            base, digits, prefixed = 16, body[2:], True
+        elif c in "oO":
+            base, digits, prefixed = 8, body[2:], True
+        elif c in "bB":
+            base, digits, prefixed = 2, body[2:], True
+        else:
+            base, digits, prefixed = 8, body[1:], True  # legacy octal
+    val = 0
+    prev = "prefix" if prefixed else "start"
+    for ch in digits:
+        if ch == "_":
+            if prev not in ("digit", "prefix"):
+                return None
+            prev = "_"
+            continue
+        d = _digit_val(ch)
+        if d is None or d >= base:
+            return None
+        val = val * base + d
+        prev = "digit"
+    if prev != "digit":  # empty digits ("0x") or trailing underscore
+        return None
+    return -val if neg else val
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,108 @@
+"""Windowed / fixed-base EC kernels vs the host oracle."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.ops import ec, limbs
+
+rng = random.Random(0xF1BED)
+
+
+def _rand_points(k):
+    return [bn254.g1_mul(bn254.G1_GENERATOR, rng.randrange(1, bn254.R))
+            for _ in range(k)]
+
+
+def _host_msm(points, scalars):
+    acc = bn254.G1_IDENTITY
+    for p, s in zip(points, scalars):
+        acc = bn254.g1_add(acc, bn254.g1_mul(p, s))
+    return acc
+
+
+def test_msm_windowed_matches_host():
+    B, T = 3, 5
+    pts_rows, sc_rows, want = [], [], []
+    for b in range(B):
+        pts = _rand_points(T)
+        scs = [rng.randrange(bn254.R) for _ in range(T)]
+        if b == 1:
+            scs[2] = 0  # zero scalar
+        pts_rows.append(limbs.points_to_projective_limbs(pts))
+        sc_rows.append(limbs.scalars_to_limbs(scs))
+        want.append(_host_msm(pts, scs))
+    out = ec.msm_windowed(jnp.asarray(np.stack(pts_rows)),
+                          jnp.asarray(np.stack(sc_rows)))
+    for b in range(B):
+        got = limbs.projective_limbs_to_point(np.asarray(out)[b])
+        assert got == want[b], f"row {b}"
+
+
+def test_msm_windowed_identity_row():
+    pts = [bn254.G1_IDENTITY] * 4
+    scs = [0, 1, 2, 3]
+    out = ec.msm_windowed(
+        jnp.asarray(limbs.points_to_projective_limbs(pts))[None],
+        jnp.asarray(limbs.scalars_to_limbs(scs))[None])
+    assert bool(ec.is_identity(out)[0])
+
+
+@pytest.fixture(scope="module")
+def fb():
+    pts = _rand_points(3)
+    tables = ec.fixed_base_tables(
+        jnp.asarray(limbs.points_to_projective_limbs(pts)))
+    return pts, tables
+
+
+def test_fixed_base_gather_matches_host(fb):
+    pts, tables = fb
+    B = 2
+    sc_rows, want = [], []
+    for _ in range(B):
+        scs = [rng.randrange(bn254.R) for _ in range(3)]
+        sc_rows.append(limbs.scalars_to_limbs(scs))
+        want.append([bn254.g1_mul(p, s) for p, s in zip(pts, scs)])
+    out = np.asarray(ec.fixed_base_gather(
+        tables, jnp.asarray(np.stack(sc_rows))))
+    for b in range(B):
+        for t in range(3):
+            got = limbs.projective_limbs_to_point(out[b, t])
+            assert got == want[b][t], f"({b},{t})"
+
+
+def test_fixed_base_msm_matches_host(fb):
+    pts, tables = fb
+    scs = [rng.randrange(bn254.R) for _ in range(3)]
+    out = ec.fixed_base_msm(tables, jnp.asarray(limbs.scalars_to_limbs(scs)))
+    got = limbs.projective_limbs_to_point(np.asarray(out))
+    assert got == _host_msm(pts, scs)
+
+
+def test_fixed_base_edge_scalars(fb):
+    pts, tables = fb
+    scs = [0, 1, bn254.R - 1]
+    out = np.asarray(ec.fixed_base_gather(
+        tables, jnp.asarray(limbs.scalars_to_limbs(scs))[None]))
+    assert limbs.projective_limbs_to_point(out[0, 0]) == bn254.G1_IDENTITY
+    assert limbs.projective_limbs_to_point(out[0, 1]) == pts[1]
+    assert limbs.projective_limbs_to_point(out[0, 2]) == bn254.g1_neg(pts[2])
+
+
+def test_to_affine_batch_matches_host():
+    pts = _rand_points(5) + [bn254.G1_IDENTITY]
+    # mix in non-trivial Z by summing pairs on device
+    dev = jnp.asarray(limbs.points_to_projective_limbs(pts))
+    doubled = ec.add(dev, dev)  # projective with Z != 1
+    aff = np.asarray(ec.to_affine_batch(doubled[None]))[0]
+    for k, p in enumerate(pts):
+        want = bn254.g1_add(p, p)
+        if want.inf:
+            assert not np.any(aff[k])
+        else:
+            assert limbs.limbs_to_int(aff[k][0]) == want.x
+            assert limbs.limbs_to_int(aff[k][1]) == want.y

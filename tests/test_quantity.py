@@ -1,0 +1,69 @@
+"""Quantity parsing: exact Go big.Int.SetString(s, 0) semantics.
+
+Reference token/token/quantity.go:46-69 parses via big.Int#scan with
+base 0; divergences from Python int(s, 0) are deliberate test targets:
+whitespace is rejected, a leading "0" means octal, underscores follow Go
+placement rules.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.token import quantity as q
+
+
+@pytest.mark.parametrize("s,expected", [
+    ("0", 0),
+    ("10", 10),
+    ("0x10", 16),
+    ("0X10", 16),
+    ("0o17", 15),
+    ("0b101", 5),
+    ("010", 8),          # Go legacy octal; Python int("010", 0) raises
+    ("0_10", 8),         # underscore after the legacy-octal prefix
+    ("0x_ff", 255),      # underscore after the prefix
+    ("1_000", 1000),     # underscore between digits
+    ("0xAb", 171),
+])
+def test_accepts_go_forms(s, expected):
+    assert q.to_quantity(s, 64).value == expected
+
+
+@pytest.mark.parametrize("s", [
+    "", " 10", "10 ", "\t7", "10\n",   # whitespace anywhere: rejected
+    "0x", "0b", "0o",                  # prefix without digits
+    "_10", "10_", "1__0",              # bad underscore placement
+    "0x1g", "0b12", "0o8", "08",       # digit out of base (08 is octal)
+    "++1", "--1", "+-1",
+    "ten",
+])
+def test_rejects_non_go_forms(s):
+    with pytest.raises(q.QuantityError):
+        q.to_quantity(s, 64)
+
+
+def test_negative_rejected_positive_sign_ok():
+    with pytest.raises(q.QuantityError):
+        q.to_quantity("-5", 64)
+    assert q.to_quantity("+5", 64).value == 5
+    # Go: Sign() of "-0" is 0, so it passes the negativity check.
+    assert q.to_quantity("-0", 64).value == 0
+
+
+def test_precision_bounds():
+    assert q.to_quantity("0xffff", 16).value == 0xFFFF
+    with pytest.raises(q.QuantityError):
+        q.to_quantity("0x10000", 16)
+    with pytest.raises(q.QuantityError):
+        q.to_quantity("1", 0)
+
+
+def test_arithmetic():
+    a = q.to_quantity("0x8000", 16)
+    b = q.to_quantity("0x7fff", 16)
+    assert a.add(b).value == 0xFFFF
+    with pytest.raises(q.QuantityError):
+        a.add(a)
+    assert a.sub(b).value == 1
+    with pytest.raises(q.QuantityError):
+        b.sub(a)
+    assert a.hex() == "0x8000"
