@@ -1,0 +1,100 @@
+"""fabtoken driver services: action assembly + output extraction.
+
+The driver-facing service object a TokenNode binds (reference
+token/core/fabtoken/v1/{issue.go,transfer.go,tokens.go} — IssueService,
+TransferService, TokensService): plaintext actions, no request metadata, and
+trivially "deobfuscated" outputs (everything is already in the clear).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...driver.identity import Identity
+from ...services.tokens import ExtractedOutput
+from ...token.model import ID
+from . import actions
+
+
+@dataclass
+class OutputSpec:
+    """One requested output: owner identity bytes + type + integer value.
+
+    owner == b"" denotes a redeem output (request.go:341 Redeem).
+    """
+
+    owner: bytes
+    token_type: str
+    value: int
+    audit_info: bytes = b""
+
+
+class FabTokenDriverService:
+    """Driver services for the plaintext UTXO driver."""
+
+    label = "fabtoken"
+    actions = actions
+
+    def __init__(self, precision: int = 64):
+        self.precision = precision
+
+    # ------------------------------------------------------------- assembly
+    def assemble_issue(self, issuer_identity: bytes,
+                       outputs: list[OutputSpec]):
+        """v1/issue.go Issue: plaintext outputs, no metadata."""
+        action = actions.IssueAction(
+            issuer=Identity(issuer_identity),
+            outputs=[actions.Output(owner=o.owner, type=o.token_type,
+                                    quantity=hex(o.value)) for o in outputs],
+        )
+        return action, None
+
+    def assemble_transfer(self, input_rows, outputs: list[OutputSpec],
+                          wallet=None, sender_audit_info=None):
+        """v1/transfer.go Transfer: claimed input tokens + plaintext outputs.
+
+        input_rows: UnspentToken rows from the selector (owner/type/quantity
+        in the clear).
+        """
+        action = actions.TransferAction(
+            inputs=[r.id for r in input_rows],
+            input_tokens=[actions.Output(owner=bytes(r.owner), type=r.type,
+                                         quantity=r.quantity)
+                          for r in input_rows],
+            outputs=[actions.Output(owner=o.owner, type=o.token_type,
+                                    quantity=hex(o.value)) for o in outputs],
+        )
+        return action, None
+
+    # ------------------------------------------------------------ ingestion
+    def extract_outputs(self, action, openings=None) -> list[ExtractedOutput]:
+        """TokensService.Deobfuscate for plaintext tokens (everything is in
+        the clear; openings are unused)."""
+        outs = []
+        for i, out in enumerate(action.get_outputs()):
+            outs.append(ExtractedOutput(
+                index=i,
+                owner_raw=bytes(out.owner),
+                token_type=out.type,
+                quantity_hex=out.quantity,
+                ledger_format=self.label,
+                ledger_token=out.serialize(),
+            ))
+        return outs
+
+    def parse_ledger_output(self, raw: bytes,
+                            opening: bytes | None = None
+                            ) -> ExtractedOutput | None:
+        """Ledger-scan ingestion (processor.go:40): plaintext outputs parse
+        directly; the opening is unused."""
+        out = actions.Output.deserialize(raw)
+        return ExtractedOutput(
+            index=0, owner_raw=bytes(out.owner), token_type=out.type,
+            quantity_hex=out.quantity, ledger_format=self.label,
+            ledger_token=raw)
+
+    # ------------------------------------------------------------- auditing
+    def audit_check(self, request, metadata, input_tokens, tx_id: str) -> None:
+        """Plaintext actions carry no commitments: nothing to re-open.
+        (The app-level auditor still records/locks/endorses.)"""
+        return None

@@ -1,0 +1,164 @@
+"""Driver registry + TMS provider: config-driven token service assembly.
+
+Behavioral mirror of reference token/core/service.go:29 (factoryDirectory:
+named driver factories) and token/core/tms.go:63,207-274 (TMSProvider: lazy
+TMS instantiation keyed by TMSID; public-params resolution order
+opts -> storage -> fetcher).
+
+A driver factory takes the serialized public parameters and returns the
+assembled driver bundle (driver services + validator + deserializer). The
+provider peeks at the pp envelope's ``identifier`` field — both pp formats
+serialize as JSON{identifier, raw} — to pick the factory, exactly how the
+reference dispatches on PublicParameters.Identifier.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+class RegistryError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class TMSID:
+    """token/tms.go:20-30: (network, channel, namespace) triple."""
+
+    network: str
+    channel: str = ""
+    namespace: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.network},{self.channel},{self.namespace}"
+
+
+@dataclass
+class DriverBundle:
+    """What a driver factory assembles (v1/driver/driver.go:69-169):
+    services + validator + deserializer bound to one pp set."""
+
+    label: str
+    public_params: object
+    services: object                 # driver service (assemble/extract/audit)
+    validator: object
+    deserializer: object
+
+
+class DriverRegistry:
+    """Named-factory directory (core/service.go:29-106)."""
+
+    def __init__(self):
+        self._factories: dict[str, Callable[[bytes], DriverBundle]] = {}
+
+    def register(self, label: str,
+                 factory: Callable[[bytes], DriverBundle]) -> None:
+        if label in self._factories:
+            raise RegistryError(f"driver [{label}] already registered")
+        self._factories[label] = factory
+
+    def labels(self) -> list[str]:
+        return sorted(self._factories)
+
+    def new_bundle(self, pp_raw: bytes) -> DriverBundle:
+        """Dispatch on the pp envelope identifier (core/tms.go driver
+        selection via PublicParametersFromBytes)."""
+        try:
+            identifier = json.loads(pp_raw).get("identifier")
+        except Exception as e:
+            raise RegistryError(
+                f"failed to unmarshal public parameters: {e}") from e
+        factory = self._factories.get(identifier)
+        if factory is None:
+            raise RegistryError(
+                f"no driver found for [{identifier}], available: "
+                f"{self.labels()}")
+        return factory(pp_raw)
+
+
+def default_registry(device: bool = False) -> DriverRegistry:
+    """Registry with the two shipped drivers (sdk/dig wiring equivalent)."""
+    reg = DriverRegistry()
+
+    def _fabtoken(pp_raw: bytes) -> DriverBundle:
+        from ..services.identity.deserializer import Deserializer
+        from .fabtoken import new_validator
+        from .fabtoken.driver import FabTokenDriverService
+        from .fabtoken.setup import PublicParams
+
+        pp = PublicParams.deserialize(pp_raw)
+        deser = Deserializer()
+        return DriverBundle(
+            label="fabtoken", public_params=pp,
+            services=FabTokenDriverService(pp.quantity_precision),
+            validator=new_validator(pp, deser), deserializer=deser)
+
+    def _zkatdlog(pp_raw: bytes) -> DriverBundle:
+        from ..crypto.setup import PublicParams
+        from ..services.identity.deserializer import Deserializer
+        from ..services.identity.idemix import idemix_owner_resolver
+        from . import zkatdlog
+        from .zkatdlog.driver import ZkDlogDriverService
+
+        pp = PublicParams.deserialize(pp_raw)
+        deser = Deserializer(extra_owner_resolvers=[idemix_owner_resolver])
+        return DriverBundle(
+            label="zkatdlog", public_params=pp,
+            services=ZkDlogDriverService(pp, device=device),
+            validator=zkatdlog.new_validator(pp, deser, device=device),
+            deserializer=deser)
+
+    reg.register("fabtoken", _fabtoken)
+    reg.register("zkatdlog", _zkatdlog)
+    return reg
+
+
+class TMSProvider:
+    """Lazy TMS directory (core/tms.go:63-120).
+
+    Public parameters resolve in the reference's order (tms.go:207-274):
+    explicit opts -> the provider's storage -> the registered fetcher
+    (e.g. read from the ledger's setup key).
+    """
+
+    def __init__(self, registry: DriverRegistry,
+                 fetcher: Callable[[TMSID], bytes | None] | None = None):
+        self.registry = registry
+        self.fetcher = fetcher
+        self._storage: dict[TMSID, bytes] = {}
+        self._services: dict[TMSID, object] = {}
+
+    def store_public_params(self, tmsid: TMSID, pp_raw: bytes) -> None:
+        self._storage[tmsid] = pp_raw
+
+    def _load_public_params(self, tmsid: TMSID,
+                            pp_raw: bytes | None) -> bytes:
+        if pp_raw is not None:                  # 1. explicit opts
+            return pp_raw
+        if tmsid in self._storage:              # 2. storage
+            return self._storage[tmsid]
+        if self.fetcher is not None:            # 3. fetcher
+            fetched = self.fetcher(tmsid)
+            if fetched is not None:
+                self._storage[tmsid] = fetched
+                return fetched
+        raise RegistryError(
+            f"cannot resolve public parameters for TMS [{tmsid}]")
+
+    def get_management_service(self, tmsid: TMSID, pp_raw: bytes = None):
+        """GetTokenManagerService (tms.go:63): one TMS per TMSID, lazily."""
+        if tmsid not in self._services:
+            from ..token.tms import TokenManagementService
+
+            raw = self._load_public_params(tmsid, pp_raw)
+            bundle = self.registry.new_bundle(raw)
+            self._services[tmsid] = TokenManagementService(tmsid, bundle)
+        return self._services[tmsid]
+
+    def update(self, tmsid: TMSID, pp_raw: bytes) -> None:
+        """Live public-params update (tms.go:117 Update): replace the
+        stored pp and drop the cached TMS so the next access rebuilds."""
+        self._storage[tmsid] = pp_raw
+        self._services.pop(tmsid, None)

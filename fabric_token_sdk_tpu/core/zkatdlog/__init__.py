@@ -7,5 +7,11 @@ routes to the TPU batch verifier behind the driver.Validator boundary.
 """
 
 from .actions import Token, IssueAction, TransferAction  # noqa: F401
+from .audit import Auditor, AuditError  # noqa: F401
+from .driver import ZkDlogDriverService  # noqa: F401
+from .metadata import (AuditableIdentity, IssueActionMetadata,  # noqa: F401
+                       IssueOutputMetadata, RequestMetadata, TokenMetadata,
+                       TransferActionMetadata, TransferInputMetadata,
+                       TransferOutputMetadata)
 from .validator import new_validator  # noqa: F401
 from .verifier import ZKVerifier  # noqa: F401

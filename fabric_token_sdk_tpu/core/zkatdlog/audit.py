@@ -1,0 +1,290 @@
+"""zkatdlog auditor: commitment re-open + identity inspection + endorse.
+
+Behavioral mirror of reference token/core/zkatdlog/nogh/v1/crypto/audit/
+auditor.go:
+  - ``Check`` (auditor.go:135-177) walks issues then transfers, re-opening
+    every output commitment from the request metadata and matching every
+    owner identity against its audit info.
+  - ``InspectOutput`` (auditor.go:225-246) recomputes
+    commit(H(type), value, bf) over the Pedersen generators and compares
+    with the token data — batched here as ONE device MSM pass over every
+    output in the request (models/audit.py), the second TPU consumer named
+    by SURVEY.md §3.4. First-failure error messages keep the reference's
+    sequential ordering.
+  - ``InspectIdentity`` (auditor.go:265-282) matches owner audit info via a
+    pluggable InfoMatcher (x509 equality today; Idemix NymEID matching plugs
+    in the same hook).
+  - ``Endorse`` (auditor.go:117-132) signs the request's message-to-sign.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...crypto import token_commit
+from ...crypto import serialization as ser
+from ...driver.request import TokenRequest
+from .actions import IssueAction, Token, TransferAction
+from .metadata import RequestMetadata, TokenMetadata
+
+
+class AuditError(Exception):
+    pass
+
+
+class EqualityInfoMatcher:
+    """Plain-identity matcher: audit info must equal the identity bytes.
+
+    The x509 analogue of the reference's enrollment-ID matcher; Idemix
+    replaces this with NymEID matching (identity/idemix/km.go:46-365).
+    """
+
+    def match_identity(self, identity: bytes, audit_info: bytes) -> None:
+        if identity != audit_info:
+            raise AuditError("identity does not match audit info")
+
+
+@dataclass
+class _InspectableToken:
+    data: object            # G1 commitment
+    token_type: str
+    value: int
+    blinding_factor: int
+    owner: bytes
+    audit_info: bytes
+
+
+@dataclass
+class _InspectableIdentity:
+    identity: bytes
+    identity_from_meta: bytes
+    audit_info: bytes
+
+
+class Auditor:
+    """Per-pp zkatdlog auditor with an optional device batch backend."""
+
+    def __init__(self, pp, signer=None, info_matcher=None,
+                 device: bool = True):
+        self.pp = pp
+        self.signer = signer
+        self.info_matcher = info_matcher or EqualityInfoMatcher()
+        self._reopen = None
+        if device:
+            from ...models.audit import BatchAuditReopen
+
+            self._reopen = BatchAuditReopen(pp)
+
+    # ------------------------------------------------------------- endorse
+    def endorse(self, request: TokenRequest, tx_id: str) -> bytes:
+        """Sign a valid token request (auditor.go:117-132)."""
+        if request is None:
+            raise AuditError(
+                f"audit of tx [{tx_id}] failed: token request is nil")
+        if self.signer is None:
+            raise AuditError(f"audit of tx [{tx_id}] failed: signer is nil")
+        return self.signer.sign(request.message_to_sign(tx_id.encode()))
+
+    # --------------------------------------------------------------- check
+    def check(self, request: TokenRequest, metadata: RequestMetadata,
+              input_tokens: list[list[Token]], tx_id: str) -> None:
+        """auditor.go:135-177: issues first, then transfers; raises
+        AuditError with the reference's first-failure ordering."""
+        issue_outputs, issue_identities = self._audit_info_for_issues(
+            request, metadata, tx_id)
+        transfer_inputs, transfer_outputs = self._audit_info_for_transfers(
+            request, metadata, input_tokens, tx_id)
+
+        # one batched device pass over every output commitment in the request
+        all_outputs = [t for group in issue_outputs + transfer_outputs
+                       for t in group]
+        accepts = self._reopen_batch(all_outputs)
+
+        cursor = 0
+        for k, group in enumerate(issue_outputs):
+            for i, tok in enumerate(group):
+                if not accepts[cursor]:
+                    raise AuditError(
+                        f"audit of {k} th issue in tx [{tx_id}] failed: "
+                        f"output at index [{i}] does not match the provided "
+                        f"opening")
+                self._inspect_token_identity(tok, i, f"issue {k}")
+                cursor += 1
+        for k, ident in enumerate(issue_identities):
+            self._inspect_identity(ident, k, f"identity for issue [{tx_id}]")
+        for k, group in enumerate(transfer_outputs):
+            for i, tok in enumerate(group):
+                if not accepts[cursor]:
+                    raise AuditError(
+                        f"audit of {k} th transfer in tx [{tx_id}] failed: "
+                        f"output at index [{i}] does not match the provided "
+                        f"opening")
+                self._inspect_token_identity(tok, i, f"transfer {k}")
+                cursor += 1
+        for k, group in enumerate(transfer_inputs):
+            for i, ident in enumerate(group):
+                self._inspect_identity(
+                    ident, i, f"input of transfer {k} in tx [{tx_id}]")
+
+    # ------------------------------------------------------------- helpers
+    def _reopen_batch(self, tokens: list[_InspectableToken]) -> list[bool]:
+        openings = [(t.data, t.token_type, t.value, t.blinding_factor)
+                    for t in tokens]
+        if self._reopen is not None:
+            return list(self._reopen.verify(openings))
+        out = []
+        for data, token_type, value, bf in openings:
+            try:
+                token_commit.audit_inspect_output(
+                    data, token_type, value, bf, self.pp.pedersen_generators)
+                out.append(True)
+            except token_commit.TokenError:
+                out.append(False)
+        return out
+
+    def _inspect_token_identity(self, tok: _InspectableToken, index: int,
+                                what: str) -> None:
+        if len(tok.owner) == 0:
+            return  # redeemed output: no identity to inspect
+        if len(tok.audit_info) == 0:
+            raise AuditError(
+                f"failed to inspect identity at index [{index}] of {what}: "
+                f"audit info is nil")
+        try:
+            self.info_matcher.match_identity(tok.owner, tok.audit_info)
+        except Exception as e:
+            raise AuditError(
+                f"owner at index [{index}] of {what} does not match the "
+                f"provided opening: {e}") from e
+
+    def _inspect_identity(self, ident: _InspectableIdentity, index: int,
+                          what: str) -> None:
+        """auditor.go:265-282."""
+        if len(ident.identity) == 0:
+            raise AuditError(
+                f"identity at index [{index}] is nil, cannot inspect it")
+        if len(ident.audit_info) == 0:
+            raise AuditError(
+                f"failed to inspect identity at index [{index}]: audit info "
+                f"is nil")
+        if ident.identity_from_meta and \
+                ident.identity_from_meta != ident.identity:
+            raise AuditError(
+                f"failed to inspect identity at index [{index}]: identity "
+                f"does not match the identity from metadata")
+        try:
+            self.info_matcher.match_identity(ident.identity,
+                                             ident.audit_info)
+        except Exception as e:
+            raise AuditError(
+                f"failed checking {what}: owner at index [{index}] does not "
+                f"match the provided opening: {e}") from e
+
+    def _audit_info_for_issues(self, request, metadata, tx_id):
+        """auditor.go:286-341 GetAuditInfoForIssues."""
+        if len(request.issues) != len(metadata.issues):
+            raise AuditError(
+                "number of issues does not match number of provided metadata")
+        outputs, identities = [], []
+        for k, md in enumerate(metadata.issues):
+            try:
+                action = IssueAction.deserialize(request.issues[k])
+            except Exception as e:
+                raise AuditError(
+                    f"failed to deserialize issue action at index [{k}]"
+                ) from e
+            if len(action.outputs) != len(md.outputs):
+                raise AuditError(
+                    "number of output does not match number of provided "
+                    "metadata")
+            group = []
+            for i, omd in enumerate(md.outputs):
+                tok = action.outputs[i]
+                if tok is None or tok.data is None:
+                    raise AuditError(f"output token at index [{i}] is nil")
+                if tok.is_redeem():
+                    raise AuditError("issue cannot redeem tokens")
+                if not omd.receivers:
+                    raise AuditError("issue must have at least one receiver")
+                opening = self._opening(omd.output_metadata, i)
+                group.append(_InspectableToken(
+                    data=tok.data, token_type=opening.token_type,
+                    value=opening.value,
+                    blinding_factor=opening.blinding_factor,
+                    owner=tok.owner,
+                    audit_info=omd.receivers[0].audit_info))
+            outputs.append(group)
+            identities.append(_InspectableIdentity(
+                identity=bytes(action.issuer),
+                identity_from_meta=md.issuer.identity,
+                audit_info=md.issuer.audit_info))
+        return outputs, identities
+
+    def _audit_info_for_transfers(self, request, metadata, input_tokens,
+                                  tx_id):
+        """auditor.go:344-430 GetAuditInfoForTransfers."""
+        if len(request.transfers) != len(metadata.transfers):
+            raise AuditError(
+                "number of transfers does not match the number of provided "
+                "metadata")
+        if len(input_tokens) != len(metadata.transfers):
+            raise AuditError(
+                "number of inputs does not match the number of provided "
+                "metadata")
+        inputs, outputs = [], []
+        for k, md in enumerate(metadata.transfers):
+            try:
+                action = TransferAction.deserialize(request.transfers[k])
+            except Exception as e:
+                raise AuditError(
+                    f"failed to deserialize transfer action at index [{k}]"
+                ) from e
+            if len(md.inputs) != len(input_tokens[k]):
+                raise AuditError(
+                    f"number of inputs does not match the number of senders "
+                    f"[{len(md.inputs)}]!=[{len(input_tokens[k])}]")
+            in_group = []
+            for i, imd in enumerate(md.inputs):
+                tok = input_tokens[k][i]
+                if tok is None:
+                    raise AuditError(f"invalid input at index [{i}]")
+                if tok.is_redeem():
+                    continue  # no identity to inspect
+                if not imd.senders:
+                    raise AuditError(
+                        f"transfer input at index [{i}] has no sender")
+                in_group.append(_InspectableIdentity(
+                    identity=tok.owner, identity_from_meta=b"",
+                    audit_info=imd.senders[0].audit_info))
+            if len(md.outputs) != len(action.outputs):
+                raise AuditError(
+                    "number of output does not match number of provided "
+                    "metadata")
+            out_group = []
+            for i, omd in enumerate(md.outputs):
+                tok = action.outputs[i]
+                if tok is None or tok.data is None:
+                    raise AuditError(f"invalid output at index [{i}]")
+                opening = self._opening(omd.output_metadata, i)
+                audit_info = b""
+                if not tok.is_redeem():
+                    if not omd.receivers:
+                        raise AuditError(
+                            f"transfer output at index [{i}] has no receiver")
+                    audit_info = omd.receivers[0].audit_info
+                out_group.append(_InspectableToken(
+                    data=tok.data, token_type=opening.token_type,
+                    value=opening.value,
+                    blinding_factor=opening.blinding_factor,
+                    owner=tok.owner, audit_info=audit_info))
+            inputs.append(in_group)
+            outputs.append(out_group)
+        return inputs, outputs
+
+    @staticmethod
+    def _opening(raw: bytes, index: int) -> TokenMetadata:
+        try:
+            return TokenMetadata.deserialize(raw)
+        except Exception as e:
+            raise AuditError(
+                f"failed to deserialize metadata at index [{index}]") from e

@@ -1,0 +1,228 @@
+"""zkatdlog driver services: ZK action assembly, deobfuscation, audit hook.
+
+The driver-facing service object a TokenNode binds for the privacy driver
+(reference token/core/zkatdlog/nogh/v1/{issue.go,transfer.go,tokens.go,
+auditor.go}):
+
+  - ``assemble_issue``  — GenerateZKIssue (crypto/issue/issuer.go:39-91):
+    fresh commitments + witnesses, same-type + range proofs, request
+    metadata carrying the openings for receivers and the auditor.
+  - ``assemble_transfer`` — Sender.GenerateZKTransfer (crypto/transfer/
+    sender.go:54-108): loads input openings from the wallet (tokendb
+    ledger metadata), commits the outputs, proves type-and-sum + range.
+  - ``extract_outputs`` — TokensService.Deobfuscate (v1/tokens.go:111):
+    opens each output commitment with the opening received during
+    distribution; outputs without an opening are opaque to this node and
+    skipped (that is the privacy model working as intended).
+  - ``audit_check`` — driver AuditorService.AuditorCheck (v1/auditor.go:58)
+    delegating to the batched-reopen Auditor (audit.py).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ...crypto import token_commit
+from ...services.tokens import ExtractedOutput
+from ...token.model import ID
+from ..fabtoken.driver import OutputSpec
+from . import actions as zk_actions
+from .actions import ActionInput, IssueAction, Token, TransferAction
+from .audit import Auditor
+from .metadata import (AuditableIdentity, IssueActionMetadata,
+                       IssueOutputMetadata, RequestMetadata, TokenMetadata,
+                       TransferActionMetadata, TransferInputMetadata,
+                       TransferOutputMetadata)
+
+
+logger = logging.getLogger("fabric_token_sdk_tpu.zkatdlog.driver")
+
+
+class DriverError(Exception):
+    pass
+
+
+class ZkDlogDriverService:
+    """Driver services for the ZK privacy driver, bound to one pp set."""
+
+    label = "zkatdlog"
+    actions = zk_actions
+
+    def __init__(self, pp, device: bool = True, info_matcher=None):
+        from ...crypto import issue_proof, transfer_proof
+
+        self.pp = pp
+        self._issue_prove = issue_proof.issue_prove
+        self._transfer_prove = transfer_proof.transfer_prove
+        self._auditor = Auditor(pp, info_matcher=info_matcher, device=device)
+
+    # ------------------------------------------------------------- assembly
+    def assemble_issue(self, issuer_identity: bytes,
+                       outputs: list[OutputSpec]):
+        """crypto/issue/issuer.go:39-91 GenerateZKIssue."""
+        if not outputs:
+            raise DriverError("no outputs to issue")
+        token_type = outputs[0].token_type
+        if any(o.token_type != token_type for o in outputs):
+            raise DriverError("issue outputs must share one token type")
+        coms, wits = token_commit.get_tokens_with_witness(
+            [o.value for o in outputs], token_type,
+            self.pp.pedersen_generators)
+        proof = self._issue_prove([w.as_tuple() for w in wits], coms, self.pp)
+        action = IssueAction(
+            issuer=issuer_identity,
+            outputs=[Token(owner=o.owner, data=c)
+                     for o, c in zip(outputs, coms)],
+            proof=proof,
+        )
+        md = IssueActionMetadata(
+            issuer=AuditableIdentity(identity=bytes(issuer_identity),
+                                     audit_info=bytes(issuer_identity)),
+            outputs=[IssueOutputMetadata(
+                output_metadata=TokenMetadata(
+                    token_type=w.token_type, value=w.value,
+                    blinding_factor=w.blinding_factor,
+                    issuer=bytes(issuer_identity)).serialize(),
+                receivers=[AuditableIdentity(
+                    identity=o.owner,
+                    audit_info=o.audit_info or o.owner)])
+                for o, w in zip(outputs, wits)],
+        )
+        return action, md
+
+    def assemble_transfer(self, input_rows, outputs: list[OutputSpec],
+                          wallet=None, sender_audit_info=None):
+        """crypto/transfer/sender.go:54-108 GenerateZKTransfer.
+
+        input_rows: UnspentToken rows from the selector; ``wallet`` maps a
+        token ID to its (serialized Token, serialized TokenMetadata) pair —
+        the openings this node learned at ingestion time.
+        ``sender_audit_info(owner_raw) -> bytes`` supplies the per-input
+        audit info (Idemix pseudonym openings; defaults to the identity
+        bytes, the x509 equality convention).
+        """
+        if wallet is None:
+            raise DriverError("zkatdlog transfers need a wallet of openings")
+        in_tokens, in_wits = [], []
+        for row in input_rows:
+            stored = wallet(row.id)
+            if stored is None:
+                raise DriverError(f"no opening for token {row.id}")
+            tok_raw, md_raw = stored
+            tok = Token.deserialize(tok_raw)
+            opening = TokenMetadata.deserialize(md_raw)
+            in_tokens.append(tok)
+            in_wits.append((opening.token_type, opening.value,
+                            opening.blinding_factor))
+        token_type = in_wits[0][0]
+        out_coms, out_wits = token_commit.get_tokens_with_witness(
+            [o.value for o in outputs], token_type,
+            self.pp.pedersen_generators)
+        proof = self._transfer_prove(
+            in_wits, [w.as_tuple() for w in out_wits],
+            [t.data for t in in_tokens], out_coms, self.pp)
+        action = TransferAction(
+            inputs=[ActionInput(id=row.id, token=tok)
+                    for row, tok in zip(input_rows, in_tokens)],
+            outputs=[Token(owner=o.owner, data=c)
+                     for o, c in zip(outputs, out_coms)],
+            proof=proof,
+        )
+        if sender_audit_info is None:
+            sender_audit_info = bytes
+        md = TransferActionMetadata(
+            inputs=[TransferInputMetadata(
+                token_id=row.id,
+                senders=[AuditableIdentity(
+                    identity=bytes(tok.owner),
+                    audit_info=sender_audit_info(tok.owner))])
+                for row, tok in zip(input_rows, in_tokens)],
+            outputs=[TransferOutputMetadata(
+                output_metadata=TokenMetadata(
+                    token_type=w.token_type, value=w.value,
+                    blinding_factor=w.blinding_factor).serialize(),
+                receivers=[AuditableIdentity(
+                    identity=o.owner,
+                    audit_info=o.audit_info or o.owner)])
+                for o, w in zip(outputs, out_wits)],
+        )
+        return action, md
+
+    # ------------------------------------------------------------ ingestion
+    def extract_outputs(self, action, openings=None) -> list[ExtractedOutput]:
+        """v1/tokens.go:111 Deobfuscate: open each output this node holds an
+        opening for; opaque outputs surface with owner b"" (skipped).
+
+        A malformed or mismatched opening — peers supply these bytes —
+        degrades that one output to opaque (logged) instead of failing the
+        whole ingestion: the ledger commit already happened and the other
+        outputs are still recoverable.
+        """
+        openings = openings or {}
+        outs = []
+        for i, tok in enumerate(action.get_outputs()):
+            md_raw = openings.get(i)
+            opaque = ExtractedOutput(index=i, owner_raw=b"", token_type="",
+                                     quantity_hex="0x0")
+            if md_raw is None or tok.is_redeem():
+                outs.append(opaque)
+                continue
+            try:
+                opening = TokenMetadata.deserialize(md_raw)
+                clear = token_commit.to_clear(
+                    tok.data, tok.owner, opening.token_type, opening.value,
+                    opening.blinding_factor, self.pp.pedersen_generators)
+            except Exception:
+                logger.exception(
+                    "discarding output [%d]: opening does not parse or does "
+                    "not match the commitment", i)
+                outs.append(opaque)
+                continue
+            outs.append(ExtractedOutput(
+                index=i,
+                owner_raw=bytes(tok.owner),
+                token_type=clear["type"],
+                quantity_hex=clear["quantity"],
+                ledger_format=self.label,
+                ledger_token=tok.serialize(),
+                ledger_metadata=md_raw,
+            ))
+        return outs
+
+    def parse_ledger_output(self, raw: bytes,
+                            opening: bytes | None = None
+                            ) -> ExtractedOutput | None:
+        """Ledger-scan ingestion: a commitment token is opaque without its
+        opening — nodes only recover outputs they hold openings for."""
+        if opening is None:
+            return None
+        tok = Token.deserialize(raw)
+        if tok.is_redeem():
+            return None
+        try:
+            md = TokenMetadata.deserialize(opening)
+            clear = token_commit.to_clear(
+                tok.data, tok.owner, md.token_type, md.value,
+                md.blinding_factor, self.pp.pedersen_generators)
+        except Exception:
+            logger.exception("discarding ledger output: bad opening")
+            return None
+        return ExtractedOutput(
+            index=0, owner_raw=bytes(tok.owner), token_type=clear["type"],
+            quantity_hex=clear["quantity"], ledger_format=self.label,
+            ledger_token=raw, ledger_metadata=opening)
+
+    # ------------------------------------------------------------- auditing
+    def audit_check(self, request, metadata: RequestMetadata | None,
+                    input_tokens: list[list[Token]] | None,
+                    tx_id: str) -> None:
+        """v1/auditor.go:58 AuditorCheck -> audit.Auditor.Check."""
+        if metadata is None:
+            raise DriverError(
+                f"audit of tx [{tx_id}] failed: missing request metadata")
+        if input_tokens is None:
+            input_tokens = [
+                TransferAction.deserialize(raw).input_tokens()
+                for raw in request.transfers
+            ]
+        self._auditor.check(request, metadata, input_tokens, tx_id)

@@ -1,0 +1,236 @@
+"""zkatdlog request metadata: commitment openings + auditable identities.
+
+Behavioral mirror of the reference metadata model:
+  - token opening (reference token/core/zkatdlog/nogh/v1/crypto/token/
+    token.go:132-180 ``Metadata``): Type, Value, BlindingFactor, Issuer.
+  - per-action metadata (reference token/driver/request.go:105-330
+    ``IssueMetadata`` / ``TransferMetadata``): auditable identities
+    (identity + audit info) for issuer/senders/receivers plus the serialized
+    opening per output.
+
+The request metadata never reaches the ledger; it flows sender -> auditor
+(audit check re-opens every commitment) and sender -> receiver (wallet
+ingestion of fresh openings). Wire format is this framework's protowire
+messages; proof-relevant bytes (Zr scalars) keep exact reference encoding
+via crypto/serialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ...crypto import serialization as ser
+from ...token.model import ID
+from ...utils import protowire as pw
+
+
+class MetadataError(ValueError):
+    pass
+
+
+@dataclass
+class TokenMetadata:
+    """Opening of one commitment token (crypto/token/token.go:132-180)."""
+
+    token_type: str
+    value: int
+    blinding_factor: int
+    issuer: bytes = b""
+
+    def serialize(self) -> bytes:
+        return (pw.string_field(1, self.token_type)
+                + pw.bytes_field(2, ser.zr_to_bytes(self.value))
+                + pw.bytes_field(3, ser.zr_to_bytes(self.blinding_factor))
+                + pw.bytes_field(4, self.issuer))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TokenMetadata":
+        fields = pw.parse_fields(raw)
+        v_raw = bytes(fields.get(2, [b""])[0])
+        bf_raw = bytes(fields.get(3, [b""])[0])
+        if not v_raw or not bf_raw:
+            raise MetadataError("invalid token metadata: missing opening")
+        return cls(
+            token_type=bytes(fields.get(1, [b""])[0]).decode(),
+            value=ser.zr_from_bytes(v_raw),
+            blinding_factor=ser.zr_from_bytes(bf_raw),
+            issuer=bytes(fields.get(4, [b""])[0]),
+        )
+
+
+@dataclass
+class AuditableIdentity:
+    """Identity + audit info pair (driver/request.go:105-121)."""
+
+    identity: bytes = b""
+    audit_info: bytes = b""
+
+    def serialize(self) -> bytes:
+        return (pw.bytes_field(1, self.identity)
+                + pw.bytes_field(2, self.audit_info))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "AuditableIdentity":
+        fields = pw.parse_fields(raw)
+        return cls(identity=bytes(fields.get(1, [b""])[0]),
+                   audit_info=bytes(fields.get(2, [b""])[0]))
+
+
+@dataclass
+class IssueOutputMetadata:
+    """driver/request.go:144-181."""
+
+    output_metadata: bytes = b""            # serialized TokenMetadata
+    receivers: list[AuditableIdentity] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = pw.bytes_field(1, self.output_metadata)
+        for r in self.receivers:
+            out += pw.message_field(2, r.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IssueOutputMetadata":
+        fields = pw.parse_fields(raw)
+        return cls(
+            output_metadata=bytes(fields.get(1, [b""])[0]),
+            receivers=[AuditableIdentity.deserialize(bytes(b))
+                       for b in fields.get(2, [])],
+        )
+
+
+@dataclass
+class IssueActionMetadata:
+    """driver/request.go:184-246."""
+
+    issuer: AuditableIdentity = field(default_factory=AuditableIdentity)
+    outputs: list[IssueOutputMetadata] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = pw.message_field(1, self.issuer.serialize())
+        for o in self.outputs:
+            out += pw.message_field(2, o.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IssueActionMetadata":
+        fields = pw.parse_fields(raw)
+        issuer = AuditableIdentity()
+        if 1 in fields:
+            issuer = AuditableIdentity.deserialize(bytes(fields[1][0]))
+        return cls(
+            issuer=issuer,
+            outputs=[IssueOutputMetadata.deserialize(bytes(b))
+                     for b in fields.get(2, [])],
+        )
+
+
+@dataclass
+class TransferInputMetadata:
+    """driver/request.go:249-279."""
+
+    token_id: ID | None = None
+    senders: list[AuditableIdentity] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = b""
+        if self.token_id is not None:
+            id_msg = (pw.string_field(1, self.token_id.tx_id)
+                      + pw.uint64_field(2, self.token_id.index))
+            out += pw.message_field(1, id_msg)
+        for s in self.senders:
+            out += pw.message_field(2, s.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferInputMetadata":
+        fields = pw.parse_fields(raw)
+        token_id = None
+        if 1 in fields:
+            id_fields = pw.parse_fields(bytes(fields[1][0]))
+            token_id = ID(bytes(id_fields.get(1, [b""])[0]).decode(),
+                          id_fields.get(2, [0])[0])
+        return cls(
+            token_id=token_id,
+            senders=[AuditableIdentity.deserialize(bytes(b))
+                     for b in fields.get(2, [])],
+        )
+
+
+@dataclass
+class TransferOutputMetadata:
+    """driver/request.go:281-330."""
+
+    output_metadata: bytes = b""            # serialized TokenMetadata
+    output_audit_info: bytes = b""
+    receivers: list[AuditableIdentity] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = (pw.bytes_field(1, self.output_metadata)
+               + pw.bytes_field(2, self.output_audit_info))
+        for r in self.receivers:
+            out += pw.message_field(3, r.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferOutputMetadata":
+        fields = pw.parse_fields(raw)
+        return cls(
+            output_metadata=bytes(fields.get(1, [b""])[0]),
+            output_audit_info=bytes(fields.get(2, [b""])[0]),
+            receivers=[AuditableIdentity.deserialize(bytes(b))
+                       for b in fields.get(3, [])],
+        )
+
+
+@dataclass
+class TransferActionMetadata:
+    """driver/request.go TransferMetadata: per-input + per-output info."""
+
+    inputs: list[TransferInputMetadata] = field(default_factory=list)
+    outputs: list[TransferOutputMetadata] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = b""
+        for i in self.inputs:
+            out += pw.message_field(1, i.serialize())
+        for o in self.outputs:
+            out += pw.message_field(2, o.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferActionMetadata":
+        fields = pw.parse_fields(raw)
+        return cls(
+            inputs=[TransferInputMetadata.deserialize(bytes(b))
+                    for b in fields.get(1, [])],
+            outputs=[TransferOutputMetadata.deserialize(bytes(b))
+                     for b in fields.get(2, [])],
+        )
+
+
+@dataclass
+class RequestMetadata:
+    """Token-request metadata: one entry per action, in request order
+    (driver.TokenRequestMetadata)."""
+
+    issues: list[IssueActionMetadata] = field(default_factory=list)
+    transfers: list[TransferActionMetadata] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = b""
+        for i in self.issues:
+            out += pw.message_field(1, i.serialize())
+        for t in self.transfers:
+            out += pw.message_field(2, t.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "RequestMetadata":
+        fields = pw.parse_fields(raw)
+        return cls(
+            issues=[IssueActionMetadata.deserialize(bytes(b))
+                    for b in fields.get(1, [])],
+            transfers=[TransferActionMetadata.deserialize(bytes(b))
+                       for b in fields.get(2, [])],
+        )

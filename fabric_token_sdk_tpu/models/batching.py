@@ -1,0 +1,38 @@
+"""Shared batch/shape bucketing policy for the device verifier kernels.
+
+Every device entry point pads its batch and term axes up to a small fixed
+set of bucket sizes so the whole framework compiles a handful of XLA
+executables total (first compiles are minutes; the persistent cache then
+serves every run). Both the range verifier and the audit reopen use this
+module so the policy cannot drift between kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Batch-dimension buckets: every request size pads up to one of these.
+B_BUCKETS = (16, 128, 1024, 4096)
+
+
+def bucket_rows(b: int) -> int:
+    for cap in B_BUCKETS:
+        if b <= cap:
+            return cap
+    return ((b + B_BUCKETS[-1] - 1) // B_BUCKETS[-1]) * B_BUCKETS[-1]
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def pad_rows(arr: np.ndarray, b_target: int, pad_row: np.ndarray) -> np.ndarray:
+    """Pad the batch axis to the bucket size by repeating `pad_row`."""
+    B = arr.shape[0]
+    if B == b_target:
+        return arr
+    pad = np.broadcast_to(pad_row, (b_target - B,) + arr.shape[1:])
+    return np.concatenate([arr, pad], axis=0)

@@ -48,6 +48,9 @@ from ..crypto import bn254, rp
 from ..crypto import serialization as ser
 from ..crypto.bn254 import fr_add, fr_inv, fr_mul, fr_sub, hash_to_zr
 from ..ops import ec, limbs
+from .batching import bucket_rows as _bucket_rows
+from .batching import next_pow2 as _next_pow2
+from .batching import pad_rows as _pad_rows
 
 R = bn254.R
 
@@ -92,17 +95,26 @@ def affine_batch_to_bytes(arr: np.ndarray) -> np.ndarray:
 # compile superlinearly; split, each compiles in seconds and the persistent
 # cache reuses them across runs.
 _tables_kernel = jax.jit(ec.fixed_base_tables)
-_rgp_kernel = jax.jit(ec.fixed_base_gather)
 _affine_rows_kernel = jax.jit(ec.to_affine_batch)
 _affine_kernel = jax.jit(ec.to_affine)
 
 
 @jax.jit
-def _k_pass_kernel(k_tables, k_fixed_sc, dc_pts, dc_sc):
-    """K = fixed-base part + x*D + C, per proof: (B, 3, 16)."""
-    fixed = ec.fixed_base_msm(k_tables, k_fixed_sc)
+def _k_pass_kernel(tables, k_idx, k_fixed_sc, dc_pts, dc_sc):
+    """K = fixed-base part + x*D + C, per proof: (B, 3, 16).
+
+    The K-equation generators are gathered from the full table set inside
+    the jit (k_idx) so no second device-resident copy of the tables exists.
+    """
+    fixed = ec.fixed_base_msm(jnp.take(tables, k_idx, axis=0), k_fixed_sc)
     var = ec.msm_windowed(dc_pts, dc_sc)
     return ec.add(fixed, var)
+
+
+@jax.jit
+def _rgp_gather_kernel(tables, rgp_idx, scalars):
+    """Right-generator fold: gather H_i tables in-jit, then per-term mul."""
+    return ec.fixed_base_gather(jnp.take(tables, rgp_idx, axis=0), scalars)
 
 
 @jax.jit
@@ -144,8 +156,8 @@ class RangeVerifierParams:
     Q: object
     commitment_gen: list    # [cg0, cg1] (pedersen_generators[1:3])
     tables: jnp.ndarray     # (2n+5, 32, 256, 3, 16) all generators
-    k_tables: jnp.ndarray   # (n+2, 32, 256, 3, 16): H_i ++ [P, S_G]
-    rgp_tables: jnp.ndarray  # (n, 32, 256, 3, 16): H_i
+    k_idx: jnp.ndarray      # (n+2,) indexes of H_i ++ [P, S_G] into tables
+    rgp_idx: jnp.ndarray    # (n,) indexes of H_i into tables
     # precomputed transcript prefix: bytes of right_gen' are per-proof, but
     # left_gen ++ [Q] bytes are pp constants.
     left_gen_bytes: tuple
@@ -173,8 +185,8 @@ class RangeVerifierParams:
             Q=rpp.Q,
             commitment_gen=list(pp.pedersen_generators[1:3]),
             tables=tables,
-            k_tables=jnp.take(tables, jnp.asarray(k_idx), axis=0),
-            rgp_tables=tables[n : 2 * n],
+            k_idx=jnp.asarray(k_idx),
+            rgp_idx=jnp.arange(n, 2 * n),
             left_gen_bytes=tuple(
                 ser.g1_to_bytes(p).hex().encode("ascii")
                 for p in rpp.left_generators),
@@ -188,19 +200,20 @@ _PARAMS_CACHE: dict = {}
 
 
 def _params_for(pp) -> RangeVerifierParams:
+    """Key on a digest of EVERY generator baked into the tables — two pp
+    sets differing in any generator must never share cached tables."""
+    import hashlib
+
     rpp = pp.range_proof_params
-    key = (rpp.bit_length, ser.g1_to_bytes(rpp.P),
-           ser.g1_to_bytes(pp.pedersen_generators[1]))
+    h = hashlib.sha256()
+    for p in ([rpp.P, rpp.Q] + list(rpp.left_generators)
+              + list(rpp.right_generators)
+              + list(pp.pedersen_generators[1:3])):
+        h.update(ser.g1_to_bytes(p))
+    key = (rpp.bit_length, h.digest())
     if key not in _PARAMS_CACHE:
         _PARAMS_CACHE[key] = RangeVerifierParams.from_pp(pp)
     return _PARAMS_CACHE[key]
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
 
 
 def _pad_terms(pts: np.ndarray, sc: np.ndarray, t_target: int):
@@ -215,27 +228,6 @@ def _pad_terms(pts: np.ndarray, sc: np.ndarray, t_target: int):
     pad_sc = np.zeros((B, t_target - T, limbs.NLIMBS), dtype=np.uint32)
     return (np.concatenate([pts, pad_pts], axis=1),
             np.concatenate([sc, pad_sc], axis=1))
-
-
-# Batch-dimension buckets: every request size pads up to one of these so the
-# device kernels compile for a handful of shapes total.
-_B_BUCKETS = (16, 128, 1024, 4096)
-
-
-def _bucket_rows(b: int) -> int:
-    for cap in _B_BUCKETS:
-        if b <= cap:
-            return cap
-    return ((b + _B_BUCKETS[-1] - 1) // _B_BUCKETS[-1]) * _B_BUCKETS[-1]
-
-
-def _pad_rows(arr: np.ndarray, b_target: int, pad_row: np.ndarray) -> np.ndarray:
-    """Pad the batch axis to the bucket size by repeating `pad_row`."""
-    B = arr.shape[0]
-    if B == b_target:
-        return arr
-    pad = np.broadcast_to(pad_row, (b_target - B,) + arr.shape[1:])
-    return np.concatenate([arr, pad], axis=0)
 
 
 def _structure_ok(proof: rp.RangeProof, rounds: int) -> bool:
@@ -418,7 +410,6 @@ class BatchRangeVerifier:
         """
         params = self.params
         n = params.bit_length
-        r = params.rounds
         B = len(proofs)
         if B == 0:
             return np.zeros(0, dtype=bool)
@@ -454,9 +445,11 @@ class BatchRangeVerifier:
              for i in live])
         dc_sc = jnp.asarray(_pad_rows(dc_sc_np, b_bucket, zero_sc))
 
-        rgp_aff = _affine_rows_kernel(_rgp_kernel(params.rgp_tables, yinv))
+        rgp_aff = _affine_rows_kernel(
+            _rgp_gather_kernel(params.tables, params.rgp_idx, yinv))
         k_aff = _affine_kernel(
-            _k_pass_kernel(params.k_tables, k_fixed, dc_pts, dc_sc))
+            _k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
+                           dc_sc))
         rgp_bytes = affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
         k_bytes = affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
 
@@ -516,9 +509,10 @@ class BatchRangeVerifier:
             var_pts.extend(pts)
             var_sc.extend(fr_mul(w, s) for w, s in zip(weights, eq.var))
 
-        # pad the variable MSM to a bucketed size (multiple of 128)
+        # pad the variable MSM to a power-of-two bucket so varying live
+        # batch sizes reuse a handful of compiled kernel shapes
         v = len(var_pts)
-        v_target = max(128, ((v + 127) // 128) * 128)
+        v_target = _next_pow2(max(128, v))
         pts_np = limbs.points_to_projective_limbs(
             var_pts + [bn254.G1_IDENTITY] * (v_target - v))
         sc_np = limbs.scalars_to_limbs(var_sc + [0] * (v_target - v))

@@ -26,14 +26,19 @@ class AuditorNode(TokenNode):
     def __init__(self, *args, audit_check=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.auditdb = AuditDB(":memory:")
-        # audit_check(tx) -> None: driver-specific inspection. fabtoken has
-        # plaintext actions (nothing to open); zkatdlog plugs the
-        # commitment-reopen batch check here (crypto/audit/auditor.go:135).
+        # audit_check(tx) -> None: optional extra inspection hook; the
+        # driver-specific check (zkatdlog commitment-reopen batch,
+        # crypto/audit/auditor.go:135) runs via self.driver.audit_check.
         self.audit_check = audit_check
 
     # responder view (ttx/auditor.go:265-282 AuditApproveView)
     def audit(self, tx: Transaction) -> bytes:
-        # 1. validate (auditor/auditor.go:73: Validate -> Request.AuditCheck)
+        # 1. validate (auditor/auditor.go:73: Validate -> Request.AuditCheck
+        #    -> driver AuditorService.AuditorCheck)
+        try:
+            self.driver.audit_check(tx.request, tx.metadata, None, tx.tx_id)
+        except Exception as e:
+            raise AuditError(f"audit check failed: {e}") from e
         if self.audit_check is not None:
             try:
                 self.audit_check(tx)

@@ -1,0 +1,60 @@
+"""Owner wallets: who a node is, per token, when receiving and spending.
+
+The node-side slice of the reference identity/wallet registry
+(token/services/identity/wallet, role.Owner): a wallet answers
+  - recipient_identity(): the identity to put on an output destined to me
+    (+ its audit info) — for x509 a stable public key, for Idemix a FRESH
+    pseudonym per call (recipients.go exchange semantics);
+  - owns(owner_raw): is this on-ledger identity mine (ownership resolution
+    at ingestion, tokens.go:64-129);
+  - sign(owner_raw, message): endorse a spend of the token owned by
+    owner_raw (ttx/endorse.go:719 signing view);
+  - audit_info_for(owner_raw): sender-side audit info for the request
+    metadata (km.go NymEID audit info / x509 equality convention).
+"""
+
+from __future__ import annotations
+
+from .idemix import IdemixKeyManager
+from .x509 import X509KeyPair
+
+
+class X509OwnerWallet:
+    """Long-term-key wallet: one stable, linkable owner identity."""
+
+    def __init__(self, keys: X509KeyPair):
+        self.keys = keys
+
+    def recipient_identity(self) -> tuple[bytes, bytes]:
+        ident = bytes(self.keys.identity)
+        return ident, ident
+
+    def owns(self, owner_raw: bytes) -> bool:
+        return bytes(owner_raw) == bytes(self.keys.identity)
+
+    def sign(self, owner_raw: bytes, message: bytes) -> bytes:
+        return self.keys.sign(message)
+
+    def audit_info_for(self, owner_raw: bytes) -> bytes:
+        return bytes(owner_raw)
+
+
+class IdemixOwnerWallet:
+    """Pseudonymous wallet: unlinkable fresh identity per receipt."""
+
+    def __init__(self, km: IdemixKeyManager):
+        self.km = km
+
+    def recipient_identity(self) -> tuple[bytes, bytes]:
+        p = self.km.fresh_pseudonym()
+        raw = bytes(p.identity())
+        return raw, self.km.audit_info(raw)
+
+    def owns(self, owner_raw: bytes) -> bool:
+        return self.km.owns(owner_raw)
+
+    def sign(self, owner_raw: bytes, message: bytes) -> bytes:
+        return self.km.sign(owner_raw, message)
+
+    def audit_info_for(self, owner_raw: bytes) -> bytes:
+        return self.km.audit_info(owner_raw)

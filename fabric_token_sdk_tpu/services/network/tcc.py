@@ -13,6 +13,7 @@ RW set atomically with MVCC conflict detection against the read set.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 
@@ -65,7 +66,16 @@ class MemoryLedger:
     def _emit(self, ev: CommitEvent) -> None:
         self.blocks.append(ev)
         for listener in list(self.listeners):
-            listener(ev)
+            # Listener isolation (network/common/finality.go listener
+            # manager semantics): one node failing to ingest a commit —
+            # e.g. fed a malformed opening by a misbehaving peer — must
+            # not starve the other nodes of the finality event, and must
+            # never unwind the already-committed ledger state.
+            try:
+                listener(ev)
+            except Exception:
+                logging.getLogger("fabric_token_sdk_tpu.ledger").exception(
+                    "finality listener failed for tx [%s]", ev.tx_id)
 
     def add_finality_listener(self, listener) -> None:
         self.listeners.append(listener)

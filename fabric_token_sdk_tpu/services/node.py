@@ -3,21 +3,21 @@
 The standalone equivalent of an FSC node with the token SDK installed
 (reference token/sdk/dig/sdk.go:84 wires the same pieces): signing identity,
 wallets, token store, transaction store, selector, tokens-ingestion service,
-and views for the ttx choreography (sign/audit/issue/transfer/redeem).
-Nodes share a MemoryLedger + TokenChaincode (the ledger consensus plane) and
-a SessionBus (the view/session plane).
+driver services (fabtoken plaintext or zkatdlog ZK), and views for the ttx
+choreography (sign/audit/issue/transfer/redeem). Nodes share a MemoryLedger
++ TokenChaincode (the ledger consensus plane) and a SessionBus (the
+view/session plane).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
 
+from ..core.fabtoken.driver import FabTokenDriverService, OutputSpec
 from ..driver import TokenRequest
 from ..token import quantity as q
 from ..token.model import ID
-from .db.sqldb import (AuditDB, TokenDB, TokenLockDB, TransactionDB,
-                       TxRecord, TxStatus)
+from .db.sqldb import TokenDB, TokenLockDB, TransactionDB, TxRecord, TxStatus
 from .selector import SherdLockSelector
 from .tokens import Tokens
 from .ttx import SessionBus, Transaction, TtxError, collect_endorsements, \
@@ -29,8 +29,9 @@ class TokenNode:
 
     def __init__(self, name: str, keys, bus: SessionBus, chaincode,
                  precision: int = 64, auditor_name: str | None = None,
-                 action_module=None):
-        from ..core.fabtoken import actions as fabtoken_actions
+                 driver=None, db_path_prefix: str | None = None,
+                 owner_wallet=None):
+        from .identity.wallet import X509OwnerWallet
 
         self.name = name
         self.keys = keys
@@ -38,38 +39,66 @@ class TokenNode:
         self.cc = chaincode
         self.precision = precision
         self.auditor_name = auditor_name
-        self.actions = action_module or fabtoken_actions
+        self.driver = driver or FabTokenDriverService(precision)
+        # how this node RECEIVES and SPENDS tokens: stable x509 key by
+        # default, per-tx Idemix pseudonyms when configured
+        self.owner_wallet = owner_wallet or X509OwnerWallet(keys)
 
-        self.tokendb = TokenDB(":memory:")
-        self.ttxdb = TransactionDB(":memory:")
-        self.lockdb = TokenLockDB(":memory:")
+        def _db(which: str) -> str:
+            if db_path_prefix is None:
+                return ":memory:"
+            return f"{db_path_prefix}.{which}.sqlite"
+
+        self.tokendb = TokenDB(_db("tokens"))
+        self.ttxdb = TransactionDB(_db("ttx"))
+        self.lockdb = TokenLockDB(_db("locks"))
         self.selector = SherdLockSelector(self.tokendb, self.lockdb,
                                           precision=precision)
-        self.tokens = Tokens(self.tokendb, self._ownership)
+        self.tokens = Tokens(self.tokendb, self._ownership,
+                             extractor=self.driver.extract_outputs)
         bus.register(name, self)
         chaincode.ledger.add_finality_listener(self._on_commit)
         # txs this node assembled or endorsed: refresh ttxdb on finality
         self._watched: dict[str, TokenRequest] = {}
+        # openings received at distribution time, keyed by tx then global
+        # output index (ttx/endorse.go:444; consumed at finality)
+        self._pending_openings: dict[str, dict[int, bytes]] = {}
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:
-        return [self.name] if owner_raw == bytes(self.keys.identity) else []
+        return [self.name] if self.owner_wallet.owns(owner_raw) else []
 
     def identity(self) -> bytes:
         return bytes(self.keys.identity)
+
+    def recipient_identity(self) -> tuple[bytes, bytes]:
+        """Recipient-exchange responder view (ttx/recipients.go): the
+        identity to make an output to + its audit info. Fresh per call for
+        pseudonymous wallets."""
+        return self.owner_wallet.recipient_identity()
 
     def balance(self, token_type: str) -> int:
         return self.tokendb.balance(self.name, token_type)
 
     # ------------------------------------------------- responder views (ttx)
-    def sign_transfer(self, tx_id: str, message: bytes) -> bytes:
-        """Owner-side endorsement view (ttx/endorse.go:719-726)."""
-        sigma = self.keys.sign(message)
+    def sign_transfer(self, tx_id: str, message: bytes,
+                      owner_raw: bytes | None = None) -> bytes:
+        """Owner-side endorsement view (ttx/endorse.go:719-726): sign as
+        the identity that owns the spent input (a pseudonym for Idemix
+        wallets)."""
+        if owner_raw is None:
+            owner_raw = self.identity()
+        sigma = self.owner_wallet.sign(owner_raw, message)
         self.ttxdb.add_endorsement_ack(tx_id, self.identity(), sigma)
         return sigma
 
     def sign_issue(self, tx_id: str, message: bytes) -> bytes:
         return self.keys.sign(message)
+
+    def receive_opening(self, tx_id: str, index: int, opening: bytes) -> None:
+        """Distribution responder: remember the opening of output `index`
+        until finality ingestion (recipients.go semantics)."""
+        self._pending_openings.setdefault(tx_id, {})[index] = opening
 
     def audit(self, tx: Transaction) -> bytes:
         """Auditor-side view (ttx/auditor.go:265; auditor service semantics
@@ -80,22 +109,28 @@ class TokenNode:
     # ------------------------------------------------- initiator views (ttx)
     def issue(self, issuer_node: str, to_node: str, token_type: str,
               amount_hex: str) -> Transaction:
-        """Withdrawal flow: ask the issuer node to issue to `to_node`."""
+        """Withdrawal flow: ask the issuer node to issue to `to_node`
+        (token/request.go:225 via the Request builder)."""
+        from ..token.request_builder import Request
+
         issuer = self.bus.node(issuer_node)
-        recipient = self.bus.node(to_node)
-        action = self.actions.IssueAction(
-            issuer=issuer.keys.identity,
-            outputs=[self.actions.Output(
-                owner=recipient.identity(), type=token_type,
-                quantity=amount_hex)],
-        )
-        tx = Transaction(tx_id=Transaction.new_anchor(),
-                         request=TokenRequest(issues=[action.serialize()]),
-                         issuer_node=issuer_node)
+        recipient_owner, recipient_ai = \
+            self.bus.node(to_node).recipient_identity()
+        value = int(amount_hex, 16)
+        tx_id = Transaction.new_anchor()
+        req = Request(tx_id, self.driver)
+        req.issue(bytes(issuer.keys.identity),
+                  [OutputSpec(owner=recipient_owner, token_type=token_type,
+                              value=value, audit_info=recipient_ai)],
+                  receivers=[to_node])
+        tx = Transaction(tx_id=tx_id, request=req.token_request(),
+                         issuer_node=issuer_node,
+                         metadata=req.request_metadata(),
+                         distribution=req.distribution())
         tx.records.append(TxRecord(
             tx_id=tx.tx_id, action_type="issue", sender="",
             recipient=to_node, token_type=token_type,
-            amount=int(amount_hex, 16), status=TxStatus.PENDING,
+            amount=value, status=TxStatus.PENDING,
             timestamp=time.time()))
         return tx
 
@@ -103,34 +138,40 @@ class TokenNode:
                  redeem: bool = False) -> Transaction:
         """Assemble a transfer spending this node's tokens
         (token/request.go:287 prepareTransfer + driver Transfer)."""
+        from ..token.request_builder import Request
+
         tx_id = Transaction.new_anchor()
         selection = self.selector.select(self.name, token_type, amount_hex,
                                          tx_id)
         target = q.to_quantity(amount_hex, self.precision).value
         change = selection.sum - target
-        recipient_owner = b"" if redeem else \
-            self.bus.node(to_node).identity()
-        outputs = [self.actions.Output(owner=recipient_owner,
-                                       type=token_type,
-                                       quantity=hex(target))]
+        recipient_owner, recipient_ai = (b"", b"") if redeem else \
+            self.bus.node(to_node).recipient_identity()
+        specs = [OutputSpec(owner=recipient_owner, token_type=token_type,
+                            value=target, audit_info=recipient_ai)]
+        receivers = [None if redeem else to_node]
         if change > 0:
-            outputs.append(self.actions.Output(
-                owner=self.identity(), type=token_type,
-                quantity=hex(change)))
-        input_tokens = []
-        for tok in selection.tokens:
-            input_tokens.append(self.actions.Output(
-                owner=bytes(tok.owner), type=tok.type,
-                quantity=tok.quantity))
-        action = self.actions.TransferAction(
-            inputs=[t.id for t in selection.tokens],
-            input_tokens=input_tokens,
-            outputs=outputs,
-        )
+            change_owner, change_ai = self.owner_wallet.recipient_identity()
+            specs.append(OutputSpec(owner=change_owner,
+                                    token_type=token_type, value=change,
+                                    audit_info=change_ai))
+            receivers.append(self.name)
+        req = Request(tx_id, self.driver)
+        try:
+            req.transfer(selection.tokens, specs,
+                         wallet=self.tokendb.get_ledger_token,
+                         sender_audit_info=self.owner_wallet.audit_info_for,
+                         receivers=receivers)
+        except Exception:
+            self.selector.unselect(tx_id)
+            raise
         tx = Transaction(
             tx_id=tx_id,
-            request=TokenRequest(transfers=[action.serialize()]),
+            request=req.token_request(),
             input_owners=[self.name] * len(selection.tokens),
+            input_owner_ids=req.input_owner_ids(),
+            metadata=req.request_metadata(),
+            distribution=req.distribution(),
         )
         tx.records.append(TxRecord(
             tx_id=tx_id, action_type="redeem" if redeem else "transfer",
@@ -155,37 +196,46 @@ class TokenNode:
     def _on_commit(self, ev) -> None:
         """network/common/finality.go:57-121 + tokens.Append (SURVEY §3.5).
 
-        Every node observes every commit; it ingests outputs owned by it.
+        Every node observes every commit; it ingests outputs owned by it
+        (for commitment drivers: outputs it holds an opening for).
         """
         if ev.status != "VALID":
             self.ttxdb.set_status(ev.tx_id, TxStatus.DELETED, ev.message)
+            self._pending_openings.pop(ev.tx_id, None)
             return
         raw = self.cc.ledger.get_state(
             self.cc.keys.token_request_key(ev.tx_id))
         if raw is None:
             return  # genesis/setup
+        openings = self._pending_openings.pop(ev.tx_id, {})
         request_raw = self._watched.get(ev.tx_id)
         if request_raw is None:
             # fetch from a peer that assembled it (finality.go:65-121 fetch
             # escalation); standalone: read tokens directly from the ledger
-            self._ingest_from_ledger(ev.tx_id)
+            self._ingest_from_ledger(ev.tx_id, openings)
         else:
             actions = self.cc.validator.unmarshal_actions(
                 request_raw.to_bytes())
-            self.tokens.append_transaction(ev.tx_id, actions)
+            self.tokens.append_transaction(ev.tx_id, actions, openings)
         self.ttxdb.set_status(ev.tx_id, TxStatus.CONFIRMED)
 
-    def _ingest_from_ledger(self, tx_id: str) -> None:
+    def _ingest_from_ledger(self, tx_id: str,
+                            openings: dict[int, bytes]) -> None:
         """Scan ledger outputs of tx_id (processor.go:40 RW-set indexing)."""
         idx = 0
         while True:
             raw = self.cc.ledger.get_state(self.cc.keys.output_key(tx_id, idx))
             if raw is None:
                 break
-            out = self.actions.Output.deserialize(raw)
-            owners = self._ownership(out.owner)
-            self.tokendb.store_token(ID(tx_id, idx), out.owner, out.type,
-                                     out.quantity, owners)
+            out = self.driver.parse_ledger_output(raw, openings.get(idx))
+            if out is not None and out.owner_raw:
+                owners = self._ownership(out.owner_raw)
+                self.tokendb.store_token(
+                    ID(tx_id, idx), out.owner_raw, out.token_type,
+                    out.quantity_hex, owners,
+                    ledger_format=out.ledger_format,
+                    ledger_token=out.ledger_token,
+                    ledger_metadata=out.ledger_metadata)
             idx += 1
         # mark spent inputs: any of my unspent tokens no longer on ledger
         for tok in self.tokendb.unspent_tokens(self.name):

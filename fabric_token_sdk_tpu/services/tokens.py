@@ -32,23 +32,34 @@ class Tokens:
     """tokens.go Tokens service bound to one TMS's tokendb."""
 
     def __init__(self, tokendb: TokenDB,
-                 ownership: Callable[[bytes], list[str]]):
+                 ownership: Callable[[bytes], list[str]],
+                 extractor: Callable | None = None):
         """ownership maps an owner identity to wallet IDs (tokens.go:64-129
-        ownership resolution via authorization mux)."""
+        ownership resolution via authorization mux); extractor is the
+        driver's Deobfuscate — ``extractor(action, openings) ->
+        list[ExtractedOutput]`` with per-action local opening indexes
+        (zkatdlog v1/tokens.go:111; plaintext default below)."""
         self.db = tokendb
         self.ownership = ownership
+        self.extractor = extractor or self._extract_plaintext
 
-    def append_transaction(self, tx_id: str, actions: list) -> None:
+    def append_transaction(self, tx_id: str, actions: list,
+                           openings: dict[int, bytes] | None = None) -> None:
         """Ingest the verified actions of a committed transaction
-        (tokens.go:171-238)."""
+        (tokens.go:171-238). ``openings`` maps GLOBAL output index (across
+        all actions, in order) to the serialized opening this node received
+        at distribution time."""
+        openings = openings or {}
         base = 0
         for action in actions:
-            outputs = self._extract_outputs(action)
+            n_out = len(action.get_outputs())
+            local = {i: openings[base + i] for i in range(n_out)
+                     if base + i in openings}
+            outputs = self.extractor(action, local)
             for out in outputs:
                 owners = self.ownership(out.owner_raw)
                 if not out.owner_raw:
-                    base += 1
-                    continue  # redeem output: not stored
+                    continue  # redeem/opaque output: not stored
                 self.db.store_token(
                     ID(tx_id, base + out.index), out.owner_raw,
                     out.token_type, out.quantity_hex, owners,
@@ -57,14 +68,11 @@ class Tokens:
                     ledger_metadata=out.ledger_metadata)
             for input_id in action.get_inputs():
                 self.db.delete_token(input_id, spent_by=tx_id)
-            base += len(outputs)
+            base += n_out
 
     @staticmethod
-    def _extract_outputs(action) -> list[ExtractedOutput]:
-        """Deobfuscate equivalent: plaintext actions expose typed outputs
-        directly; commitment actions carry clear values in metadata and are
-        deobfuscated by the zkatdlog TokensService wrapper before reaching
-        here (zkatdlog v1/tokens.go:111)."""
+    def _extract_plaintext(action, openings=None) -> list[ExtractedOutput]:
+        """Plaintext actions expose typed outputs directly."""
         outs = []
         for i, out in enumerate(action.get_outputs()):
             outs.append(ExtractedOutput(

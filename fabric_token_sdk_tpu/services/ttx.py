@@ -34,9 +34,20 @@ class Transaction:
     # client-side bookkeeping: which signer nodes own each transfer input,
     # populated at assembly time (mirror of TokenRequest metadata)
     input_owners: list[str] = field(default_factory=list)
+    # raw on-ledger owner identity per transfer input (a pseudonym for
+    # Idemix wallets) — tells the signing node WHICH identity must endorse
+    input_owner_ids: list[bytes] = field(default_factory=list)
     issuer_node: str | None = None
     # record stream for ttxdb
     records: list[TxRecord] = field(default_factory=list)
+    # request metadata (commitment openings + audit info for commitment
+    # drivers; None for plaintext drivers). Never reaches the ledger: it
+    # flows over sessions to the auditor and — per-output — to receivers.
+    metadata: object | None = None
+    # opening distribution plan: (recipient node, global output index,
+    # serialized opening), computed at assembly time
+    # (ttx/endorse.go:444 distributeEnvToParties).
+    distribution: list[tuple[str, int, bytes]] = field(default_factory=list)
 
     @staticmethod
     def new_anchor() -> str:
@@ -87,9 +98,10 @@ def collect_endorsements(tx: Transaction, bus: SessionBus,
         responder = bus.node(tx.issuer_node)
         sigma = responder.sign_issue(tx.tx_id, msg)
         tx.request.signatures.append(sigma)
-    for owner_name in tx.input_owners:
+    for i, owner_name in enumerate(tx.input_owners):
         responder = bus.node(owner_name)
-        sigma = responder.sign_transfer(tx.tx_id, msg)
+        owner_raw = tx.input_owner_ids[i] if tx.input_owner_ids else None
+        sigma = responder.sign_transfer(tx.tx_id, msg, owner_raw)
         tx.request.signatures.append(sigma)
 
     # 2. request audit (endorse.go:409; ttx/auditor.go:128-254)
@@ -97,6 +109,12 @@ def collect_endorsements(tx: Transaction, bus: SessionBus,
         auditor = bus.node(auditor_node)
         sigma = auditor.audit(tx)
         tx.request.auditor_signatures.append(sigma)
+
+    # 3. distribute openings to output receivers (endorse.go:444
+    # distributeEnvToParties): each receiver learns the openings of the
+    # outputs destined to it so it can ingest them at finality.
+    for node_name, index, opening_raw in tx.distribution:
+        bus.node(node_name).receive_opening(tx.tx_id, index, opening_raw)
 
 
 def ordering_and_finality(tx: Transaction, chaincode,

@@ -1,0 +1,143 @@
+"""token.Request builder: the application-facing action assembler.
+
+Behavioral mirror of reference token/request.go: a Request accumulates
+Issue/Transfer/Redeem actions (request.go:225,287,341) together with their
+metadata, produces the serialized driver TokenRequest and the
+message-to-sign (request.go:968 MarshalToSign), and runs the auditor-side
+AuditCheck (request.go:1145) through the driver's audit service.
+
+The heavy lifting per action is delegated to the driver services bound at
+construction (fabtoken plaintext or zkatdlog ZK) — the same layering as the
+reference, where Request methods call into the driver's
+IssueService/TransferService.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..driver.request import TokenRequest
+
+
+class RequestBuilderError(Exception):
+    pass
+
+
+@dataclass
+class _PlannedOutput:
+    """Distribution bookkeeping: which action's output goes to whom.
+
+    Global output indexes are resolved at read time (``distribution()``)
+    because ingestion numbers outputs issues-first then transfers
+    (core/common/validator.py unmarshal order), regardless of the order the
+    builder methods were called in.
+    """
+
+    kind: str                 # "issue" | "transfer"
+    action_pos: int           # position within that kind's list
+    local_index: int          # output index within the action
+    receiver: object
+    opening: bytes | None
+
+
+class Request:
+    """One token request under assembly, bound to an anchor + driver."""
+
+    def __init__(self, anchor: str, driver_services):
+        self.anchor = anchor
+        self.driver = driver_services
+        self._issues: list = []           # (action, metadata | None)
+        self._transfers: list = []
+        self._planned: list[_PlannedOutput] = []
+        self._input_owner_ids: list[bytes] = []
+
+    # ------------------------------------------------------------- builders
+    def issue(self, issuer_identity: bytes, outputs,
+              receivers: list | None = None) -> object:
+        """request.go:225 Issue: append one issue action.
+
+        outputs: list[OutputSpec]; receivers: parallel opaque receiver tags
+        (e.g. node names) recorded in the distribution plan.
+        """
+        action, md = self.driver.assemble_issue(issuer_identity, outputs)
+        self._plan_outputs("issue", len(self._issues), md, outputs, receivers)
+        self._issues.append((action, md))
+        return action
+
+    def transfer(self, input_rows, outputs, wallet=None,
+                 sender_audit_info=None, receivers: list | None = None
+                 ) -> object:
+        """request.go:287 Transfer / :341 Redeem (a redeem is a transfer
+        whose output has an empty owner)."""
+        action, md = self.driver.assemble_transfer(
+            input_rows, outputs, wallet=wallet,
+            sender_audit_info=sender_audit_info)
+        self._plan_outputs("transfer", len(self._transfers), md, outputs,
+                           receivers)
+        self._transfers.append((action, md))
+        self._input_owner_ids.extend(bytes(r.owner) for r in input_rows)
+        return action
+
+    def _plan_outputs(self, kind, action_pos, md, outputs, receivers) -> None:
+        for i, spec in enumerate(outputs):
+            opening = None
+            if md is not None:
+                opening = md.outputs[i].output_metadata
+            receiver = receivers[i] if receivers else None
+            self._planned.append(_PlannedOutput(
+                kind=kind, action_pos=action_pos, local_index=i,
+                receiver=receiver, opening=opening))
+
+    def _global_index(self, p: _PlannedOutput) -> int:
+        """Issues-first numbering, matching ingestion order."""
+        base = 0
+        if p.kind == "issue":
+            for a, _ in self._issues[:p.action_pos]:
+                base += len(a.get_outputs())
+        else:
+            for a, _ in self._issues:
+                base += len(a.get_outputs())
+            for a, _ in self._transfers[:p.action_pos]:
+                base += len(a.get_outputs())
+        return base + p.local_index
+
+    # -------------------------------------------------------------- outputs
+    def token_request(self) -> TokenRequest:
+        """The wire-level driver request (request.go RequestToBytes)."""
+        return TokenRequest(
+            issues=[a.serialize() for a, _ in self._issues],
+            transfers=[a.serialize() for a, _ in self._transfers])
+
+    def request_metadata(self):
+        """driver.TokenRequestMetadata for commitment drivers, else None."""
+        issue_md = [md for _, md in self._issues]
+        transfer_md = [md for _, md in self._transfers]
+        if all(m is None for m in issue_md + transfer_md):
+            return None
+        from ..core.zkatdlog.metadata import RequestMetadata
+
+        return RequestMetadata(
+            issues=[m for m in issue_md if m is not None],
+            transfers=[m for m in transfer_md if m is not None])
+
+    def distribution(self) -> list[tuple[object, int, bytes]]:
+        """(receiver, global index, opening) triples for the ttx
+        distribution step (endorse.go:444)."""
+        return [(p.receiver, self._global_index(p), p.opening)
+                for p in self._planned
+                if p.receiver is not None and p.opening is not None]
+
+    def input_owner_ids(self) -> list[bytes]:
+        return list(self._input_owner_ids)
+
+    def marshal_to_sign(self) -> bytes:
+        """request.go:968 MarshalToSign: the bytes every endorser, the
+        issuer, and the auditor sign."""
+        return self.token_request().message_to_sign(self.anchor.encode())
+
+    # ------------------------------------------------------------- auditing
+    def audit_check(self, input_tokens=None) -> None:
+        """request.go:1145 AuditCheck -> driver AuditorService."""
+        self.driver.audit_check(self.token_request(),
+                                self.request_metadata(), input_tokens,
+                                self.anchor)

@@ -1,0 +1,76 @@
+"""Token API facade: ManagementService bound to one TMSID.
+
+Behavioral mirror of reference token/tms.go:32-185: the single entry point
+an application holds for one token management service instance — exposing
+the public-parameters manager, the validator, the driver services, and the
+request factory. ``GetManagementService`` (tms.go:185) maps to
+``TMSProvider.get_management_service`` in core/registry.py.
+"""
+
+from __future__ import annotations
+
+from .request_builder import Request
+
+
+class PublicParametersManager:
+    """token/ppm.go facade over the driver's pp (serialize / validate /
+    precision / auditors / issuers surface)."""
+
+    def __init__(self, pp):
+        self._pp = pp
+
+    def public_parameters(self):
+        return self._pp
+
+    def serialize(self) -> bytes:
+        return self._pp.serialize()
+
+    def validate(self) -> None:
+        self._pp.validate()
+
+    def precision(self) -> int:
+        rpp = getattr(self._pp, "range_proof_params", None)
+        if rpp is not None:
+            return rpp.bit_length
+        return self._pp.quantity_precision
+
+    def auditors(self) -> list[bytes]:
+        auditor = getattr(self._pp, "auditor", None)
+        return [bytes(auditor)] if auditor else []
+
+    def issuers(self) -> list[bytes]:
+        return [bytes(i) for i in getattr(self._pp, "issuer_ids", [])]
+
+
+class TokenManagementService:
+    """token.ManagementService (tms.go:32): facade over one driver bundle."""
+
+    def __init__(self, tmsid, bundle):
+        self.tmsid = tmsid
+        self._bundle = bundle
+        self._ppm = PublicParametersManager(bundle.public_params)
+
+    # ------------------------------------------------------------ accessors
+    def public_parameters_manager(self) -> PublicParametersManager:
+        return self._ppm
+
+    def validator(self):
+        """tms.go Validator() — the request verifier (TPU-batched for
+        zkatdlog when the bundle was built with device=True)."""
+        return self._bundle.validator
+
+    def deserializer(self):
+        return self._bundle.deserializer
+
+    def driver_services(self):
+        return self._bundle.services
+
+    @property
+    def label(self) -> str:
+        return self._bundle.label
+
+    # ------------------------------------------------------------- requests
+    def new_request(self, anchor: str) -> Request:
+        """token.NewRequest (tms.go/request.go:165): an empty request bound
+        to this TMS and anchor."""
+        return Request(anchor, self._bundle.services)

@@ -1,0 +1,19 @@
+"""Single source of truth for the JAX persistent-compile-cache policy.
+
+The limbed EC kernels trace to large graphs; first compiles take minutes on
+both backends. Every entry point (tests, bench, graft entry) funnels through
+configure_jax_cache so the policy cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def configure_jax_cache() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

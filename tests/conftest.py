@@ -21,6 +21,6 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the limbed EC kernels trace to large graphs
 # (256-step fori_loop bodies); caching makes re-runs cheap. Set via config,
 # not env — jax is already imported (sitecustomize), so env vars are too late.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache  # noqa: E402
+
+configure_jax_cache()

@@ -1,0 +1,136 @@
+"""Driver registry, TMSProvider resolution order, TMS facade, Request
+builder (reference token/core/service.go:29, token/core/tms.go:63-274,
+token/tms.go:32, token/request.go:225-341,968,1145)."""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.core.fabtoken.driver import OutputSpec
+from fabric_token_sdk_tpu.core.registry import (RegistryError, TMSID,
+                                                TMSProvider, default_registry)
+from fabric_token_sdk_tpu.crypto import setup as zk_setup
+
+
+@pytest.fixture(scope="module")
+def fab_pp_raw():
+    return fabtoken.setup(64).serialize()
+
+
+@pytest.fixture(scope="module")
+def zk_pp_raw():
+    return zk_setup.setup(16).serialize()
+
+
+def test_registry_dispatches_on_identifier(fab_pp_raw, zk_pp_raw):
+    reg = default_registry()
+    assert reg.labels() == ["fabtoken", "zkatdlog"]
+    b1 = reg.new_bundle(fab_pp_raw)
+    assert b1.label == "fabtoken"
+    assert b1.validator is not None and b1.services is not None
+    b2 = reg.new_bundle(zk_pp_raw)
+    assert b2.label == "zkatdlog"
+    assert b2.public_params.range_proof_params.bit_length == 16
+
+
+def test_registry_unknown_identifier(fab_pp_raw):
+    reg = default_registry()
+    with pytest.raises(RegistryError, match="no driver found"):
+        reg.new_bundle(b'{"identifier": "martian", "raw": ""}')
+    with pytest.raises(RegistryError, match="already registered"):
+        reg.register("fabtoken", lambda raw: None)
+
+
+def test_provider_resolution_order(fab_pp_raw, zk_pp_raw):
+    """opts -> storage -> fetcher (core/tms.go:207-274)."""
+    fetched = []
+
+    def fetcher(tmsid):
+        fetched.append(tmsid)
+        return fab_pp_raw if tmsid.network == "net-fetch" else None
+
+    prov = TMSProvider(default_registry(), fetcher=fetcher)
+
+    # 1. explicit opts win
+    tms = prov.get_management_service(TMSID("net-a"), pp_raw=zk_pp_raw)
+    assert tms.label == "zkatdlog"
+    # cached per TMSID
+    assert prov.get_management_service(TMSID("net-a")) is tms
+
+    # 2. storage
+    prov.store_public_params(TMSID("net-b"), fab_pp_raw)
+    assert prov.get_management_service(TMSID("net-b")).label == "fabtoken"
+
+    # 3. fetcher
+    assert prov.get_management_service(TMSID("net-fetch")).label == "fabtoken"
+    assert fetched == [TMSID("net-fetch")]
+
+    # unresolvable
+    with pytest.raises(RegistryError, match="cannot resolve"):
+        prov.get_management_service(TMSID("net-missing"))
+
+
+def test_provider_update_drops_cache(fab_pp_raw, zk_pp_raw):
+    prov = TMSProvider(default_registry())
+    tmsid = TMSID("net", "ch", "ns")
+    tms1 = prov.get_management_service(tmsid, pp_raw=fab_pp_raw)
+    assert tms1.label == "fabtoken"
+    prov.update(tmsid, zk_pp_raw)
+    tms2 = prov.get_management_service(tmsid)
+    assert tms2 is not tms1 and tms2.label == "zkatdlog"
+
+
+def test_tms_facade_surface(zk_pp_raw):
+    prov = TMSProvider(default_registry())
+    tms = prov.get_management_service(TMSID("net"), pp_raw=zk_pp_raw)
+    ppm = tms.public_parameters_manager()
+    ppm.validate()
+    assert ppm.precision() == 16
+    assert ppm.serialize() == tms.public_parameters_manager().serialize()
+    assert tms.validator() is not None
+    assert tms.deserializer() is not None
+
+
+def test_request_builder_fabtoken(fab_pp_raw):
+    prov = TMSProvider(default_registry())
+    tms = prov.get_management_service(TMSID("net"), pp_raw=fab_pp_raw)
+    req = tms.new_request("anchor-1")
+    req.issue(b"issuer-id", [OutputSpec(owner=b"alice", token_type="USD",
+                                        value=100)], receivers=["alice"])
+    tr = req.token_request()
+    assert len(tr.issues) == 1 and not tr.transfers
+    # plaintext driver: no metadata, no distribution
+    assert req.request_metadata() is None
+    assert req.distribution() == []
+    # message-to-sign covers the anchor
+    m1 = req.marshal_to_sign()
+    assert m1.endswith(b"anchor-1")
+    # audit check is a no-op for plaintext actions
+    req.audit_check()
+
+
+def test_request_builder_zkatdlog_with_audit(zk_pp_raw):
+    prov = TMSProvider(default_registry())
+    tms = prov.get_management_service(TMSID("net"), pp_raw=zk_pp_raw)
+    req = tms.new_request("anchor-2")
+    req.issue(b"issuer-id",
+              [OutputSpec(owner=b"alice", token_type="USD", value=10,
+                          audit_info=b"alice"),
+               OutputSpec(owner=b"bob", token_type="USD", value=20,
+                          audit_info=b"bob")],
+              receivers=["alice", "bob"])
+    md = req.request_metadata()
+    assert md is not None and len(md.issues) == 1
+    assert [(r, i) for r, i, _ in req.distribution()] == [("alice", 0),
+                                                          ("bob", 1)]
+    # the auditor-side check passes on honest metadata (request.go:1145)
+    req.audit_check(input_tokens=[])
+
+    # and rejects a tampered opening
+    from fabric_token_sdk_tpu.core.zkatdlog.metadata import TokenMetadata
+
+    opening = TokenMetadata.deserialize(
+        md.issues[0].outputs[0].output_metadata)
+    opening.value += 1
+    md.issues[0].outputs[0].output_metadata = opening.serialize()
+    with pytest.raises(Exception, match="opening"):
+        req.audit_check(input_tokens=[])

@@ -1,0 +1,168 @@
+"""zkatdlog auditor unit tests: batched commitment re-open + identity match.
+
+Mirror of reference crypto/audit/auditor_test.go: valid issue/transfer
+requests pass Check; a wrong opening, wrong audit info, or mismatched
+metadata count fails with the reference's first-failure ordering.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core.zkatdlog.actions import (ActionInput,
+                                                        IssueAction, Token,
+                                                        TransferAction)
+from fabric_token_sdk_tpu.core.zkatdlog.audit import AuditError, Auditor
+from fabric_token_sdk_tpu.core.zkatdlog.metadata import (
+    AuditableIdentity, IssueActionMetadata, IssueOutputMetadata,
+    RequestMetadata, TokenMetadata, TransferActionMetadata,
+    TransferInputMetadata, TransferOutputMetadata)
+from fabric_token_sdk_tpu.crypto import setup, token_commit
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.token.model import ID
+
+BIT_LENGTH = 16
+
+ISSUER = b"issuer-identity"
+ALICE = b"alice-identity"
+BOB = b"bob-identity"
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(BIT_LENGTH)
+
+
+@pytest.fixture(scope="module")
+def auditor(pp):
+    return Auditor(pp, device=True)
+
+
+def _issue_with_md(pp, values, owner=ALICE):
+    coms, wits = token_commit.get_tokens_with_witness(
+        values, "USD", pp.pedersen_generators)
+    action = IssueAction(
+        issuer=ISSUER,
+        outputs=[Token(owner=owner, data=c) for c in coms],
+        proof=b"p",
+    )
+    md = IssueActionMetadata(
+        issuer=AuditableIdentity(identity=ISSUER, audit_info=ISSUER),
+        outputs=[IssueOutputMetadata(
+            output_metadata=TokenMetadata(
+                token_type=w.token_type, value=w.value,
+                blinding_factor=w.blinding_factor,
+                issuer=ISSUER).serialize(),
+            receivers=[AuditableIdentity(identity=owner, audit_info=owner)])
+            for w in wits],
+    )
+    return action, md, coms, wits
+
+
+def test_issue_check_passes(pp, auditor):
+    action, md, _, _ = _issue_with_md(pp, [10, 20, 30])
+    req = TokenRequest(issues=[action.serialize()])
+    auditor.check(req, RequestMetadata(issues=[md]), [], "tx1")
+
+
+def test_issue_wrong_opening_rejected(pp, auditor):
+    action, md, _, wits = _issue_with_md(pp, [10, 20])
+    bad = TokenMetadata(token_type="USD", value=wits[1].value + 1,
+                        blinding_factor=wits[1].blinding_factor,
+                        issuer=ISSUER)
+    md.outputs[1].output_metadata = bad.serialize()
+    req = TokenRequest(issues=[action.serialize()])
+    with pytest.raises(AuditError, match=r"output at index \[1\]"):
+        auditor.check(req, RequestMetadata(issues=[md]), [], "tx2")
+
+
+def test_issue_wrong_type_rejected(pp, auditor):
+    action, md, _, wits = _issue_with_md(pp, [10])
+    bad = TokenMetadata(token_type="EUR", value=wits[0].value,
+                        blinding_factor=wits[0].blinding_factor)
+    md.outputs[0].output_metadata = bad.serialize()
+    req = TokenRequest(issues=[action.serialize()])
+    with pytest.raises(AuditError, match=r"output at index \[0\]"):
+        auditor.check(req, RequestMetadata(issues=[md]), [], "tx3")
+
+
+def test_issue_wrong_audit_info_rejected(pp, auditor):
+    action, md, _, _ = _issue_with_md(pp, [10])
+    md.outputs[0].receivers[0].audit_info = BOB  # owner is ALICE
+    req = TokenRequest(issues=[action.serialize()])
+    with pytest.raises(AuditError, match="does not match"):
+        auditor.check(req, RequestMetadata(issues=[md]), [], "tx4")
+
+
+def test_metadata_count_mismatch(pp, auditor):
+    action, md, _, _ = _issue_with_md(pp, [10])
+    req = TokenRequest(issues=[action.serialize()])
+    with pytest.raises(AuditError, match="number of issues"):
+        auditor.check(req, RequestMetadata(issues=[]), [], "tx5")
+
+
+def _transfer_with_md(pp, in_values, out_values):
+    in_coms, in_wits = token_commit.get_tokens_with_witness(
+        in_values, "USD", pp.pedersen_generators)
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        out_values, "USD", pp.pedersen_generators)
+    in_tokens = [Token(owner=ALICE, data=c) for c in in_coms]
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("prev", i), token=t)
+                for i, t in enumerate(in_tokens)],
+        outputs=[Token(owner=BOB, data=c) for c in out_coms],
+        proof=b"p",
+    )
+    md = TransferActionMetadata(
+        inputs=[TransferInputMetadata(
+            token_id=ID("prev", i),
+            senders=[AuditableIdentity(identity=ALICE, audit_info=ALICE)])
+            for i in range(len(in_tokens))],
+        outputs=[TransferOutputMetadata(
+            output_metadata=TokenMetadata(
+                token_type=w.token_type, value=w.value,
+                blinding_factor=w.blinding_factor).serialize(),
+            receivers=[AuditableIdentity(identity=BOB, audit_info=BOB)])
+            for w in out_wits],
+    )
+    return action, md, in_tokens
+
+
+def test_transfer_check_passes(pp, auditor):
+    action, md, in_tokens = _transfer_with_md(pp, [30], [10, 20])
+    req = TokenRequest(transfers=[action.serialize()])
+    auditor.check(req, RequestMetadata(transfers=[md]), [in_tokens], "tx6")
+
+
+def test_transfer_wrong_opening_rejected(pp, auditor):
+    action, md, in_tokens = _transfer_with_md(pp, [30], [10, 20])
+    opening = TokenMetadata.deserialize(md.outputs[0].output_metadata)
+    opening.blinding_factor += 1
+    md.outputs[0].output_metadata = opening.serialize()
+    req = TokenRequest(transfers=[action.serialize()])
+    with pytest.raises(AuditError, match=r"transfer in tx \[tx7\]"):
+        auditor.check(req, RequestMetadata(transfers=[md]), [in_tokens],
+                      "tx7")
+
+
+def test_transfer_sender_audit_info_mismatch(pp, auditor):
+    action, md, in_tokens = _transfer_with_md(pp, [30], [30])
+    md.inputs[0].senders[0].audit_info = BOB  # sender is ALICE
+    req = TokenRequest(transfers=[action.serialize()])
+    with pytest.raises(AuditError, match="does not match"):
+        auditor.check(req, RequestMetadata(transfers=[md]), [in_tokens],
+                      "tx8")
+
+
+def test_mixed_request_one_device_batch(pp, auditor):
+    """Issues + transfers re-opened in one batched device pass."""
+    i_action, i_md, _, _ = _issue_with_md(pp, [5, 6, 7])
+    t_action, t_md, in_tokens = _transfer_with_md(pp, [18], [9, 9])
+    req = TokenRequest(issues=[i_action.serialize()],
+                       transfers=[t_action.serialize()])
+    md = RequestMetadata(issues=[i_md], transfers=[t_md])
+    auditor.check(req, md, [in_tokens], "tx9")
+
+
+def test_endorse_requires_signer(pp, auditor):
+    req = TokenRequest()
+    with pytest.raises(AuditError, match="signer is nil"):
+        auditor.endorse(req, "tx10")

@@ -1,0 +1,130 @@
+"""zkatdlog driver through the FULL services tier (VERDICT round-1 #6).
+
+The same issue -> transfer -> redeem choreography as test_ttx_lifecycle, but
+with commitment tokens: wallet openings distributed over sessions, selector
+over deobfuscated balances, ZK proofs behind the validator, and the auditor
+running the batched commitment-reopen check on every request.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import zkatdlog
+from fabric_token_sdk_tpu.core.zkatdlog.driver import ZkDlogDriverService
+from fabric_token_sdk_tpu.crypto import setup
+from fabric_token_sdk_tpu.services.auditor import AuditError, AuditorNode
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def pp_module():
+    return setup.setup(BIT_LENGTH)
+
+
+@pytest.fixture
+def net(pp_module):
+    pp = pp_module
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    # device=False: this suite exercises the SERVICES integration (wallet
+    # openings, selector, distribution, auditor flow); the device kernels
+    # themselves are covered by test_zkatdlog_e2e / test_zk_audit /
+    # test_range_verifier — compiling them again here would dominate the
+    # suite's runtime on the CPU backend for zero extra coverage.
+    validator = zkatdlog.new_validator(pp, Deserializer(), device=False)
+    ledger = MemoryLedger()
+    cc = TokenChaincode(validator, ledger, pp.serialize())
+    bus = SessionBus()
+    driver = ZkDlogDriverService(pp, device=False)
+    nodes = {}
+    nodes["issuer"] = TokenNode("issuer", issuer_keys, bus, cc,
+                                precision=BIT_LENGTH,
+                                auditor_name="auditor", driver=driver)
+    nodes["auditor"] = AuditorNode("auditor", auditor_keys, bus, cc,
+                                   precision=BIT_LENGTH,
+                                   auditor_name="auditor", driver=driver)
+    for name in ("alice", "bob", "charlie"):
+        nodes[name] = TokenNode(name, new_signing_identity(), bus, cc,
+                                precision=BIT_LENGTH,
+                                auditor_name="auditor", driver=driver)
+    return nodes
+
+
+def test_zk_issue_transfer_redeem_with_balances(net):
+    alice, bob, charlie = net["alice"], net["bob"], net["charlie"]
+    tx = alice.issue("issuer", "alice", "USD", hex(1000))
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 1000
+    assert bob.balance("USD") == 0
+
+    tx2 = alice.transfer("USD", hex(300), "bob")
+    ev = alice.execute(tx2)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 700
+    assert bob.balance("USD") == 300
+
+    # bob redeems 100 (change 200 back to bob)
+    tx3 = bob.transfer("USD", hex(100), "", redeem=True)
+    ev = bob.execute(tx3)
+    assert ev.status == "VALID", ev.message
+    assert bob.balance("USD") == 200
+
+    # audit trail covers all three transactions; locks released
+    auditor = net["auditor"]
+    recs = auditor.auditdb.query_transactions()
+    assert {r.tx_id for r in recs} == {tx.tx_id, tx2.tx_id, tx3.tx_id}
+    assert auditor.auditdb.locked_eids() == []
+
+    # privacy: a non-participant learns no balances from the ledger
+    assert charlie.balance("USD") == 0
+    assert charlie.tokendb.unspent_tokens() == []
+
+    # the ledger itself stores only commitments: no plaintext value leaks
+    for key, raw in net["alice"].cc.ledger.state.items():
+        assert b"1000" not in raw and b"0x2bc" not in raw
+
+
+def test_zk_transfer_gathers_multiple_inputs(net):
+    alice, bob = net["alice"], net["bob"]
+    for amount in (10, 20, 30):
+        assert alice.execute(
+            alice.issue("issuer", "alice", "USD", hex(amount))
+        ).status == "VALID"
+    tx = alice.transfer("USD", hex(55), "bob")
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 5
+    assert bob.balance("USD") == 55
+
+
+def test_auditor_rejects_tampered_opening(net):
+    """Metadata opening that doesn't match the commitment fails the audit
+    before any signature is produced (crypto/audit/auditor.go:225-246)."""
+    alice = net["alice"]
+    tx = alice.issue("issuer", "alice", "USD", hex(500))
+    md = tx.metadata.issues[0].outputs[0]
+    from fabric_token_sdk_tpu.core.zkatdlog.metadata import TokenMetadata
+
+    opening = TokenMetadata.deserialize(md.output_metadata)
+    opening.value += 1
+    md.output_metadata = opening.serialize()
+    from fabric_token_sdk_tpu.services.ttx import TtxError
+
+    with pytest.raises((AuditError, TtxError)):
+        alice.execute(tx)
+
+
+def test_auditor_requires_metadata(net):
+    alice = net["alice"]
+    tx = alice.issue("issuer", "alice", "USD", hex(5))
+    tx.metadata = None
+    with pytest.raises(AuditError):
+        alice.execute(tx)
