@@ -12,6 +12,7 @@ import base64
 import json
 from dataclasses import dataclass, field
 
+from ...crypto import serialization as ser
 from ...driver.identity import Identity
 from ...token.model import ID
 
@@ -31,16 +32,31 @@ def _unb64(s: str | None) -> bytes:
     return base64.b64decode(s) if s else b""
 
 
+def _go_json(obj) -> bytes:
+    """Go json.Marshal byte conventions: no spaces, no key sorting needed
+    (we emit in Go struct declaration order), HTML escaping of <,>,&
+    (Go escapes by default; token payloads never contain them)."""
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
 def wrap_token_with_type(raw: bytes) -> bytes:
-    """tokens.WrapWithType: typed-token envelope {Type, Token}."""
-    return json.dumps({"Type": FABTOKEN_FORMAT, "Token": _b64(raw)}).encode()
+    """services/tokens/typed.go:37 WrapWithType: Go asn1.Marshal of
+    TypedToken{INTEGER Type, OCTET STRING Token}."""
+    return ser.der_sequence(ser.der_integer(FABTOKEN_FORMAT),
+                            ser.der_octet_string(raw))
 
 
 def unmarshal_typed_token(raw: bytes) -> bytes:
-    t = json.loads(raw)
-    if t.get("Type") != FABTOKEN_FORMAT:
-        raise ActionError(f"invalid token type [{t.get('Type')}]")
-    return _unb64(t.get("Token"))
+    """typed.go:28 + tokens/core/fabtoken/token.go type check."""
+    try:
+        seq = ser.DerReader(raw).read_sequence()
+        typ = seq.read_integer()
+        body = seq.read_octet_string()
+    except Exception as e:
+        raise ActionError(f"failed to unmarshal to TypedToken: {e}") from e
+    if typ != FABTOKEN_FORMAT:
+        raise ActionError(f"invalid token type [{typ}]")
+    return body
 
 
 @dataclass
@@ -58,26 +74,31 @@ class Output:
         return self.owner
 
     def serialize(self) -> bytes:
-        raw = json.dumps({
-            "owner": _b64(self.owner), "type": self.type,
-            "quantity": self.quantity,
-        }).encode()
-        return wrap_token_with_type(raw)
+        """Standalone (ledger) form: ASN.1 TypedToken{1, json} exactly as
+        Go json.Marshal of token.Token (tags owner/type/quantity,omitempty)
+        wrapped by tokens/typed.go WrapWithType."""
+        return wrap_token_with_type(_go_json(self.to_dict()))
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "Output":
         body = json.loads(unmarshal_typed_token(raw))
-        return cls(owner=_unb64(body.get("owner")), type=body["type"],
-                   quantity=body["quantity"])
+        return cls.from_dict(body)
 
     def to_dict(self) -> dict:
-        return {"owner": _b64(self.owner), "type": self.type,
-                "quantity": self.quantity}
+        """Go json.Marshal field set: omitempty on every field."""
+        d = {}
+        if self.owner:
+            d["owner"] = _b64(self.owner)
+        if self.type:
+            d["type"] = self.type
+        if self.quantity:
+            d["quantity"] = self.quantity
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Output":
-        return cls(owner=_unb64(d.get("owner")), type=d["type"],
-                   quantity=d["quantity"])
+        return cls(owner=_unb64(d.get("owner")), type=d.get("type", ""),
+                   quantity=d.get("quantity", ""))
 
 
 @dataclass
@@ -115,19 +136,23 @@ class IssueAction:
         return False
 
     def serialize(self) -> bytes:
-        return json.dumps({
-            "issuer": _b64(self.issuer),
-            "outputs": [o.to_dict() for o in self.outputs],
-            "metadata": {k: _b64(v) for k, v in self.metadata.items()},
-        }).encode()
+        """Go json.Marshal of the IssueAction struct (actions.go:97-99):
+        field-name keys, nil map -> null."""
+        return _go_json({
+            "Issuer": _b64(self.issuer) if len(self.issuer) else None,
+            "Outputs": [o.to_dict() for o in self.outputs] or None,
+            "Metadata": {k: _b64(v) for k, v in self.metadata.items()}
+            or None,
+        })
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "IssueAction":
         d = json.loads(raw)
         return cls(
-            issuer=Identity(_unb64(d.get("issuer"))),
-            outputs=[Output.from_dict(o) for o in d.get("outputs", [])],
-            metadata={k: _unb64(v) for k, v in (d.get("metadata") or {}).items()},
+            issuer=Identity(_unb64(d.get("Issuer"))),
+            outputs=[Output.from_dict(o) for o in d.get("Outputs") or []],
+            metadata={k: _unb64(v)
+                      for k, v in (d.get("Metadata") or {}).items()},
         )
 
 
@@ -177,21 +202,33 @@ class TransferAction:
         return False
 
     def serialize(self) -> bytes:
-        return json.dumps({
-            "inputs": [{"tx_id": i.tx_id, "index": i.index} for i in self.inputs],
-            "input_tokens": [t.to_dict() for t in self.input_tokens],
-            "outputs": [o.to_dict() for o in self.outputs],
-            "metadata": {k: _b64(v) for k, v in self.metadata.items()},
-        }).encode()
+        """Go json.Marshal of the TransferAction struct (actions.go:193):
+        token.ID json tags are tx_id/index with omitempty."""
+        def _id(i: ID) -> dict:
+            d = {}
+            if i.tx_id:
+                d["tx_id"] = i.tx_id
+            if i.index:
+                d["index"] = i.index
+            return d
+
+        return _go_json({
+            "Inputs": [_id(i) for i in self.inputs] or None,
+            "InputTokens": [t.to_dict() for t in self.input_tokens] or None,
+            "Outputs": [o.to_dict() for o in self.outputs] or None,
+            "Metadata": {k: _b64(v) for k, v in self.metadata.items()}
+            or None,
+        })
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "TransferAction":
         d = json.loads(raw)
         return cls(
-            inputs=[ID(i["tx_id"], i.get("index", 0))
-                    for i in d.get("inputs", [])],
+            inputs=[ID(i.get("tx_id", ""), i.get("index", 0))
+                    for i in d.get("Inputs") or []],
             input_tokens=[Output.from_dict(t)
-                          for t in d.get("input_tokens", [])],
-            outputs=[Output.from_dict(o) for o in d.get("outputs", [])],
-            metadata={k: _unb64(v) for k, v in (d.get("metadata") or {}).items()},
+                          for t in d.get("InputTokens") or []],
+            outputs=[Output.from_dict(o) for o in d.get("Outputs") or []],
+            metadata={k: _unb64(v)
+                      for k, v in (d.get("Metadata") or {}).items()},
         )

@@ -1,12 +1,14 @@
 """zkatdlog actions: commitment tokens, issue/transfer actions.
 
-Behavioral mirror of reference token/core/zkatdlog/nogh/v1/crypto/transfer/
-action.go:24-378 and .../issue/action.go: a token is (owner bytes,
-Data = Pedersen commitment in G1); actions carry commitment outputs, input
-IDs + input tokens, the serialized ZK proof, and a metadata map. Wire format
-here is this framework's protowire messages (token: {1: owner, 2: g1},
-actions: repeated submessages) — the Fiat-Shamir-relevant proof bytes keep
-exact reference encoding via crypto/serialization.
+Byte-exact wire mirror of the reference protos
+(token/core/zkatdlog/nogh/protos/noghactions.proto, generated
+protos-go/actions) and the standalone token envelope
+(token/services/tokens/typed.go + tokens/core/comm/token.go:41): a token
+embedded in an action is the bare proto message
+``Token{owner, G1{raw}}``; a token travelling alone (ledger state,
+Deobfuscate input) is ASN.1 ``TypedToken{Type=2, OCTET STRING proto}``.
+Conformance is pinned against protoc-compiled reference protos in
+tests/test_wire_conformance.py.
 """
 
 from __future__ import annotations
@@ -19,9 +21,42 @@ from ...driver.identity import Identity
 from ...token.model import ID
 from ...utils import protowire as pw
 
+#: tokens/core/comm/token.go:18 — the comm (commitment) token format tag.
+COMM_TOKEN_TYPE = 2
+
 
 class ActionError(ValueError):
     pass
+
+
+def wrap_token_with_type(raw: bytes, typ: int = COMM_TOKEN_TYPE) -> bytes:
+    """tokens/typed.go:37 WrapWithType: ASN.1 {INTEGER type, OCTET STRING}."""
+    return ser.der_sequence(ser.der_integer(typ), ser.der_octet_string(raw))
+
+
+def unmarshal_typed_token(raw: bytes, typ: int = COMM_TOKEN_TYPE) -> bytes:
+    """tokens/typed.go:28 + comm/token.go:45: unwrap and check the type."""
+    try:
+        seq = ser.DerReader(raw).read_sequence()
+        got_typ = seq.read_integer()
+        body = seq.read_octet_string()
+    except Exception as e:
+        raise ActionError(f"failed to unmarshal to TypedToken: {e}") from e
+    if got_typ != typ:
+        raise ActionError(f"invalid token type [{got_typ}]")
+    return body
+
+
+def _g1_msg(p: G1) -> bytes:
+    """noghmath.proto G1{1: raw}."""
+    return pw.bytes_field(1, ser.g1_to_bytes(p))
+
+
+def _g1_from_msg(raw: bytes) -> G1:
+    fields = pw.parse_fields(raw)
+    if 1 not in fields:
+        raise ActionError("invalid G1 proto: missing raw")
+    return ser.g1_from_bytes(bytes(fields[1][0]))
 
 
 @dataclass
@@ -31,18 +66,27 @@ class Token:
     owner: bytes
     data: G1
 
-    def serialize(self) -> bytes:
+    def to_proto(self) -> bytes:
+        """noghactions.proto Token{1: owner, 2: G1} — embedded form."""
         return (pw.bytes_field(1, self.owner)
-                + pw.bytes_field(2, ser.g1_to_bytes(self.data)))
+                + pw.message_field(2, _g1_msg(self.data)))
+
+    @classmethod
+    def from_proto(cls, raw: bytes) -> "Token":
+        fields = pw.parse_fields(raw)
+        if 2 not in fields:
+            raise ActionError("invalid token: missing data")
+        return cls(owner=bytes(fields.get(1, [b""])[0]),
+                   data=_g1_from_msg(bytes(fields[2][0])))
+
+    def serialize(self) -> bytes:
+        """Standalone form (crypto/token/token.go:35-47): typed-wrapped."""
+        return wrap_token_with_type(self.to_proto())
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "Token":
-        fields = pw.parse_fields(raw)
-        data_raw = bytes(fields.get(2, [b""])[0])
-        if not data_raw:
-            raise ActionError("invalid token: missing data")
-        return cls(owner=bytes(fields.get(1, [b""])[0]),
-                   data=ser.g1_from_bytes(data_raw))
+        """crypto/token/token.go:51-66."""
+        return cls.from_proto(unmarshal_typed_token(raw))
 
     def get_owner(self) -> bytes:
         return self.owner
@@ -51,42 +95,65 @@ class Token:
         return len(self.owner) == 0
 
 
+def _token_id_msg(token_id: ID) -> bytes:
+    """noghactions.proto TokenID{1: id, 2: index}."""
+    return (pw.string_field(1, token_id.tx_id)
+            + pw.uint64_field(2, token_id.index))
+
+
+def _token_id_from_msg(raw: bytes) -> ID:
+    fields = pw.parse_fields(raw)
+    return ID(bytes(fields.get(1, [b""])[0]).decode(),
+              fields.get(2, [0])[0])
+
+
 @dataclass
 class ActionInput:
-    """transfer/action.go:24-113: input ID + claimed token."""
+    """noghactions.proto TransferActionInput{1: TokenID, 2: Token,
+    3: upgrade witness (not produced by this framework)}."""
 
     id: ID
     token: Token
 
     def serialize(self) -> bytes:
-        id_msg = (pw.string_field(1, self.id.tx_id)
-                  + pw.uint64_field(2, self.id.index))
-        return (pw.message_field(1, id_msg)
-                + pw.message_field(2, self.token.serialize()))
+        return (pw.message_field(1, _token_id_msg(self.id))
+                + pw.message_field(2, self.token.to_proto()))
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "ActionInput":
         fields = pw.parse_fields(raw)
         if 1 not in fields or 2 not in fields:
             raise ActionError("invalid transfer action input")
-        id_fields = pw.parse_fields(fields[1][0])
-        tx_id = bytes(id_fields.get(1, [b""])[0]).decode()
-        index = id_fields.get(2, [0])[0]
-        return cls(id=ID(tx_id, index),
-                   token=Token.deserialize(bytes(fields[2][0])))
+        if 3 in fields and bytes(fields[3][0]):
+            raise ActionError(
+                "upgrade witnesses are not supported by this framework")
+        return cls(id=_token_id_from_msg(bytes(fields[1][0])),
+                   token=Token.from_proto(bytes(fields[2][0])))
 
 
-def _metadata_fields(metadata: dict[str, bytes]) -> bytes:
+def _proof_msg(proof: bytes) -> bytes:
+    """noghactions.proto Proof{1: proof}."""
+    return pw.bytes_field(1, proof)
+
+
+def _proof_from_msg(raw: bytes) -> bytes:
+    fields = pw.parse_fields(raw)
+    return bytes(fields.get(1, [b""])[0])
+
+
+def _metadata_fields(field_number: int, metadata: dict[str, bytes]) -> bytes:
+    """proto map<string, bytes>: repeated {1: key, 2: value}, sorted keys
+    (Go's map order is random; sorted is a deterministic subset)."""
     out = b""
     for k in sorted(metadata):
         entry = pw.string_field(1, k) + pw.bytes_field(2, metadata[k])
-        out += pw.message_field(4, entry)
+        out += pw.message_field(field_number, entry)
     return out
 
 
-def _metadata_from_fields(fields) -> dict[str, bytes]:
+def _metadata_from_fields(fields, field_number: int) -> dict[str, bytes]:
     md = {}
-    for raw in fields.get(4, []):
+    for raw in fields.get(field_number, []):
         sub = pw.parse_fields(raw)
         key = bytes(sub.get(1, [b""])[0]).decode()
         md[key] = bytes(sub.get(2, [b""])[0])
@@ -95,7 +162,7 @@ def _metadata_from_fields(fields) -> dict[str, bytes]:
 
 @dataclass
 class TransferAction:
-    """transfer/action.go:115-378."""
+    """noghactions.proto TransferAction (transfer/action.go:115-378)."""
 
     inputs: list[ActionInput] = field(default_factory=list)
     outputs: list[Token] = field(default_factory=list)
@@ -142,6 +209,7 @@ class TransferAction:
         return [o.data for o in self.outputs]
 
     def get_serialized_outputs(self) -> list[bytes]:
+        """action.go:221-229 — standalone (typed-wrapped) forms."""
         return [o.serialize() for o in self.outputs]
 
     def is_redeem_at(self, index: int) -> bool:
@@ -161,26 +229,36 @@ class TransferAction:
         for inp in self.inputs:
             out += pw.message_field(1, inp.serialize())
         for o in self.outputs:
-            out += pw.message_field(2, o.serialize())
-        out += pw.bytes_field(3, self.proof)
-        out += _metadata_fields(self.metadata)
+            # TransferActionOutput{1: Token}
+            out += pw.message_field(
+                2, pw.message_field(1, o.to_proto(), present=True))
+        out += pw.message_field(3, _proof_msg(self.proof),
+                                present=bool(self.proof))
+        out += _metadata_fields(4, self.metadata)
         return out
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "TransferAction":
         fields = pw.parse_fields(raw)
+        outputs = []
+        for b in fields.get(2, []):
+            sub = pw.parse_fields(bytes(b))
+            if 1 not in sub:
+                raise ActionError("invalid output in transfer action")
+            outputs.append(Token.from_proto(bytes(sub[1][0])))
         return cls(
             inputs=[ActionInput.deserialize(bytes(b))
                     for b in fields.get(1, [])],
-            outputs=[Token.deserialize(bytes(b)) for b in fields.get(2, [])],
-            proof=bytes(fields.get(3, [b""])[0]),
-            metadata=_metadata_from_fields(fields),
+            outputs=outputs,
+            proof=_proof_from_msg(bytes(fields.get(3, [b""])[0])),
+            metadata=_metadata_from_fields(fields, 4),
         )
 
 
 @dataclass
 class IssueAction:
-    """issue/action.go: issuer + commitment outputs + proof."""
+    """noghactions.proto IssueAction{1: Identity, 2: inputs, 3: outputs,
+    4: Proof, 5: metadata} (issue/action.go)."""
 
     issuer: Identity = Identity(b"")
     outputs: list[Token] = field(default_factory=list)
@@ -226,19 +304,37 @@ class IssueAction:
         return False
 
     def serialize(self) -> bytes:
-        out = pw.bytes_field(1, bytes(self.issuer))
+        # Identity{1: raw}
+        out = pw.message_field(1, pw.bytes_field(1, bytes(self.issuer)),
+                               present=len(self.issuer) > 0)
         for o in self.outputs:
-            out += pw.message_field(2, o.serialize())
-        out += pw.bytes_field(3, self.proof)
-        out += _metadata_fields(self.metadata)
+            # IssueActionOutput{1: Token}
+            out += pw.message_field(
+                3, pw.message_field(1, o.to_proto(), present=True))
+        out += pw.message_field(4, _proof_msg(self.proof),
+                                present=bool(self.proof))
+        out += _metadata_fields(5, self.metadata)
         return out
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "IssueAction":
         fields = pw.parse_fields(raw)
+        issuer = b""
+        if 1 in fields:
+            issuer = bytes(pw.parse_fields(
+                bytes(fields[1][0])).get(1, [b""])[0])
+        if fields.get(2):
+            raise ActionError(
+                "issue-with-inputs (redeem-by-issuer) is not supported")
+        outputs = []
+        for b in fields.get(3, []):
+            sub = pw.parse_fields(bytes(b))
+            if 1 not in sub:
+                raise ActionError("invalid output in issue action")
+            outputs.append(Token.from_proto(bytes(sub[1][0])))
         return cls(
-            issuer=Identity(bytes(fields.get(1, [b""])[0])),
-            outputs=[Token.deserialize(bytes(b)) for b in fields.get(2, [])],
-            proof=bytes(fields.get(3, [b""])[0]),
-            metadata=_metadata_from_fields(fields),
+            issuer=Identity(issuer),
+            outputs=outputs,
+            proof=_proof_from_msg(bytes(fields.get(4, [b""])[0])),
+            metadata=_metadata_from_fields(fields, 5),
         )

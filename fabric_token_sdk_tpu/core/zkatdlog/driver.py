@@ -54,7 +54,20 @@ class ZkDlogDriverService:
         self.pp = pp
         self._issue_prove = issue_proof.issue_prove
         self._transfer_prove = transfer_proof.transfer_prove
-        self._auditor = Auditor(pp, info_matcher=info_matcher, device=device)
+        self._device = device
+        self._info_matcher = info_matcher
+        # lazy: only auditor nodes ever call audit_check, and the device
+        # reopen tables cost a table build per pp — non-auditing nodes must
+        # not pay it
+        self._auditor_instance: Auditor | None = None
+
+    @property
+    def _auditor(self) -> Auditor:
+        if self._auditor_instance is None:
+            self._auditor_instance = Auditor(
+                self.pp, info_matcher=self._info_matcher,
+                device=self._device)
+        return self._auditor_instance
 
     # ------------------------------------------------------------- assembly
     def assemble_issue(self, issuer_identity: bytes,

@@ -1,18 +1,19 @@
 """zkatdlog request metadata: commitment openings + auditable identities.
 
-Behavioral mirror of the reference metadata model:
-  - token opening (reference token/core/zkatdlog/nogh/v1/crypto/token/
-    token.go:132-180 ``Metadata``): Type, Value, BlindingFactor, Issuer.
-  - per-action metadata (reference token/driver/request.go:105-330
-    ``IssueMetadata`` / ``TransferMetadata``): auditable identities
-    (identity + audit info) for issuer/senders/receivers plus the serialized
-    opening per output.
+Byte-exact wire mirror of the reference protos:
+  - token opening ``TokenMetadata`` (noghactions.proto + crypto/token/
+    token.go:132-180): {type, Zr value, Zr blinding_factor, Identity
+    issuer}, wrapped standalone as ASN.1 TypedMetadata{Type=2, proto}
+    (tokens/typed.go:46-72).
+  - request metadata (token/driver/protos/request.proto +
+    driver/request.go:105-330): AuditableIdentity / OutputMetadata /
+    TransferInputMetadata / IssueMetadata / TransferMetadata /
+    ActionMetadata(oneof) / TokenRequestMetadata.
 
 The request metadata never reaches the ledger; it flows sender -> auditor
 (audit check re-opens every commitment) and sender -> receiver (wallet
-ingestion of fresh openings). Wire format is this framework's protowire
-messages; proof-relevant bytes (Zr scalars) keep exact reference encoding
-via crypto/serialization.
+ingestion of fresh openings). Conformance is pinned against
+protoc-compiled reference protos in tests/test_wire_conformance.py.
 """
 
 from __future__ import annotations
@@ -22,10 +23,36 @@ from dataclasses import dataclass, field
 from ...crypto import serialization as ser
 from ...token.model import ID
 from ...utils import protowire as pw
+from .actions import (_token_id_from_msg, _token_id_msg,
+                      unmarshal_typed_token, wrap_token_with_type)
+
+#: driver/request.go TokenRequestMetadata version.
+METADATA_VERSION = 1
 
 
 class MetadataError(ValueError):
     pass
+
+
+def _zr_msg(v: int) -> bytes:
+    """noghmath.proto Zr{1: raw} (32-byte big-endian scalar)."""
+    return pw.bytes_field(1, ser.zr_to_bytes(v))
+
+
+def _zr_from_msg(raw: bytes) -> int:
+    fields = pw.parse_fields(raw)
+    if 1 not in fields:
+        raise MetadataError("invalid Zr proto: missing raw")
+    return ser.zr_from_bytes(bytes(fields[1][0]))
+
+
+def _identity_msg(raw: bytes) -> bytes:
+    """Identity{1: raw}."""
+    return pw.bytes_field(1, raw)
+
+
+def _identity_from_msg(raw: bytes) -> bytes:
+    return bytes(pw.parse_fields(raw).get(1, [b""])[0])
 
 
 @dataclass
@@ -37,129 +64,72 @@ class TokenMetadata:
     blinding_factor: int
     issuer: bytes = b""
 
+    def to_proto(self) -> bytes:
+        """noghactions.proto TokenMetadata{1: type, 2: Zr, 3: Zr, 4: Id}."""
+        out = (pw.string_field(1, self.token_type)
+               + pw.message_field(2, _zr_msg(self.value), present=True)
+               + pw.message_field(3, _zr_msg(self.blinding_factor),
+                                  present=True))
+        # token.go:170-177 always emits the Identity wrapper
+        out += pw.message_field(4, _identity_msg(self.issuer), present=True)
+        return out
+
+    @classmethod
+    def from_proto(cls, raw: bytes) -> "TokenMetadata":
+        fields = pw.parse_fields(raw)
+        if 2 not in fields or 3 not in fields:
+            raise MetadataError("invalid token metadata: missing opening")
+        issuer = b""
+        if 4 in fields:
+            issuer = _identity_from_msg(bytes(fields[4][0]))
+        return cls(
+            token_type=bytes(fields.get(1, [b""])[0]).decode(),
+            value=_zr_from_msg(bytes(fields[2][0])),
+            blinding_factor=_zr_from_msg(bytes(fields[3][0])),
+            issuer=issuer,
+        )
+
     def serialize(self) -> bytes:
-        return (pw.string_field(1, self.token_type)
-                + pw.bytes_field(2, ser.zr_to_bytes(self.value))
-                + pw.bytes_field(3, ser.zr_to_bytes(self.blinding_factor))
-                + pw.bytes_field(4, self.issuer))
+        """Standalone form (token.go:161-180): ASN.1 TypedMetadata{2, ...}
+        — the same envelope as tokens (tokens/typed.go)."""
+        return wrap_token_with_type(self.to_proto())
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "TokenMetadata":
-        fields = pw.parse_fields(raw)
-        v_raw = bytes(fields.get(2, [b""])[0])
-        bf_raw = bytes(fields.get(3, [b""])[0])
-        if not v_raw or not bf_raw:
-            raise MetadataError("invalid token metadata: missing opening")
-        return cls(
-            token_type=bytes(fields.get(1, [b""])[0]).decode(),
-            value=ser.zr_from_bytes(v_raw),
-            blinding_factor=ser.zr_from_bytes(bf_raw),
-            issuer=bytes(fields.get(4, [b""])[0]),
-        )
+        """token.go:136-158."""
+        try:
+            body = unmarshal_typed_token(raw)
+        except Exception as e:
+            raise MetadataError(
+                f"failed deserializing metadata: {e}") from e
+        return cls.from_proto(body)
 
 
 @dataclass
 class AuditableIdentity:
-    """Identity + audit info pair (driver/request.go:105-121)."""
+    """request.proto AuditableIdentity{1: Identity, 2: audit_info}."""
 
     identity: bytes = b""
     audit_info: bytes = b""
 
     def serialize(self) -> bytes:
-        return (pw.bytes_field(1, self.identity)
+        return (pw.message_field(1, _identity_msg(self.identity),
+                                 present=True)
                 + pw.bytes_field(2, self.audit_info))
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "AuditableIdentity":
         fields = pw.parse_fields(raw)
-        return cls(identity=bytes(fields.get(1, [b""])[0]),
+        identity = b""
+        if 1 in fields:
+            identity = _identity_from_msg(bytes(fields[1][0]))
+        return cls(identity=identity,
                    audit_info=bytes(fields.get(2, [b""])[0]))
 
 
 @dataclass
 class IssueOutputMetadata:
-    """driver/request.go:144-181."""
-
-    output_metadata: bytes = b""            # serialized TokenMetadata
-    receivers: list[AuditableIdentity] = field(default_factory=list)
-
-    def serialize(self) -> bytes:
-        out = pw.bytes_field(1, self.output_metadata)
-        for r in self.receivers:
-            out += pw.message_field(2, r.serialize())
-        return out
-
-    @classmethod
-    def deserialize(cls, raw: bytes) -> "IssueOutputMetadata":
-        fields = pw.parse_fields(raw)
-        return cls(
-            output_metadata=bytes(fields.get(1, [b""])[0]),
-            receivers=[AuditableIdentity.deserialize(bytes(b))
-                       for b in fields.get(2, [])],
-        )
-
-
-@dataclass
-class IssueActionMetadata:
-    """driver/request.go:184-246."""
-
-    issuer: AuditableIdentity = field(default_factory=AuditableIdentity)
-    outputs: list[IssueOutputMetadata] = field(default_factory=list)
-
-    def serialize(self) -> bytes:
-        out = pw.message_field(1, self.issuer.serialize())
-        for o in self.outputs:
-            out += pw.message_field(2, o.serialize())
-        return out
-
-    @classmethod
-    def deserialize(cls, raw: bytes) -> "IssueActionMetadata":
-        fields = pw.parse_fields(raw)
-        issuer = AuditableIdentity()
-        if 1 in fields:
-            issuer = AuditableIdentity.deserialize(bytes(fields[1][0]))
-        return cls(
-            issuer=issuer,
-            outputs=[IssueOutputMetadata.deserialize(bytes(b))
-                     for b in fields.get(2, [])],
-        )
-
-
-@dataclass
-class TransferInputMetadata:
-    """driver/request.go:249-279."""
-
-    token_id: ID | None = None
-    senders: list[AuditableIdentity] = field(default_factory=list)
-
-    def serialize(self) -> bytes:
-        out = b""
-        if self.token_id is not None:
-            id_msg = (pw.string_field(1, self.token_id.tx_id)
-                      + pw.uint64_field(2, self.token_id.index))
-            out += pw.message_field(1, id_msg)
-        for s in self.senders:
-            out += pw.message_field(2, s.serialize())
-        return out
-
-    @classmethod
-    def deserialize(cls, raw: bytes) -> "TransferInputMetadata":
-        fields = pw.parse_fields(raw)
-        token_id = None
-        if 1 in fields:
-            id_fields = pw.parse_fields(bytes(fields[1][0]))
-            token_id = ID(bytes(id_fields.get(1, [b""])[0]).decode(),
-                          id_fields.get(2, [0])[0])
-        return cls(
-            token_id=token_id,
-            senders=[AuditableIdentity.deserialize(bytes(b))
-                     for b in fields.get(2, [])],
-        )
-
-
-@dataclass
-class TransferOutputMetadata:
-    """driver/request.go:281-330."""
+    """request.proto OutputMetadata{1: metadata, 2: audit_info, 3: recv}."""
 
     output_metadata: bytes = b""            # serialized TokenMetadata
     output_audit_info: bytes = b""
@@ -173,7 +143,7 @@ class TransferOutputMetadata:
         return out
 
     @classmethod
-    def deserialize(cls, raw: bytes) -> "TransferOutputMetadata":
+    def deserialize(cls, raw: bytes) -> "IssueOutputMetadata":
         fields = pw.parse_fields(raw)
         return cls(
             output_metadata=bytes(fields.get(1, [b""])[0]),
@@ -183,12 +153,78 @@ class TransferOutputMetadata:
         )
 
 
+#: Transfer outputs share the same OutputMetadata message.
+TransferOutputMetadata = IssueOutputMetadata
+
+
+@dataclass
+class IssueActionMetadata:
+    """request.proto IssueMetadata{1: issuer, 2: inputs, 3: outputs,
+    4: extra_signers}."""
+
+    issuer: AuditableIdentity = field(default_factory=AuditableIdentity)
+    outputs: list[IssueOutputMetadata] = field(default_factory=list)
+    extra_signers: list[bytes] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = pw.message_field(1, self.issuer.serialize(), present=True)
+        for o in self.outputs:
+            out += pw.message_field(3, o.serialize())
+        for s in self.extra_signers:
+            out += pw.message_field(4, _identity_msg(s), present=True)
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "IssueActionMetadata":
+        fields = pw.parse_fields(raw)
+        issuer = AuditableIdentity()
+        if 1 in fields:
+            issuer = AuditableIdentity.deserialize(bytes(fields[1][0]))
+        return cls(
+            issuer=issuer,
+            outputs=[IssueOutputMetadata.deserialize(bytes(b))
+                     for b in fields.get(3, [])],
+            extra_signers=[_identity_from_msg(bytes(b))
+                           for b in fields.get(4, [])],
+        )
+
+
+@dataclass
+class TransferInputMetadata:
+    """request.proto TransferInputMetadata{1: TokenID, 2: senders}."""
+
+    token_id: ID | None = None
+    senders: list[AuditableIdentity] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = b""
+        if self.token_id is not None:
+            out += pw.message_field(1, _token_id_msg(self.token_id))
+        for s in self.senders:
+            out += pw.message_field(2, s.serialize())
+        return out
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TransferInputMetadata":
+        fields = pw.parse_fields(raw)
+        token_id = None
+        if 1 in fields:
+            token_id = _token_id_from_msg(bytes(fields[1][0]))
+        return cls(
+            token_id=token_id,
+            senders=[AuditableIdentity.deserialize(bytes(b))
+                     for b in fields.get(2, [])],
+        )
+
+
 @dataclass
 class TransferActionMetadata:
-    """driver/request.go TransferMetadata: per-input + per-output info."""
+    """request.proto TransferMetadata{1: inputs, 2: outputs,
+    8: extra_signers}."""
 
     inputs: list[TransferInputMetadata] = field(default_factory=list)
     outputs: list[TransferOutputMetadata] = field(default_factory=list)
+    extra_signers: list[bytes] = field(default_factory=list)
 
     def serialize(self) -> bytes:
         out = b""
@@ -196,6 +232,8 @@ class TransferActionMetadata:
             out += pw.message_field(1, i.serialize())
         for o in self.outputs:
             out += pw.message_field(2, o.serialize())
+        for s in self.extra_signers:
+            out += pw.message_field(8, _identity_msg(s), present=True)
         return out
 
     @classmethod
@@ -206,31 +244,56 @@ class TransferActionMetadata:
                     for b in fields.get(1, [])],
             outputs=[TransferOutputMetadata.deserialize(bytes(b))
                      for b in fields.get(2, [])],
+            extra_signers=[_identity_from_msg(bytes(b))
+                           for b in fields.get(8, [])],
         )
 
 
 @dataclass
 class RequestMetadata:
-    """Token-request metadata: one entry per action, in request order
-    (driver.TokenRequestMetadata)."""
+    """request.proto TokenRequestMetadata{1: version, 2: repeated
+    ActionMetadata (oneof issue=1 / transfer=2), 3: application map}.
+
+    Action order on the wire matches the TokenRequest action order:
+    issues first, then transfers (driver/request.go marshalling).
+    """
 
     issues: list[IssueActionMetadata] = field(default_factory=list)
     transfers: list[TransferActionMetadata] = field(default_factory=list)
+    application: dict[str, bytes] = field(default_factory=dict)
 
     def serialize(self) -> bytes:
-        out = b""
+        out = pw.uint64_field(1, METADATA_VERSION)
         for i in self.issues:
-            out += pw.message_field(1, i.serialize())
+            body = pw.message_field(1, i.serialize(), present=True)
+            out += pw.message_field(2, body)
         for t in self.transfers:
-            out += pw.message_field(2, t.serialize())
+            body = pw.message_field(2, t.serialize(), present=True)
+            out += pw.message_field(2, body)
+        for k in sorted(self.application):
+            entry = pw.string_field(1, k) + \
+                pw.bytes_field(2, self.application[k])
+            out += pw.message_field(3, entry)
         return out
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "RequestMetadata":
         fields = pw.parse_fields(raw)
-        return cls(
-            issues=[IssueActionMetadata.deserialize(bytes(b))
-                    for b in fields.get(1, [])],
-            transfers=[TransferActionMetadata.deserialize(bytes(b))
-                       for b in fields.get(2, [])],
-        )
+        issues, transfers = [], []
+        for b in fields.get(2, []):
+            sub = pw.parse_fields(bytes(b))
+            if 1 in sub:
+                issues.append(
+                    IssueActionMetadata.deserialize(bytes(sub[1][0])))
+            elif 2 in sub:
+                transfers.append(
+                    TransferActionMetadata.deserialize(bytes(sub[2][0])))
+            else:
+                raise MetadataError("empty action metadata")
+        application = {}
+        for b in fields.get(3, []):
+            sub = pw.parse_fields(bytes(b))
+            application[bytes(sub.get(1, [b""])[0]).decode()] = \
+                bytes(sub.get(2, [b""])[0])
+        return cls(issues=issues, transfers=transfers,
+                   application=application)

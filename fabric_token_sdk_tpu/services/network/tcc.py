@@ -34,6 +34,11 @@ class CommitEvent:
     tx_id: str
     status: str  # "VALID" | "INVALID"
     message: str = ""
+    # total output slots of the committed request, INCLUDING redeem outputs
+    # (which occupy an index but leave no ledger key). Lets ledger-scan
+    # ingestion walk every slot instead of stopping at the first gap — the
+    # RW-set processor equivalent of knowing the full write set.
+    n_outputs: int = 0
 
 
 class MemoryLedger:
@@ -49,7 +54,8 @@ class MemoryLedger:
     def new_rwset(self) -> MemoryRWSet:
         return MemoryRWSet(self.state)
 
-    def commit(self, tx_id: str, rws: MemoryRWSet) -> CommitEvent:
+    def commit(self, tx_id: str, rws: MemoryRWSet,
+               n_outputs: int = 0) -> CommitEvent:
         """Atomically validate the read set and apply writes (total order)."""
         with self.lock:
             for key, seen in rws.reads.items():
@@ -59,7 +65,7 @@ class MemoryLedger:
                     self._emit(ev)
                     return ev
             rws.apply()
-            ev = CommitEvent(tx_id, "VALID")
+            ev = CommitEvent(tx_id, "VALID", n_outputs=n_outputs)
             self._emit(ev)
             return ev
 
@@ -129,7 +135,8 @@ class TokenChaincode:
             ev = CommitEvent(tx_id, "INVALID", f"translation failed: {e}")
             self.ledger._emit(ev)
             return ev
-        return self.ledger.commit(tx_id, rws)
+        n_outputs = sum(len(a.get_outputs()) for a in actions)
+        return self.ledger.commit(tx_id, rws, n_outputs=n_outputs)
 
     # ---- queries (tcc.go:126-143) ----------------------------------------
     def query_public_params(self) -> bytes | None:

@@ -12,6 +12,7 @@ view/session plane).
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 from ..core.fabtoken.driver import FabTokenDriverService, OutputSpec
 from ..driver import TokenRequest
@@ -61,8 +62,12 @@ class TokenNode:
         # txs this node assembled or endorsed: refresh ttxdb on finality
         self._watched: dict[str, TokenRequest] = {}
         # openings received at distribution time, keyed by tx then global
-        # output index (ttx/endorse.go:444; consumed at finality)
-        self._pending_openings: dict[str, dict[int, bytes]] = {}
+        # output index (ttx/endorse.go:444; consumed at finality). Bounded:
+        # txs that are distributed but never reach finality would otherwise
+        # accumulate forever, so the oldest entries are evicted past a cap.
+        self._pending_openings: "OrderedDict[str, dict[int, bytes]]" = \
+            OrderedDict()
+        self._pending_openings_cap = 10_000
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:
@@ -99,6 +104,8 @@ class TokenNode:
         """Distribution responder: remember the opening of output `index`
         until finality ingestion (recipients.go semantics)."""
         self._pending_openings.setdefault(tx_id, {})[index] = opening
+        while len(self._pending_openings) > self._pending_openings_cap:
+            self._pending_openings.popitem(last=False)
 
     def audit(self, tx: Transaction) -> bytes:
         """Auditor-side view (ttx/auditor.go:265; auditor service semantics
@@ -212,21 +219,24 @@ class TokenNode:
         if request_raw is None:
             # fetch from a peer that assembled it (finality.go:65-121 fetch
             # escalation); standalone: read tokens directly from the ledger
-            self._ingest_from_ledger(ev.tx_id, openings)
+            self._ingest_from_ledger(ev.tx_id, openings, ev.n_outputs)
         else:
             actions = self.cc.validator.unmarshal_actions(
                 request_raw.to_bytes())
             self.tokens.append_transaction(ev.tx_id, actions, openings)
         self.ttxdb.set_status(ev.tx_id, TxStatus.CONFIRMED)
 
-    def _ingest_from_ledger(self, tx_id: str,
-                            openings: dict[int, bytes]) -> None:
-        """Scan ledger outputs of tx_id (processor.go:40 RW-set indexing)."""
-        idx = 0
-        while True:
+    def _ingest_from_ledger(self, tx_id: str, openings: dict[int, bytes],
+                            n_outputs: int) -> None:
+        """Scan ledger outputs of tx_id (processor.go:40 RW-set indexing).
+
+        Walks every output SLOT of the transaction — redeem outputs occupy
+        an index but leave no ledger key, so gaps must not end the scan.
+        """
+        for idx in range(n_outputs):
             raw = self.cc.ledger.get_state(self.cc.keys.output_key(tx_id, idx))
             if raw is None:
-                break
+                continue  # redeem output: indexed but never written
             out = self.driver.parse_ledger_output(raw, openings.get(idx))
             if out is not None and out.owner_raw:
                 owners = self._ownership(out.owner_raw)
@@ -236,7 +246,6 @@ class TokenNode:
                     ledger_format=out.ledger_format,
                     ledger_token=out.ledger_token,
                     ledger_metadata=out.ledger_metadata)
-            idx += 1
         # mark spent inputs: any of my unspent tokens no longer on ledger
         for tok in self.tokendb.unspent_tokens(self.name):
             key = self.cc.keys.output_key(tok.id.tx_id, tok.id.index)

@@ -1,0 +1,117 @@
+"""Phase-level profile of the batched range verifier on the current backend.
+
+Times pass-1 (transcript points), host phase a/b, and pass-2 (combined MSM)
+separately at a given batch size. Run on the real chip:
+    python profile_verifier.py [BATCH]
+"""
+
+import sys
+import time
+
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+configure_jax_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bench import _load  # noqa: E402
+from fabric_token_sdk_tpu.models import range_verifier as rv  # noqa: E402
+from fabric_token_sdk_tpu.ops import limbs  # noqa: E402
+from fabric_token_sdk_tpu.crypto import bn254  # noqa: E402
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+
+def main():
+    pp, proofs, coms = _load()
+    reps = (BATCH + len(proofs) - 1) // len(proofs)
+    proofs = (proofs * reps)[:BATCH]
+    coms = (coms * reps)[:BATCH]
+
+    t0 = time.perf_counter()
+    v = rv.BatchRangeVerifier(pp)
+    params = v.params
+    print(f"tables: {time.perf_counter()-t0:.2f}s", flush=True)
+
+    # warm-up full verify (compiles everything)
+    t0 = time.perf_counter()
+    out = v.verify(proofs, coms)
+    print(f"warmup verify: {time.perf_counter()-t0:.2f}s all={out.all()}",
+          flush=True)
+
+    # ---- phase timings (steady state)
+    n = params.bit_length
+    live = list(range(BATCH))
+    t0 = time.perf_counter()
+    transcripts = {i: rv._host_phase_a(proofs[i], coms[i], params)
+                   for i in live}
+    t_host_a = time.perf_counter() - t0
+
+    b_bucket = rv._bucket_rows(len(live))
+    zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
+    id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+    t0 = time.perf_counter()
+    yinv_np = np.stack(
+        [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
+    yinv = jnp.asarray(rv._pad_rows(yinv_np, b_bucket, zero_sc))
+    k_fixed_np = np.stack(
+        [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
+         for i in live])
+    k_fixed = jnp.asarray(rv._pad_rows(k_fixed_np, b_bucket, zero_sc))
+    dc_pts_np = np.stack(
+        [limbs.points_to_projective_limbs(
+            [proofs[i].data.D, proofs[i].data.C]) for i in live])
+    dc_pts = jnp.asarray(rv._pad_rows(dc_pts_np, b_bucket, id_pt))
+    dc_sc_np = np.stack(
+        [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
+         for i in live])
+    dc_sc = jnp.asarray(rv._pad_rows(dc_sc_np, b_bucket, zero_sc))
+    t_marshal = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rgp_dev = rv._rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
+    rgp_dev.block_until_ready()
+    t_rgp = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rgp_aff = rv._affine_rows_kernel(rgp_dev)
+    rgp_aff.block_until_ready()
+    t_rgp_aff = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    k_dev = rv._k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
+                              dc_sc)
+    k_aff = rv._affine_kernel(k_dev)
+    k_aff.block_until_ready()
+    t_k = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rgp_bytes = rv.affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
+    k_bytes = rv.affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
+    equations = {}
+    for row, i in enumerate(live):
+        rgp_hex = [bytes(rgp_bytes[row, j]).hex().encode("ascii")
+                   for j in range(n)]
+        k_hex = bytes(k_bytes[row]).hex().encode("ascii")
+        equations[i] = rv._host_phase_b(proofs[i], transcripts[i], rgp_hex,
+                                        k_hex, params)
+    t_host_b = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ok = v._verify_combined(proofs, coms, live, equations)
+    t_combined = time.perf_counter() - t0
+
+    total = t_host_a + t_marshal + t_rgp + t_rgp_aff + t_k + t_host_b + \
+        t_combined
+    print(f"B={BATCH}  total={total:.3f}s  ({BATCH/total:.1f}/s)  ok={ok}")
+    for name, t in [("host_a", t_host_a), ("marshal", t_marshal),
+                    ("rgp_gather", t_rgp), ("rgp_affine", t_rgp_aff),
+                    ("k_pass+affine", t_k), ("host_b(+bytes)", t_host_b),
+                    ("combined_msm", t_combined)]:
+        print(f"  {name:>14}: {t:.3f}s  {100*t/total:.1f}%")
+
+
+if __name__ == "__main__":
+    main()

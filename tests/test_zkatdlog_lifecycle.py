@@ -128,3 +128,39 @@ def test_auditor_requires_metadata(net):
     tx.metadata = None
     with pytest.raises(AuditError):
         alice.execute(tx)
+
+
+def test_receiver_after_redeem_output_still_ingests(net):
+    """A redeem output occupies an output index but leaves no ledger key;
+    a receiver's ledger-scan ingestion must not stop at the gap."""
+    from fabric_token_sdk_tpu.core.fabtoken.driver import OutputSpec
+    from fabric_token_sdk_tpu.services.ttx import Transaction
+    from fabric_token_sdk_tpu.token.request_builder import Request
+
+    alice, bob = net["alice"], net["bob"]
+    assert alice.execute(
+        alice.issue("issuer", "alice", "USD", hex(40))).status == "VALID"
+
+    tx_id = Transaction.new_anchor()
+    selection = alice.selector.select("alice", "USD", hex(40), tx_id)
+    bob_owner, bob_ai = bob.recipient_identity()
+    req = Request(tx_id, alice.driver)
+    req.transfer(
+        selection.tokens,
+        [OutputSpec(owner=b"", token_type="USD", value=15),   # redeem @0
+         OutputSpec(owner=bob_owner, token_type="USD", value=25,
+                    audit_info=bob_ai)],                      # bob @1
+        wallet=alice.tokendb.get_ledger_token,
+        sender_audit_info=alice.owner_wallet.audit_info_for,
+        receivers=[None, "bob"])
+    tx = Transaction(tx_id=tx_id, request=req.token_request(),
+                     input_owners=["alice"] * len(selection.tokens),
+                     input_owner_ids=req.input_owner_ids(),
+                     metadata=req.request_metadata(),
+                     distribution=req.distribution())
+    bob_before = bob.balance("USD")
+    # alice does NOT add herself as watcher for bob: bob takes the
+    # ledger-scan path (he never assembled or signed this tx)
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert bob.balance("USD") == bob_before + 25
