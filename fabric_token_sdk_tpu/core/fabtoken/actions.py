@@ -33,10 +33,14 @@ def _unb64(s: str | None) -> bytes:
 
 
 def _go_json(obj) -> bytes:
-    """Go json.Marshal byte conventions: no spaces, no key sorting needed
-    (we emit in Go struct declaration order), HTML escaping of <,>,&
-    (Go escapes by default; token payloads never contain them)."""
-    return json.dumps(obj, separators=(",", ":")).encode()
+    """Go json.Marshal byte conventions: no spaces, keys in Go struct
+    declaration order, and HTML escaping of <, >, & to \\u003c/\\u003e/
+    \\u0026 (Go escapes them by default; token types are free user strings
+    so this is reachable)."""
+    raw = json.dumps(obj, separators=(",", ":"))
+    raw = raw.replace("&", "\\u0026").replace("<", "\\u003c") \
+             .replace(">", "\\u003e")
+    return raw.encode()
 
 
 def wrap_token_with_type(raw: bytes) -> bytes:

@@ -12,6 +12,7 @@ error message (SURVEY.md north star: bit-identical accept/reject).
 from __future__ import annotations
 
 import logging
+import time
 
 from ...crypto import issue_proof, rp, transfer_proof
 from ...crypto.bn254 import G1, g1_add, g1_neg
@@ -88,9 +89,18 @@ class ZKVerifier:
                             commitments: list[G1]) -> None:
         """Device-batched RangeCorrectness with host fallback for the exact
         reference error (rangecorrectness.go:137-162 ordering)."""
+        from ...services import metrics
+
         if len(rc.proofs) != len(commitments):
             raise ProofError("invalid range proof")
+        t0 = time.perf_counter()
         accepts = self._range.verify_range_correctness(rc, commitments)
+        metrics.GLOBAL.histogram(
+            "zk_range_batch_verify_seconds",
+            path=self._range.last_path or "?").observe(
+            time.perf_counter() - t0)
+        metrics.GLOBAL.counter("zk_range_proofs_verified_total").add(
+            len(rc.proofs))
         if accepts.all():
             return
         # Reproduce the sequential loop's first-failure error exactly.
@@ -109,8 +119,11 @@ class ZKVerifier:
         # disagreement is a kernel bug, never a bad proof. Count and log it
         # loudly so it can't silently mask a broken device path, then trust
         # the host oracle for the accept/reject decision (exactness).
+        from ...services import metrics
+
         global DEVICE_DISAGREEMENTS
         DEVICE_DISAGREEMENTS += 1
+        metrics.GLOBAL.counter("zk_device_oracle_disagreements_total").add()
         logger.error(
             "device/oracle disagreement: device rejected index %d of a "
             "%d-proof batch the host oracle fully accepts (kernel bug?)",

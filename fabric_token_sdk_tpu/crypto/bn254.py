@@ -63,6 +63,30 @@ def fr_inv(a: int) -> int:
     return pow(a, R - 2, R)
 
 
+def fr_batch_inv(values: list[int]) -> list[int]:
+    """Montgomery batch inversion: ONE field inversion + 3(n-1) muls.
+
+    A single Fermat inversion costs ~256 modmuls; verifier hot paths invert
+    a dozen scalars per proof, so batching is a ~10x host-side win."""
+    n = len(values)
+    if n == 0:
+        return []
+    prefix = [0] * n
+    acc = 1
+    for i, v in enumerate(values):
+        if v % R == 0:
+            raise ZeroDivisionError("inverse of zero in Fr")
+        acc = acc * v % R
+        prefix[i] = acc
+    inv_acc = pow(acc, R - 2, R)
+    out = [0] * n
+    for i in range(n - 1, 0, -1):
+        out[i] = prefix[i - 1] * inv_acc % R
+        inv_acc = inv_acc * values[i] % R
+    out[0] = inv_acc
+    return out
+
+
 def fr_rand() -> int:
     """Uniform random scalar in [0, R)."""
     return secrets.randbelow(R)

@@ -1,0 +1,10 @@
+"""NWO-style multiprocess test harness (reference integration/nwo/token).
+
+Boots N real OS processes — one per token node — over a shared ledger
+process, with the session plane (sign/audit/distribute views) running over
+IPC queues and finality flowing through a polling delivery service, the
+same planes the reference runs over websockets + Fabric delivery
+(SURVEY.md §2.5).
+"""
+
+from .nwo import Platform, NodeSpec  # noqa: F401

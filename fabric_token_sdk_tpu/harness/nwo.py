@@ -1,0 +1,512 @@
+"""Multiprocess platform: ledger server process + node processes.
+
+Behavioral mirror of reference integration/nwo/token/platform.go:112-246:
+  1. GENERATE phase — every node process generates its crypto material and
+     reports its public identity;
+  2. SETUP phase — the orchestrator builds the public parameters (with the
+     collected issuer/auditor identities) and boots the ledger process
+     hosting the token chaincode (the ordering + validation plane);
+  3. RUN phase — nodes build their driver bundle from the pp bytes and
+     serve views; the orchestrator drives initiator views and asserts.
+
+Planes (SURVEY.md §2.5):
+  - session plane: per-node IPC inbox queues (paired initiator/responder
+    calls — the websockets/libp2p substitute);
+  - consensus plane: the ledger manager process (Broadcast ==
+    process_request RPC; finality == block polling via DeliveryService).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from multiprocessing.managers import BaseManager
+
+from ..services.network.rws import KeyTranslator
+
+
+# ---------------------------------------------------------------------------
+# ledger server process
+# ---------------------------------------------------------------------------
+
+class _LedgerService:
+    """The shared ledger + chaincode, hosted in its own process."""
+
+    def __init__(self):
+        self._cc = None
+        self._lock = threading.Lock()
+
+    def boot(self, pp_raw: bytes, driver_label: str) -> None:
+        """SETUP phase: build validator + chaincode from pp bytes."""
+        from ..core.registry import default_registry
+        from ..services.network.tcc import MemoryLedger, TokenChaincode
+
+        bundle = default_registry(device=False).new_bundle(pp_raw)
+        with self._lock:
+            self._cc = TokenChaincode(bundle.validator, MemoryLedger(),
+                                      pp_raw)
+
+    def process_request(self, tx_id: str, request_raw: bytes):
+        return self._cc.process_request(tx_id, request_raw)
+
+    def get_state(self, key: str):
+        return self._cc.ledger.get_state(key)
+
+    def blocks_since(self, cursor: int):
+        """Delivery service: commit events from `cursor` on."""
+        blocks = self._cc.ledger.blocks
+        return list(blocks[cursor:]), len(blocks)
+
+    def query_public_params(self):
+        return self._cc.query_public_params()
+
+
+class LedgerManager(BaseManager):
+    pass
+
+
+LedgerManager.register("ledger", callable=None)
+
+
+def _serve_ledger(address, authkey):
+    service = _LedgerService()
+    mgr = LedgerManager(address=address, authkey=authkey)
+    LedgerManager.register("ledger", callable=lambda: service)
+    server = mgr.get_server()
+    server.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# client-side ledger facade (per node process)
+# ---------------------------------------------------------------------------
+
+class DeliveryService(threading.Thread):
+    """Polls the ledger for new blocks and dispatches commit events to the
+    local finality listeners (network/common/finality.go manager role)."""
+
+    def __init__(self, proxy, poll: float = 0.02):
+        super().__init__(daemon=True)
+        self.proxy = proxy
+        self.poll = poll
+        self.listeners: list = []
+        self.cursor = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    def add_finality_listener(self, listener) -> None:
+        with self._lock:
+            self.listeners.append(listener)
+
+    def remove_finality_listener(self, listener) -> None:
+        with self._lock:
+            if listener in self.listeners:
+                self.listeners.remove(listener)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events, new_cursor = self.proxy.blocks_since(self.cursor)
+            except (EOFError, ConnectionError, BrokenPipeError):
+                return  # ledger gone: shut down quietly
+            self.cursor = new_cursor
+            for ev in events:
+                with self._lock:
+                    listeners = list(self.listeners)
+                for listener in listeners:
+                    try:
+                        listener(ev)
+                    except Exception:  # listener isolation
+                        import logging
+
+                        logging.getLogger(
+                            "fabric_token_sdk_tpu.harness").exception(
+                            "finality listener failed [%s]", ev.tx_id)
+            self._stop.wait(self.poll)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class RemoteLedger:
+    """MemoryLedger facade over the manager proxy + delivery thread."""
+
+    def __init__(self, proxy, delivery: DeliveryService):
+        self.proxy = proxy
+        self.delivery = delivery
+
+    def get_state(self, key: str):
+        return self.proxy.get_state(key)
+
+    def add_finality_listener(self, listener) -> None:
+        self.delivery.add_finality_listener(listener)
+
+    def remove_finality_listener(self, listener) -> None:
+        self.delivery.remove_finality_listener(listener)
+
+
+class RemoteChaincode:
+    """TokenChaincode facade: validation/ordering RPC + local key scheme.
+
+    unmarshal_actions runs on the LOCAL validator (nodes hold the pp);
+    process_request is the Broadcast RPC to the ledger process.
+    """
+
+    def __init__(self, proxy, validator, delivery: DeliveryService):
+        self.keys = KeyTranslator()
+        self.validator = validator
+        self.ledger = RemoteLedger(proxy, delivery)
+        self._proxy = proxy
+
+    def process_request(self, tx_id: str, request_raw: bytes):
+        return self._proxy.process_request(tx_id, request_raw)
+
+
+# ---------------------------------------------------------------------------
+# session plane: IPC queue bus
+# ---------------------------------------------------------------------------
+
+class QueueBus:
+    """SessionBus over per-node inbox queues.
+
+    A call is (reply_queue, method, args, kwargs); the responder node's
+    dispatcher thread executes it on the real node object and posts
+    (ok, result_or_error) on the reply queue — the paired initiator/
+    responder view shape of ttx over a process boundary.
+    """
+
+    def __init__(self, inboxes: dict, my_name: str, reply_queue):
+        self.inboxes = inboxes
+        self.my_name = my_name
+        self.reply_queue = reply_queue
+        self.local: dict[str, object] = {}
+
+    def register(self, name: str, node) -> None:
+        self.local[name] = node
+
+    def node(self, name: str):
+        if name in self.local:
+            return self.local[name]
+        if name not in self.inboxes:
+            from ..services.ttx import TtxError
+
+            raise TtxError(f"unknown node [{name}]")
+        return _RemoteNodeStub(self, name)
+
+
+class _RemoteNodeStub:
+    """Initiator-side proxy for a responder view on another node."""
+
+    _METHODS = ("sign_transfer", "sign_issue", "audit", "receive_opening",
+                "recipient_identity", "issuer_public_identity")
+
+    def __init__(self, bus: QueueBus, name: str):
+        self._bus = bus
+        self._name = name
+
+    def __getattr__(self, method):
+        if method not in self._METHODS:
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            self._bus.inboxes[self._name].put(
+                (self._bus.reply_queue, method, args, kwargs))
+            ok, payload = self._bus.reply_queue.get(timeout=60)
+            if not ok:
+                raise RuntimeError(
+                    f"view [{method}] on [{self._name}] failed: {payload}")
+            return payload
+
+        return call
+
+
+def _dispatch_loop(node, inbox, stop_event):
+    """Responder thread: serve session-plane calls on the real node."""
+    while not stop_event.is_set():
+        try:
+            msg = inbox.get(timeout=0.1)
+        except Exception:
+            continue
+        if msg is None:
+            return
+        reply_queue, method, args, kwargs = msg
+        try:
+            result = getattr(node, method)(*args, **kwargs)
+            reply_queue.put((True, result))
+        except Exception as e:
+            reply_queue.put((False, f"{type(e).__name__}: {e}"))
+
+
+# ---------------------------------------------------------------------------
+# node process
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NodeSpec:
+    name: str
+    role: str = "owner"          # "owner" | "issuer" | "auditor"
+    idemix: bool = False         # pseudonymous owner wallet
+
+
+def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
+    """Entry point of one node process."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from ..core.registry import default_registry
+    from ..services.auditor import AuditorNode
+    from ..services.identity.x509 import new_signing_identity
+    from ..services.node import TokenNode
+    from ..services.ttx import Transaction
+
+    spec = NodeSpec(**spec_dict)
+    keys = new_signing_identity()
+
+    # GENERATE phase: report identity material
+    control["out"].put(("identity", spec.name, bytes(keys.identity)))
+
+    # wait for SETUP: pp bytes + go signal
+    cmd, pp_raw, extra = control["in"].get()
+    assert cmd == "start"
+
+    bundle = default_registry(device=False).new_bundle(pp_raw)
+    mgr = LedgerManager(address=tuple(ledger_address)
+                        if isinstance(ledger_address, list)
+                        else ledger_address, authkey=authkey)
+    mgr.connect()
+    proxy = mgr.ledger()
+    delivery = DeliveryService(proxy)
+    cc = RemoteChaincode(proxy, bundle.validator, delivery)
+
+    bus = QueueBus(inboxes, spec.name, replies[spec.name])
+    owner_wallet = None
+    if spec.idemix:
+        from ..services.identity.idemix import (EnrollmentAuthority,
+                                                IdemixKeyManager)
+        from ..services.identity.wallet import IdemixOwnerWallet
+
+        # extra carries the pickled enrollment authority keys? Out of scope:
+        # each idemix node enrolls with a process-local authority here;
+        # cross-process CA distribution is exercised in-process instead.
+        ca = EnrollmentAuthority()
+        owner_wallet = IdemixOwnerWallet(
+            IdemixKeyManager(f"{spec.name}@org", ca))
+
+    cls = AuditorNode if spec.role == "auditor" else TokenNode
+    node = cls(spec.name, keys, bus, cc,
+               precision=extra["precision"],
+               auditor_name=extra.get("auditor"),
+               driver=bundle.services, owner_wallet=owner_wallet)
+    delivery.start()
+
+    stop_event = threading.Event()
+    dispatcher = threading.Thread(
+        target=_dispatch_loop, args=(node, inboxes[spec.name], stop_event),
+        daemon=True)
+    dispatcher.start()
+
+    # RUN phase: command loop from the orchestrator
+    while True:
+        cmd, *args = control["in"].get()
+        try:
+            if cmd == "stop":
+                stop_event.set()
+                delivery.stop()
+                control["out"].put(("stopped", spec.name, None))
+                return
+            elif cmd == "issue":
+                issuer_node, to_node, token_type, amount_hex = args
+                tx = node.issue(issuer_node, to_node, token_type, amount_hex)
+                ev = node.execute(tx)
+                control["out"].put(("result", spec.name,
+                                    (ev.status, ev.message, tx.tx_id)))
+            elif cmd == "transfer":
+                token_type, amount_hex, to_node, redeem = args
+                tx = node.transfer(token_type, amount_hex, to_node,
+                                   redeem=redeem)
+                ev = node.execute(tx)
+                control["out"].put(("result", spec.name,
+                                    (ev.status, ev.message, tx.tx_id)))
+            elif cmd == "balance":
+                token_type, = args
+                control["out"].put(("result", spec.name,
+                                    node.balance(token_type)))
+            elif cmd == "wait_tx":
+                tx_id, timeout = args
+                deadline = time.time() + timeout
+                status = None
+                while time.time() < deadline:
+                    status = node.ttxdb.get_status(tx_id)
+                    if status in ("Confirmed", "Deleted"):
+                        break
+                    time.sleep(0.02)
+                control["out"].put(("result", spec.name, status))
+            else:
+                control["out"].put(("error", spec.name,
+                                    f"unknown command [{cmd}]"))
+        except Exception as e:
+            control["out"].put(("error", spec.name,
+                                f"{type(e).__name__}: {e}"))
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+class Platform:
+    """Boots the topology and drives it (platform.go:112-246 role)."""
+
+    def __init__(self, specs: list[NodeSpec], precision: int = 64,
+                 driver: str = "fabtoken", bit_length: int = 16):
+        self.specs = specs
+        self.precision = precision
+        self.driver = driver
+        self.bit_length = bit_length
+        self._ctx = mp.get_context("spawn")
+        self._mgr = self._ctx.Manager()
+        self._procs: dict[str, mp.Process] = {}
+        self._controls: dict[str, dict] = {}
+        self._events = self._mgr.Queue()
+        self._ledger_proc = None
+        self._ledger_mgr = None
+        self._authkey = uuid.uuid4().hex.encode()
+        self._address = ("127.0.0.1", 0)
+
+    # ------------------------------------------------------------------ boot
+    def start(self) -> None:
+        # keep proxy references alive on self: if the orchestrator drops
+        # them, the manager decrefs and deletes the queues server-side,
+        # stranding the children's proxies (RebuildProxy KeyError)
+        inboxes = self._inboxes = \
+            {s.name: self._mgr.Queue() for s in self.specs}
+        replies = self._replies = \
+            {s.name: self._mgr.Queue() for s in self.specs}
+
+        # 1. GENERATE: spawn nodes, collect identities
+        for s in self.specs:
+            self._controls[s.name] = {"in": self._mgr.Queue(),
+                                      "out": self._events}
+
+        # pick a free port for the ledger manager
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        self._address = sock.getsockname()
+        sock.close()
+
+        for s in self.specs:
+            self._procs[s.name] = self._ctx.Process(
+                target=_node_main,
+                args=(s.__dict__, list(self._address), self._authkey,
+                      inboxes, self._controls[s.name], replies),
+                daemon=True)
+            self._procs[s.name].start()
+
+        identities = {}
+        for _ in self.specs:
+            kind, name, ident = self._events.get(timeout=60)
+            assert kind == "identity"
+            identities[name] = ident
+
+        # 2. SETUP: build pp with collected material, boot the ledger
+        pp_raw = self._make_pp(identities)
+        self._ledger_proc = self._ctx.Process(
+            target=_serve_ledger, args=(self._address, self._authkey),
+            daemon=True)
+        self._ledger_proc.start()
+        mgr = LedgerManager(address=self._address, authkey=self._authkey)
+        for _ in range(100):
+            try:
+                mgr.connect()
+                break
+            except ConnectionRefusedError:
+                time.sleep(0.05)
+        self._ledger_mgr = mgr
+        mgr.ledger().boot(pp_raw, self.driver)
+
+        # 3. RUN: release the nodes
+        auditor = next((s.name for s in self.specs if s.role == "auditor"),
+                       None)
+        for s in self.specs:
+            self._controls[s.name]["in"].put(
+                ("start", pp_raw,
+                 {"precision": self.precision
+                  if self.driver == "fabtoken" else self.bit_length,
+                  "auditor": auditor}))
+
+    def _make_pp(self, identities: dict) -> bytes:
+        issuers = [identities[s.name] for s in self.specs
+                   if s.role == "issuer"]
+        auditors = [identities[s.name] for s in self.specs
+                    if s.role == "auditor"]
+        if self.driver == "fabtoken":
+            from ..core import fabtoken
+            from ..driver.identity import Identity
+
+            pp = fabtoken.setup(self.precision)
+            pp.issuer_ids = [Identity(i) for i in issuers]
+            if auditors:
+                pp.auditor = auditors[0]
+            return pp.serialize()
+        from ..crypto import setup as zk_setup
+        from ..driver.identity import Identity
+
+        pp = zk_setup.setup(self.bit_length)
+        pp.issuer_ids = [Identity(i) for i in issuers]
+        if auditors:
+            pp.auditor = auditors[0]
+        return pp.serialize()
+
+    # ----------------------------------------------------------------- views
+    def call(self, node: str, command: str, *args, timeout: float = 120):
+        """Drive one initiator view on `node` and wait for its result."""
+        self._controls[node]["in"].put((command, *args))
+        while True:
+            kind, name, payload = self._events.get(timeout=timeout)
+            if kind == "error":
+                raise RuntimeError(f"[{name}] {payload}")
+            if kind == "result":
+                return payload
+
+    def issue(self, via: str, issuer: str, to: str, token_type: str,
+              amount: int):
+        status, message, tx_id = self.call(
+            via, "issue", issuer, to, token_type, hex(amount))
+        if status != "VALID":
+            raise RuntimeError(f"issue failed: {message}")
+        return tx_id
+
+    def transfer(self, via: str, token_type: str, amount: int, to: str,
+                 redeem: bool = False):
+        status, message, tx_id = self.call(
+            via, "transfer", token_type, hex(amount), to, redeem)
+        if status != "VALID":
+            raise RuntimeError(f"transfer failed: {message}")
+        return tx_id
+
+    def balance(self, node: str, token_type: str) -> int:
+        return self.call(node, "balance", token_type)
+
+    def wait_tx(self, node: str, tx_id: str, timeout: float = 10.0) -> str:
+        return self.call(node, "wait_tx", tx_id, timeout)
+
+    # ------------------------------------------------------------------ stop
+    def stop(self) -> None:
+        for s in self.specs:
+            try:
+                self._controls[s.name]["in"].put(("stop",))
+            except Exception:
+                pass
+        deadline = time.time() + 5
+        for p in self._procs.values():
+            p.join(timeout=max(0.1, deadline - time.time()))
+            if p.is_alive():
+                p.terminate()
+        if self._ledger_proc is not None:
+            self._ledger_proc.terminate()
+        self._mgr.shutdown()

@@ -46,7 +46,8 @@ import numpy as np
 
 from ..crypto import bn254, rp
 from ..crypto import serialization as ser
-from ..crypto.bn254 import fr_add, fr_inv, fr_mul, fr_sub, hash_to_zr
+from ..crypto.bn254 import (fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub,
+                            hash_to_zr)
 from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows
 from .batching import next_pow2 as _next_pow2
@@ -250,7 +251,7 @@ def _structure_ok(proof: rp.RangeProof, rounds: int) -> bool:
     return True
 
 
-def _fold_coefficients(round_challenges: list[int], n: int,
+def _fold_coefficients(challenge_pairs: list[tuple[int, int]], n: int,
                        invert_first_half: bool) -> list[int]:
     """Expand IPA generator folding into per-index coefficients.
 
@@ -263,10 +264,12 @@ def _fold_coefficients(round_challenges: list[int], n: int,
     index's MOST-significant bit; building the coefficient table by repeated
     doubling appends one bit per step with the last-processed challenge on
     the MSB — hence the challenges are consumed in reverse round order.
+
+    challenge_pairs: (x_r, x_r^-1) per round — inverses are batch-computed
+    by the caller (one Fermat inversion per proof, not one per round).
     """
     coeffs = [1]
-    for x in reversed(round_challenges):
-        x_inv = fr_inv(x)
+    for x, x_inv in reversed(challenge_pairs):
         lo, hi = (x_inv, x) if invert_first_half else (x, x_inv)
         coeffs = [fr_mul(c, lo) for c in coeffs] + \
                  [fr_mul(c, hi) for c in coeffs]
@@ -359,8 +362,11 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     x_ipa = hash_to_zr(raw)
 
     round_ch = [rp.ipa_round_challenge(L, Rp) for L, Rp in zip(ipa.L, ipa.R)]
-    a_coeffs = _fold_coefficients(round_ch, n, invert_first_half=True)
-    b_coeffs = _fold_coefficients(round_ch, n, invert_first_half=False)
+    # one batched inversion for (y, every round challenge)
+    round_inv = fr_batch_inv(round_ch)
+    pairs = list(zip(round_ch, round_inv))
+    a_coeffs = _fold_coefficients(pairs, n, invert_first_half=True)
+    b_coeffs = _fold_coefficients(pairs, n, invert_first_half=False)
 
     a, b = ipa.left, ipa.right
     fixed = []
@@ -381,8 +387,7 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     var = [fr_sub(0, x), R - 1]                          # D, C (eq2)
     for xr in round_ch:                                  # L_r
         var.append(fr_sub(0, fr_mul(xr, xr)))
-    for xr in round_ch:                                  # R_r
-        xr_inv = fr_inv(xr)
+    for xr_inv in round_inv:                             # R_r
         var.append(fr_sub(0, fr_mul(xr_inv, xr_inv)))
     var.append(fr_sub(0, x))                             # T1   (eq1)
     var.append(fr_sub(0, x_sq))                          # T2   (eq1)
@@ -509,10 +514,12 @@ class BatchRangeVerifier:
             var_pts.extend(pts)
             var_sc.extend(fr_mul(w, s) for w, s in zip(weights, eq.var))
 
-        # pad the variable MSM to a power-of-two bucket so varying live
-        # batch sizes reuse a handful of compiled kernel shapes
+        # pad the variable MSM to the next {2^k, 1.5*2^k} bucket: still a
+        # handful of compiled shapes, but at most 33% padding waste (a
+        # plain pow2 ladder wastes up to 2x device work on the hot path)
         v = len(var_pts)
-        v_target = _next_pow2(max(128, v))
+        p = _next_pow2(max(128, v))
+        v_target = (3 * p // 4) if v <= 3 * p // 4 else p
         pts_np = limbs.points_to_projective_limbs(
             var_pts + [bn254.G1_IDENTITY] * (v_target - v))
         sc_np = limbs.scalars_to_limbs(var_sc + [0] * (v_target - v))

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ...token.model import ID
@@ -111,7 +112,22 @@ class TokenChaincode:
 
     # ---- invoke("invoke") -------------------------------------------------
     def process_request(self, tx_id: str, request_raw: bytes) -> CommitEvent:
-        """Validate + translate + commit one token request (tcc.go:220-255)."""
+        """Validate + translate + commit one token request (tcc.go:220-255).
+
+        Instrumented with the span/histogram pair the reference threads
+        through its validator service (tracing.go:18-26, v1/metrics.go)."""
+        from .. import metrics
+
+        t0 = time.perf_counter()
+        try:
+            return self._process_request(tx_id, request_raw)
+        finally:
+            metrics.GLOBAL.histogram("tcc_process_request_seconds").observe(
+                time.perf_counter() - t0)
+            metrics.GLOBAL.counter("tcc_requests_total").add()
+
+    def _process_request(self, tx_id: str,
+                         request_raw: bytes) -> CommitEvent:
         rws = self.ledger.new_rwset()
         translator = Translator(tx_id=tx_id, rws=rws)
 

@@ -82,6 +82,12 @@ class TokenNode:
         pseudonymous wallets."""
         return self.owner_wallet.recipient_identity()
 
+    def issuer_public_identity(self) -> bytes:
+        """Issuer-identity responder view (withdrawal flow's first leg):
+        method, not attribute reach-through, so it works over any session
+        transport (in-process or RPC)."""
+        return bytes(self.keys.identity)
+
     def balance(self, token_type: str) -> int:
         return self.tokendb.balance(self.name, token_type)
 
@@ -120,13 +126,13 @@ class TokenNode:
         (token/request.go:225 via the Request builder)."""
         from ..token.request_builder import Request
 
-        issuer = self.bus.node(issuer_node)
+        issuer_identity = self.bus.node(issuer_node).issuer_public_identity()
         recipient_owner, recipient_ai = \
             self.bus.node(to_node).recipient_identity()
         value = int(amount_hex, 16)
         tx_id = Transaction.new_anchor()
         req = Request(tx_id, self.driver)
-        req.issue(bytes(issuer.keys.identity),
+        req.issue(issuer_identity,
                   [OutputSpec(owner=recipient_owner, token_type=token_type,
                               value=value, audit_info=recipient_ai)],
                   receivers=[to_node])

@@ -1,0 +1,363 @@
+"""HTLC interop: lock/claim/reclaim/deadline/wrong-preimage matrix for BOTH
+driver validator chains (reference fabtoken validator_transfer.go:96-170,
+zkatdlog validator_transfer.go:112-175, htlc script.go/signer.go)."""
+
+import hashlib
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken, zkatdlog
+from fabric_token_sdk_tpu.core.fabtoken.actions import (IssueAction, Output,
+                                                        TransferAction)
+from fabric_token_sdk_tpu.core.zkatdlog.actions import (ActionInput,
+                                                        IssueAction as ZkIssue,
+                                                        Token,
+                                                        TransferAction as ZkTransfer)
+from fabric_token_sdk_tpu.crypto import setup as zk_setup
+from fabric_token_sdk_tpu.crypto import issue_proof, token_commit, transfer_proof
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.driver.identity import Identity
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import (X509Verifier,
+                                                         new_signing_identity)
+from fabric_token_sdk_tpu.services.interop.htlc import (ClaimSignature,
+                                                        HashInfo, Script,
+                                                        claim_key, lock_key,
+                                                        lock_value,
+                                                        script_verifier_resolver)
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.token.model import ID
+
+PREIMAGE = b"the-atomic-swap-preimage"
+IMAGE = hashlib.sha256(PREIMAGE).digest().hex().encode()
+
+
+def _deserializer():
+    return Deserializer(extra_owner_resolvers=[
+        script_verifier_resolver(
+            lambda ident: X509Verifier.from_identity(ident))])
+
+
+def _script(alice, bob, deadline):
+    return Script(sender=bytes(alice.identity),
+                  recipient=bytes(bob.identity), deadline=deadline,
+                  hash_info=HashInfo(hash=IMAGE))
+
+
+# ---------------------------------------------------------------- fabtoken
+
+@pytest.fixture
+def fab():
+    issuer, auditor = new_signing_identity(), new_signing_identity()
+    alice, bob = new_signing_identity(), new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer.identity]
+    pp.auditor = bytes(auditor.identity)
+    cc = TokenChaincode(fabtoken.new_validator(pp, _deserializer()),
+                        MemoryLedger(), pp.serialize())
+    return dict(pp=pp, cc=cc, issuer=issuer, auditor=auditor, alice=alice,
+                bob=bob)
+
+
+def _fab_request(world, tx_id, issues=(), transfers=(), sigs=()):
+    req = TokenRequest(issues=[a.serialize() for a in issues],
+                       transfers=[a.serialize() for a in transfers])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [s(msg) if callable(s) else s for s in sigs]
+    return req, msg
+
+
+def _fab_lock(world, tx_id="lk", deadline=None):
+    """issue to alice, then lock into an htlc script owner."""
+    if deadline is None:
+        deadline = time.time() + 3600
+    alice, bob = world["alice"], world["bob"]
+    issue = IssueAction(issuer=world["issuer"].identity,
+                        outputs=[Output(bytes(alice.identity), "USD",
+                                        "0x64")])
+    req, _ = _fab_request(world, "is-" + tx_id, issues=[issue],
+                          sigs=[world["issuer"].sign])
+    assert world["cc"].process_request("is-" + tx_id,
+                                       req.to_bytes()).status == "VALID"
+    script = _script(alice, bob, deadline)
+    lock = TransferAction(
+        inputs=[ID("is-" + tx_id, 0)],
+        input_tokens=[issue.outputs[0]],
+        outputs=[Output(bytes(script.to_owner()), "USD", "0x64")],
+        metadata={lock_key(IMAGE): lock_value(IMAGE)},
+    )
+    req, _ = _fab_request(world, tx_id, transfers=[lock],
+                          sigs=[alice.sign])
+    ev = world["cc"].process_request(tx_id, req.to_bytes())
+    return ev, lock, script
+
+
+def test_fab_lock_requires_metadata_key(fab):
+    ev, lock, script = _fab_lock(fab, "lk0")
+    assert ev.status == "VALID", ev.message
+
+    # a lock without the metadata entry is rejected
+    lock2 = TransferAction(inputs=lock.inputs,
+                           input_tokens=lock.input_tokens,
+                           outputs=lock.outputs, metadata={})
+    req, _ = _fab_request(fab, "lk0b", transfers=[lock2],
+                          sigs=[fab["alice"].sign])
+    ev = fab["cc"].process_request("lk0b", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "lock" in ev.message
+
+
+def _fab_claim(world, lock, script, tx_id="cl", preimage=PREIMAGE,
+               to=None, quantity="0x64"):
+    to = to or world["bob"]
+    claim = TransferAction(
+        inputs=[ID("lk1", 0)],
+        input_tokens=[lock.outputs[0]],
+        outputs=[Output(bytes(to.identity), "USD", quantity)],
+        metadata={claim_key(script.hash_info.image(preimage)): preimage},
+    )
+    req = TokenRequest(transfers=[claim.serialize()])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    sig = ClaimSignature(recipient_signature=to.sign(msg),
+                         preimage=preimage).to_json()
+    req.signatures = [sig]
+    return claim, req
+
+
+def test_fab_claim_with_preimage(fab):
+    ev, lock, script = _fab_lock(fab, "lk1")
+    assert ev.status == "VALID", ev.message
+    claim, req = _fab_claim(fab, lock, script, tx_id="cl1")
+    ev = fab["cc"].process_request("cl1", req.to_bytes())
+    assert ev.status == "VALID", ev.message
+    # bob owns the claimed token now
+    tok = Output.deserialize(fab["cc"].query_tokens([ID("cl1", 0)])[0])
+    assert tok.owner == bytes(fab["bob"].identity)
+
+
+def test_fab_claim_wrong_preimage_rejected(fab):
+    ev, lock, script = _fab_lock(fab, "lk1")
+    assert ev.status == "VALID"
+    claim, req = _fab_claim(fab, lock, script, tx_id="cl2",
+                            preimage=b"wrong-preimage")
+    ev = fab["cc"].process_request("cl2", req.to_bytes())
+    assert ev.status == "INVALID"
+
+
+def test_fab_claim_after_deadline_rejected(fab):
+    """Past the deadline the recipient can no longer claim."""
+    ev, lock, script = _fab_lock(fab, "lk1", deadline=time.time() + 1.5)
+    assert ev.status == "VALID"
+    time.sleep(1.6)
+    claim, req = _fab_claim(fab, lock, script, tx_id="cl3")
+    ev = fab["cc"].process_request("cl3", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "recipient" in ev.message or "sender" in ev.message
+
+
+def test_fab_reclaim_after_deadline(fab):
+    ev, lock, script = _fab_lock(fab, "lk1", deadline=time.time() + 1.0)
+    assert ev.status == "VALID"
+    time.sleep(1.1)
+    alice = fab["alice"]
+    reclaim = TransferAction(
+        inputs=[ID("lk1", 0)],
+        input_tokens=[lock.outputs[0]],
+        outputs=[Output(bytes(alice.identity), "USD", "0x64")],
+    )
+    req, _ = _fab_request(fab, "rc1", transfers=[reclaim],
+                          sigs=[alice.sign])
+    ev = fab["cc"].process_request("rc1", req.to_bytes())
+    assert ev.status == "VALID", ev.message
+
+
+def test_fab_reclaim_before_deadline_rejected(fab):
+    ev, lock, script = _fab_lock(fab, "lk1")  # deadline far future
+    assert ev.status == "VALID"
+    alice = fab["alice"]
+    reclaim = TransferAction(
+        inputs=[ID("lk1", 0)],
+        input_tokens=[lock.outputs[0]],
+        outputs=[Output(bytes(alice.identity), "USD", "0x64")],
+    )
+    req, _ = _fab_request(fab, "rc2", transfers=[reclaim],
+                          sigs=[alice.sign])
+    ev = fab["cc"].process_request("rc2", req.to_bytes())
+    assert ev.status == "INVALID"
+
+
+def test_fab_script_spend_must_be_single_output(fab):
+    ev, lock, script = _fab_lock(fab, "lk1")
+    assert ev.status == "VALID"
+    claim = TransferAction(
+        inputs=[ID("lk1", 0)],
+        input_tokens=[lock.outputs[0]],
+        outputs=[Output(bytes(fab["bob"].identity), "USD", "0x32"),
+                 Output(bytes(fab["alice"].identity), "USD", "0x32")],
+        metadata={claim_key(IMAGE): PREIMAGE},
+    )
+    req = TokenRequest(transfers=[claim.serialize()])
+    msg = req.message_to_sign(b"cl5")
+    req.auditor_signatures = [fab["auditor"].sign(msg)]
+    req.signatures = [ClaimSignature(fab["bob"].sign(msg),
+                                     PREIMAGE).to_json()]
+    ev = fab["cc"].process_request("cl5", req.to_bytes())
+    assert ev.status == "INVALID"
+    assert "only transfers the ownership" in ev.message
+
+
+# ---------------------------------------------------------------- zkatdlog
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def zk_world():
+    issuer, auditor = new_signing_identity(), new_signing_identity()
+    alice, bob = new_signing_identity(), new_signing_identity()
+    pp = zk_setup.setup(BIT_LENGTH)
+    pp.issuer_ids = [issuer.identity]
+    pp.auditor = bytes(auditor.identity)
+    cc = TokenChaincode(
+        zkatdlog.new_validator(pp, _deserializer(), device=False),
+        MemoryLedger(), pp.serialize())
+    return dict(pp=pp, cc=cc, issuer=issuer, auditor=auditor, alice=alice,
+                bob=bob)
+
+
+def _zk_lock(world, tx_id, deadline):
+    """ZK issue to alice, then 1-in/1-out transfer into the script owner.
+
+    Each lock uses a tx-unique preimage: the ledger enforces lock-key
+    uniqueness (one outstanding lock per hash), so reusing a hash across
+    locks on one ledger is correctly rejected.
+    """
+    preimage = f"preimage-{tx_id}".encode()
+    image = hashlib.sha256(preimage).digest().hex().encode()
+    pp = world["pp"]
+    alice, bob = world["alice"], world["bob"]
+    coms, wits = token_commit.get_tokens_with_witness(
+        [77], "USD", pp.pedersen_generators)
+    proof = issue_proof.issue_prove([w.as_tuple() for w in wits], coms, pp)
+    issue = ZkIssue(issuer=world["issuer"].identity,
+                    outputs=[Token(bytes(alice.identity), coms[0])],
+                    proof=proof)
+    req = TokenRequest(issues=[issue.serialize()])
+    msg = req.message_to_sign(f"zi-{tx_id}".encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [world["issuer"].sign(msg)]
+    assert world["cc"].process_request(f"zi-{tx_id}",
+                                       req.to_bytes()).status == "VALID"
+
+    script = Script(sender=bytes(alice.identity),
+                    recipient=bytes(bob.identity), deadline=deadline,
+                    hash_info=HashInfo(hash=image))
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [77], "USD", pp.pedersen_generators)
+    tproof = transfer_proof.transfer_prove(
+        [w.as_tuple() for w in wits], [w.as_tuple() for w in out_wits],
+        coms, out_coms, pp)
+    lock = ZkTransfer(
+        inputs=[ActionInput(id=ID(f"zi-{tx_id}", 0),
+                            token=issue.outputs[0])],
+        outputs=[Token(bytes(script.to_owner()), out_coms[0])],
+        proof=tproof,
+        metadata={lock_key(image): lock_value(image)},
+    )
+    req = TokenRequest(transfers=[lock.serialize()])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [alice.sign(msg)]
+    ev = world["cc"].process_request(tx_id, req.to_bytes())
+    return ev, lock, script, out_wits, preimage
+
+
+def _zk_spend_script(world, lock, script, out_wits, tx_id, to_identity,
+                     claim_preimage=None, signer=None):
+    """1-in/1-out spend of the script-owned commitment token."""
+    pp = world["pp"]
+    in_wits = [w.as_tuple() for w in out_wits]
+    new_coms, new_wits = token_commit.get_tokens_with_witness(
+        [77], "USD", pp.pedersen_generators)
+    tproof = transfer_proof.transfer_prove(
+        in_wits, [w.as_tuple() for w in new_wits],
+        [lock.outputs[0].data], new_coms, pp)
+    action = ZkTransfer(
+        inputs=[ActionInput(id=ID(lock_tx_id(world, lock), 0),
+                            token=lock.outputs[0])],
+        outputs=[Token(to_identity, new_coms[0])],
+        proof=tproof,
+    )
+    if claim_preimage is not None:
+        action.metadata[claim_key(
+            script.hash_info.image(claim_preimage))] = claim_preimage
+    req = TokenRequest(transfers=[action.serialize()])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    if claim_preimage is not None:
+        req.signatures = [ClaimSignature(
+            recipient_signature=signer.sign(msg),
+            preimage=claim_preimage).to_json()]
+    else:
+        req.signatures = [signer.sign(msg)]
+    return world["cc"].process_request(tx_id, req.to_bytes())
+
+
+_LOCK_TXIDS = {}
+
+
+def lock_tx_id(world, lock):
+    return _LOCK_TXIDS[id(lock)]
+
+
+def _zk_lock_tracked(world, tx_id, deadline):
+    ev, lock, script, wits, preimage = _zk_lock(world, tx_id, deadline)
+    _LOCK_TXIDS[id(lock)] = tx_id
+    return ev, lock, script, wits, preimage
+
+
+def test_zk_htlc_lock_and_claim(zk_world):
+    ev, lock, script, wits, preimage = _zk_lock_tracked(
+        zk_world, "zlk1", time.time() + 3600)
+    assert ev.status == "VALID", ev.message
+    ev = _zk_spend_script(zk_world, lock, script, wits, "zcl1",
+                          bytes(zk_world["bob"].identity),
+                          claim_preimage=preimage, signer=zk_world["bob"])
+    assert ev.status == "VALID", ev.message
+
+
+def test_zk_htlc_wrong_preimage_rejected(zk_world):
+    ev, lock, script, wits, _ = _zk_lock_tracked(zk_world, "zlk2",
+                                                 time.time() + 3600)
+    assert ev.status == "VALID", ev.message
+    ev = _zk_spend_script(zk_world, lock, script, wits, "zcl2",
+                          bytes(zk_world["bob"].identity),
+                          claim_preimage=b"nope", signer=zk_world["bob"])
+    assert ev.status == "INVALID"
+
+
+def test_zk_htlc_reclaim_after_deadline(zk_world):
+    # host proving takes seconds: the deadline must outlive the lock's own
+    # validation, then we wait it out before reclaiming
+    deadline = time.time() + 12.0
+    ev, lock, script, wits, _ = _zk_lock_tracked(zk_world, "zlk3", deadline)
+    assert ev.status == "VALID", ev.message
+    time.sleep(max(0.0, deadline - time.time()) + 0.2)
+    ev = _zk_spend_script(zk_world, lock, script, wits, "zrc3",
+                          bytes(zk_world["alice"].identity),
+                          signer=zk_world["alice"])
+    assert ev.status == "VALID", ev.message
+
+
+def test_zk_htlc_claim_by_sender_before_deadline_rejected(zk_world):
+    ev, lock, script, wits, _ = _zk_lock_tracked(zk_world, "zlk4",
+                                                 time.time() + 3600)
+    assert ev.status == "VALID", ev.message
+    # alice (sender) tries to take it back early, to herself
+    ev = _zk_spend_script(zk_world, lock, script, wits, "zrc4",
+                          bytes(zk_world["alice"].identity),
+                          signer=zk_world["alice"])
+    assert ev.status == "INVALID"

@@ -1,0 +1,93 @@
+"""Metrics/tracing subsystem (reference metrics/provider.go:26-75,
+v1/metrics.go:14-40, tracing.go:18-26)."""
+
+import threading
+
+from fabric_token_sdk_tpu.services.metrics import (MetricsProvider, Tracer)
+
+
+def test_counter_and_histogram_with_labels():
+    p = MetricsProvider({"tms": "net,ch,ns"})
+    p.counter("requests_total", driver="zkatdlog").add()
+    p.counter("requests_total", driver="zkatdlog").add(2)
+    p.counter("requests_total", driver="fabtoken").add()
+    h = p.histogram("verify_seconds")
+    for v in (0.002, 0.003, 0.8):
+        h.observe(v)
+
+    snap = p.snapshot()
+    zk = [(k, v) for k, v in snap.items()
+          if k[0] == "requests_total" and ("driver", "zkatdlog") in k[1]]
+    assert zk[0][1] == 3
+    hist = [v for k, v in snap.items() if k[0] == "verify_seconds"][0]
+    assert hist["count"] == 3
+    assert abs(hist["sum"] - 0.805) < 1e-9
+
+
+def test_with_labels_shares_registry():
+    p = MetricsProvider()
+    child = p.with_labels(tms="a")
+    child.counter("x").add()
+    assert [v for k, v in p.snapshot().items() if k[0] == "x"] == [1.0]
+
+
+def test_prometheus_text_format():
+    p = MetricsProvider()
+    p.counter("reqs", code="200").add(5)
+    p.histogram("lat").observe(0.002)
+    text = p.prometheus_text()
+    assert 'reqs{code="200"} 5.0' in text
+    assert "lat_count " in text and "lat_sum " in text
+    assert 'lat_bucket' in text
+
+
+def test_histogram_thread_safety():
+    p = MetricsProvider()
+    h = p.histogram("hot")
+
+    def worker():
+        for _ in range(1000):
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.n == 8000
+
+
+def test_tracer_spans_record_durations_and_events():
+    p = MetricsProvider()
+    tr = Tracer(provider=p)
+    with tr.span("audit_check", tx_id="t1") as sp:
+        sp.add_event("start_check")
+        sp.add_event("end_check")
+    assert tr.finished[-1].duration > 0
+    assert [e[0] for e in tr.finished[-1].events] == ["start_check",
+                                                      "end_check"]
+    snap = p.snapshot()
+    assert [v for k, v in snap.items()
+            if k[0] == "span_audit_check_seconds"][0]["count"] == 1
+
+
+def test_hot_path_instrumented_end_to_end():
+    """The chaincode request path feeds the global registry."""
+    from fabric_token_sdk_tpu.core import fabtoken
+    from fabric_token_sdk_tpu.services import metrics
+    from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+    from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+    from fabric_token_sdk_tpu.services.network.tcc import (MemoryLedger,
+                                                           TokenChaincode)
+
+    before = [v for k, v in metrics.GLOBAL.snapshot().items()
+              if k[0] == "tcc_requests_total"]
+    issuer = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer.identity]
+    cc = TokenChaincode(fabtoken.new_validator(pp, Deserializer()),
+                        MemoryLedger(), pp.serialize())
+    cc.process_request("mtx", b"garbage")  # INVALID, still counted
+    after = [v for k, v in metrics.GLOBAL.snapshot().items()
+             if k[0] == "tcc_requests_total"]
+    assert after and after[0] == (before[0] if before else 0) + 1

@@ -1,0 +1,57 @@
+"""NWO-style multiprocess e2e: real OS processes over a shared ledger
+process (reference integration/nwo/token platform + fungible TestAll
+shape, SURVEY.md §4 'multi-node without real cluster')."""
+
+import pytest
+
+from fabric_token_sdk_tpu.harness import NodeSpec, Platform
+
+
+@pytest.fixture
+def platform():
+    p = Platform(specs=[
+        NodeSpec("issuer", role="issuer"),
+        NodeSpec("auditor", role="auditor"),
+        NodeSpec("alice"),
+        NodeSpec("bob"),
+    ])
+    p.start()
+    yield p
+    p.stop()
+
+
+def test_multiprocess_issue_transfer_redeem(platform):
+    p = platform
+    tx1 = p.issue(via="alice", issuer="issuer", to="alice",
+                  token_type="USD", amount=1000)
+    assert p.wait_tx("alice", tx1) == "Confirmed"
+    assert p.balance("alice", "USD") == 1000
+
+    tx2 = p.transfer(via="alice", token_type="USD", amount=300, to="bob")
+    assert p.wait_tx("alice", tx2) == "Confirmed"
+    # bob's delivery service ingests asynchronously; wait on his balance
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline and p.balance("bob", "USD") != 300:
+        time.sleep(0.05)
+    assert p.balance("bob", "USD") == 300
+    assert p.balance("alice", "USD") == 700
+
+    tx3 = p.transfer(via="bob", token_type="USD", amount=100, to="",
+                     redeem=True)
+    assert p.wait_tx("bob", tx3) == "Confirmed"
+    assert p.balance("bob", "USD") == 200
+
+
+def test_multiprocess_double_spend_rejected(platform):
+    p = platform
+    tx1 = p.issue(via="alice", issuer="issuer", to="alice",
+                  token_type="EUR", amount=10)
+    p.wait_tx("alice", tx1)
+    tx2 = p.transfer(via="alice", token_type="EUR", amount=10, to="bob")
+    p.wait_tx("alice", tx2)
+    # alice's tokens are spent; further spend must fail (selector finds
+    # nothing — the insufficient-funds guard on a live multiprocess net)
+    with pytest.raises(RuntimeError):
+        p.transfer(via="alice", token_type="EUR", amount=10, to="bob")

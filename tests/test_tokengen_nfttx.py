@@ -1,0 +1,163 @@
+"""tokengen CLI golden round-trips + NFT layer tests (reference
+cmd/tokengen, token/services/nfttx)."""
+
+import json
+
+import pytest
+
+from fabric_token_sdk_tpu.cmd.tokengen import build_parser, main
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.services.nfttx import (NFTService, NoResults,
+                                                 marshal_state, state_id,
+                                                 unmarshal_state)
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+
+
+# ----------------------------------------------------------------- tokengen
+
+def _write_identity(tmp_path, name):
+    from cryptography.hazmat.primitives import serialization
+
+    keys = new_signing_identity()
+    pem = keys.private_key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    p = tmp_path / f"{name}.pem"
+    p.write_bytes(pem)
+    return p, bytes(keys.identity)
+
+
+def test_tokengen_fabtoken_roundtrip(tmp_path, capsys):
+    issuer_pem, issuer_der = _write_identity(tmp_path, "issuer")
+    rc = main(["gen", "fabtoken", "--precision", "32",
+               "--issuer", str(issuer_pem), "--output", str(tmp_path)])
+    assert rc == 0
+    out = tmp_path / "fabtoken_pp.json"
+    raw = out.read_bytes()
+    pp = fabtoken.PublicParams.deserialize(raw)
+    assert pp.quantity_precision == 32
+    assert pp.max_token == (1 << 32) - 1
+    assert [bytes(i) for i in pp.issuer_ids] == [issuer_der]
+    # golden stability: re-serialize is byte-identical
+    assert pp.serialize() == raw
+    # registry accepts the generated file directly
+    from fabric_token_sdk_tpu.core.registry import default_registry
+
+    assert default_registry().new_bundle(raw).label == "fabtoken"
+
+
+def test_tokengen_dlog_roundtrip(tmp_path, capsys):
+    from fabric_token_sdk_tpu.crypto.setup import PublicParams
+
+    issuer_pem, _ = _write_identity(tmp_path, "issuer")
+    auditor_pem, _ = _write_identity(tmp_path, "aud")
+    rc = main(["gen", "dlog", "--bits", "16", "--issuer", str(issuer_pem),
+               "--auditor", str(auditor_pem), "--tpu-batch-size", "256",
+               "--output", str(tmp_path)])
+    assert rc == 0
+    raw = (tmp_path / "zkatdlog_pp.json").read_bytes()
+    pp = PublicParams.deserialize(raw)
+    pp.validate()
+    assert pp.range_proof_params.bit_length == 16
+    assert pp.tpu_batch.batch_size == 256
+    assert pp.serialize() == raw
+
+    # pp print reports the right summary
+    rc = main(["pp", "print", str(tmp_path / "zkatdlog_pp.json")])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "identifier: zkatdlog" in text and "bit_length: 16" in text
+
+
+def test_tokengen_base_exponent_selects_bits(tmp_path):
+    rc = main(["gen", "dlog", "--base", "2", "--exponent", "5",
+               "--output", str(tmp_path)])  # 2^5 = 32
+    from fabric_token_sdk_tpu.crypto.setup import PublicParams
+
+    assert rc == 0
+    pp = PublicParams.deserialize((tmp_path / "zkatdlog_pp.json").read_bytes())
+    assert pp.range_proof_params.bit_length == 32
+
+
+def test_tokengen_rejects_unsupported_bits(tmp_path):
+    assert main(["gen", "dlog", "--bits", "17",
+                 "--output", str(tmp_path)]) == 2
+
+
+def test_tokengen_update_preserves_material(tmp_path):
+    from fabric_token_sdk_tpu.crypto.setup import PublicParams
+
+    assert main(["gen", "dlog", "--bits", "16",
+                 "--output", str(tmp_path)]) == 0
+    path = tmp_path / "zkatdlog_pp.json"
+    before = PublicParams.deserialize(path.read_bytes())
+    assert main(["update", str(path)]) == 0
+    after = PublicParams.deserialize(path.read_bytes())
+    # generators unchanged by an update (identities/generators preserved)
+    from fabric_token_sdk_tpu.crypto import serialization as ser
+
+    assert ser.g1_to_bytes(after.pedersen_generators[0]) == \
+        ser.g1_to_bytes(before.pedersen_generators[0])
+
+
+# -------------------------------------------------------------------- nfttx
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    cc = TokenChaincode(fabtoken.new_validator(pp, Deserializer()),
+                        MemoryLedger(), pp.serialize())
+    bus = SessionBus()
+    nodes = {
+        "issuer": TokenNode("issuer", issuer_keys, bus, cc,
+                            auditor_name="auditor"),
+        "auditor": AuditorNode("auditor", auditor_keys, bus, cc,
+                               auditor_name="auditor"),
+        "alice": TokenNode("alice", new_signing_identity(), bus, cc,
+                           auditor_name="auditor"),
+        "bob": TokenNode("bob", new_signing_identity(), bus, cc,
+                         auditor_name="auditor"),
+    }
+    return nodes
+
+
+def test_nft_state_marshalling_roundtrip():
+    state = {"model": "house", "address": "5th avenue"}
+    token_type = marshal_state(state)
+    restored = unmarshal_state(token_type)
+    assert restored["model"] == "house"
+    assert state_id(restored)  # unique ID stamped
+    # two marshals of the same state get DIFFERENT ids (uniqueness)
+    assert state_id(unmarshal_state(marshal_state(state))) != \
+        state_id(restored)
+
+
+def test_nft_issue_transfer_query(net):
+    alice_svc = NFTService(net["alice"])
+    bob_svc = NFTService(net["bob"])
+    state = alice_svc.issue("issuer", "alice",
+                            {"model": "house", "address": "5th avenue"})
+    sid = state_id(state)
+
+    # query by arbitrary key (qe.go:52 QueryByKey)
+    assert alice_svc.query_by_key("address", "5th avenue")["model"] == \
+        "house"
+
+    alice_svc.transfer(sid, "bob")
+    assert bob_svc.query_by_key("model", "house")
+    with pytest.raises(NoResults):
+        alice_svc.query_by_key("model", "house")  # alice no longer owns it
+
+
+def test_nft_unknown_query(net):
+    with pytest.raises(NoResults):
+        NFTService(net["alice"]).query_by_key("model", "missing")

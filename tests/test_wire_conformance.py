@@ -94,7 +94,7 @@ def test_transfer_action_bytes_equal_oracle():
         proof=na.Proof(proof=b"zkp"),
         metadata={"k1": b"v1", "k2": b"v2"},
     )
-    assert ours.serialize() == oracle.SerializeToString()
+    assert ours.serialize() == oracle.SerializeToString(deterministic=True)
 
     parsed = TransferAction.deserialize(oracle.SerializeToString())
     assert parsed.inputs[0].id == ID("tx0", 3)
