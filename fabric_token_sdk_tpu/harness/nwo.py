@@ -201,7 +201,8 @@ class _RemoteNodeStub:
     """Initiator-side proxy for a responder view on another node."""
 
     _METHODS = ("sign_transfer", "sign_issue", "audit", "receive_opening",
-                "recipient_identity", "issuer_public_identity")
+                "recipient_identity", "issuer_public_identity",
+                "owns_identity", "sign_as_co_owner")
 
     def __init__(self, bus: QueueBus, name: str):
         self._bus = bus

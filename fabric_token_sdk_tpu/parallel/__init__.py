@@ -7,4 +7,4 @@ axis is model-parallel ('tp') with an all-gather + point-fold combine over
 ICI (XLA collectives, not NCCL/MPI — SURVEY.md §2.5 "TPU-native equivalent").
 """
 
-from .mesh import make_mesh, sharded_msm_is_identity  # noqa: F401
+from .mesh import make_mesh, shard_batch, sharded_msm_is_identity  # noqa: F401

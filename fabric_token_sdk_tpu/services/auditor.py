@@ -44,9 +44,15 @@ class AuditorNode(TokenNode):
                 self.audit_check(tx)
             except Exception as e:
                 raise AuditError(f"audit check failed: {e}") from e
-        # 2. lock enrollment IDs (auditor/auditor.go:80-100)
-        eids = sorted({name for name in tx.input_owners})
-        self.auditdb.acquire_locks(tx.tx_id, eids)
+        # 2. lock enrollment IDs (auditor/auditor.go:80-100); a multisig
+        # input lists every co-owner — each one's EID is locked
+        eids = set()
+        for owner in tx.input_owners:
+            if isinstance(owner, (list, tuple)):
+                eids.update(owner)
+            else:
+                eids.add(owner)
+        self.auditdb.acquire_locks(tx.tx_id, sorted(eids))
         # 3. append records + subscribe finality (auditor/auditor.go:102)
         for rec in tx.records:
             self.auditdb.add_transaction(rec)

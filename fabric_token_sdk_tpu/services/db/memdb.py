@@ -1,0 +1,297 @@
+"""Pure in-memory DB backend: same contract as the sqlite driver.
+
+The reference ships one SQL schema with multiple drivers (sqlite, postgres,
+memory — token/services/db/sql/*, db/dbtest) and runs ONE shared test
+suite against all of them. This is the memory driver: plain dicts behind
+the exact TokenDB/TransactionDB/AuditDB/TokenLockDB/IdentityDB API, for
+tests and ephemeral nodes where durability is not wanted.
+
+tests/test_db_contract.py runs the shared contract suite against both this
+module and sqldb.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ...token.model import ID, UnspentToken
+from .sqldb import DBError, TxRecord, TxStatus  # shared contract types
+
+
+class _Base:
+    def __init__(self, path: str = ":memory:"):
+        # path accepted for driver-interface parity; always ephemeral
+        self._mu = threading.RLock()
+
+    def close(self) -> None:
+        pass
+
+
+@dataclass
+class _TokenRow:
+    owner_raw: bytes
+    token_type: str
+    quantity: str
+    amount: int
+    ledger_format: str = ""
+    ledger_token: bytes = b""
+    ledger_metadata: bytes = b""
+    is_deleted: bool = False
+    spent_by: str = ""
+    spendable: bool = True
+    owners: list[str] = field(default_factory=list)
+
+
+class TokenDB(_Base):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._rows: dict[tuple[str, int], _TokenRow] = {}
+
+    def store_token(self, token_id: ID, owner_raw: bytes, token_type: str,
+                    quantity_hex: str, owners: list[str],
+                    ledger_format: str = "", ledger_token: bytes = b"",
+                    ledger_metadata: bytes = b"",
+                    spendable: bool = True) -> None:
+        with self._mu:
+            self._rows[(token_id.tx_id, token_id.index)] = _TokenRow(
+                owner_raw=bytes(owner_raw), token_type=token_type,
+                quantity=quantity_hex, amount=int(quantity_hex, 16),
+                ledger_format=ledger_format, ledger_token=ledger_token,
+                ledger_metadata=ledger_metadata, spendable=spendable,
+                owners=list(owners))
+
+    def delete_token(self, token_id: ID, spent_by: str) -> None:
+        with self._mu:
+            row = self._rows.get((token_id.tx_id, token_id.index))
+            if row is not None:
+                row.is_deleted = True
+                row.spent_by = spent_by
+
+    def is_mine(self, token_id: ID, wallet_id: str) -> bool:
+        with self._mu:
+            row = self._rows.get((token_id.tx_id, token_id.index))
+            return row is not None and wallet_id in row.owners
+
+    def unspent_tokens(self, wallet_id: str | None = None,
+                       token_type: str | None = None) -> list[UnspentToken]:
+        with self._mu:
+            out = []
+            for (tx_id, idx), row in sorted(self._rows.items()):
+                if row.is_deleted:
+                    continue
+                if wallet_id is not None and wallet_id not in row.owners:
+                    continue
+                if token_type is not None and row.token_type != token_type:
+                    continue
+                out.append(UnspentToken(id=ID(tx_id, idx),
+                                        owner=row.owner_raw,
+                                        type=row.token_type,
+                                        quantity=row.quantity))
+            return out
+
+    def balance(self, wallet_id: str | None, token_type: str) -> int:
+        with self._mu:
+            total = 0
+            for row in self._rows.values():
+                if row.is_deleted or row.token_type != token_type:
+                    continue
+                if wallet_id is not None and wallet_id not in row.owners:
+                    continue
+                total += row.amount
+            return total
+
+    def get_token(self, token_id: ID, include_deleted: bool = False):
+        with self._mu:
+            row = self._rows.get((token_id.tx_id, token_id.index))
+            if row is None or (row.is_deleted and not include_deleted):
+                return None
+            return UnspentToken(id=token_id, owner=row.owner_raw,
+                                type=row.token_type, quantity=row.quantity)
+
+    def get_ledger_token(self, token_id: ID) -> tuple[bytes, bytes] | None:
+        with self._mu:
+            row = self._rows.get((token_id.tx_id, token_id.index))
+            if row is None or row.is_deleted:
+                return None
+            return (row.ledger_token, row.ledger_metadata)
+
+    def whose(self, token_id: ID) -> list[str]:
+        with self._mu:
+            row = self._rows.get((token_id.tx_id, token_id.index))
+            return list(row.owners) if row else []
+
+
+class TransactionDB(_Base):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._transactions: list[TxRecord] = []
+        self._requests: dict[str, bytes] = {}
+        self._status: dict[str, tuple[str, str]] = {}
+        self._acks: dict[str, dict[bytes, bytes]] = {}
+        self._validations: dict[str, tuple[bytes, dict]] = {}
+
+    def add_transaction(self, rec: TxRecord) -> None:
+        with self._mu:
+            self._transactions.append(rec)
+            self._status.setdefault(rec.tx_id, (rec.status, ""))
+
+    def add_token_request(self, tx_id: str, request: bytes,
+                          status: str = TxStatus.PENDING) -> None:
+        with self._mu:
+            self._requests[tx_id] = request
+            self._status.setdefault(tx_id, (status, ""))
+
+    def get_token_request(self, tx_id: str) -> bytes | None:
+        with self._mu:
+            return self._requests.get(tx_id)
+
+    def set_status(self, tx_id: str, status: str, message: str = "") -> None:
+        with self._mu:
+            self._status[tx_id] = (status, message)
+            for rec in self._transactions:
+                if rec.tx_id == tx_id:
+                    rec.status = status
+
+    def get_status(self, tx_id: str) -> str:
+        with self._mu:
+            return self._status.get(tx_id, (TxStatus.UNKNOWN, ""))[0]
+
+    def query_transactions(self, tx_id: str | None = None,
+                           statuses: list[str] | None = None,
+                           action_type: str | None = None) -> list[TxRecord]:
+        with self._mu:
+            out = []
+            for rec in self._transactions:
+                if tx_id is not None and rec.tx_id != tx_id:
+                    continue
+                if statuses and rec.status not in statuses:
+                    continue
+                if action_type is not None and rec.action_type != action_type:
+                    continue
+                out.append(rec)
+            return out
+
+    def add_endorsement_ack(self, tx_id: str, endorser: bytes,
+                            sigma: bytes) -> None:
+        with self._mu:
+            self._acks.setdefault(tx_id, {})[bytes(endorser)] = sigma
+
+    def get_endorsement_acks(self, tx_id: str) -> dict[bytes, bytes]:
+        with self._mu:
+            return dict(self._acks.get(tx_id, {}))
+
+    def add_validation_record(self, tx_id: str, token_request: bytes,
+                              metadata: bytes = b"") -> None:
+        with self._mu:
+            self._validations[tx_id] = (token_request, metadata)
+
+
+class AuditDB(TransactionDB):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._locks: dict[str, str] = {}  # eid -> tx_id
+
+    def acquire_locks(self, tx_id: str, eids: list[str]) -> None:
+        with self._mu:
+            for eid in eids:
+                holder = self._locks.get(eid)
+                if holder is not None and holder != tx_id:
+                    raise DBError(
+                        f"eid [{eid}] already locked by [{holder}]")
+            for eid in eids:
+                self._locks[eid] = tx_id
+
+    def release_locks(self, tx_id: str) -> None:
+        with self._mu:
+            for eid in [e for e, t in self._locks.items() if t == tx_id]:
+                del self._locks[eid]
+
+    def locked_eids(self) -> list[str]:
+        with self._mu:
+            return sorted(self._locks)
+
+    def payments(self, eid_field: str, token_type: str | None = None
+                 ) -> list[TxRecord]:
+        with self._mu:
+            out = []
+            for rec in self._transactions:
+                if eid_field not in (rec.sender, rec.recipient):
+                    continue
+                if token_type is not None and rec.token_type != token_type:
+                    continue
+                out.append(rec)
+            return out
+
+
+class TokenLockDB(_Base):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._locks: dict[tuple[str, int], tuple[str, float]] = {}
+
+    def lock(self, token_id: ID, consumer_tx_id: str) -> bool:
+        with self._mu:
+            key = (token_id.tx_id, token_id.index)
+            holder = self._locks.get(key)
+            if holder is not None:
+                # re-entrant for the same consumer; the lease timestamp is
+                # NOT refreshed (matches the sqlite driver, where the
+                # original INSERT's created_at stands)
+                return holder[0] == consumer_tx_id
+            self._locks[key] = (consumer_tx_id, time.time())
+            return True
+
+    def unlock_by_consumer(self, consumer_tx_id: str) -> None:
+        with self._mu:
+            for key in [k for k, (c, _) in self._locks.items()
+                        if c == consumer_tx_id]:
+                del self._locks[key]
+
+    def holder(self, token_id: ID) -> str | None:
+        with self._mu:
+            entry = self._locks.get((token_id.tx_id, token_id.index))
+            return entry[0] if entry else None
+
+    def evict_expired(self, lease_seconds: float) -> int:
+        with self._mu:
+            now = time.time()
+            expired = [k for k, (_, t) in self._locks.items()
+                       if now - t > lease_seconds]
+            for k in expired:
+                del self._locks[k]
+            return len(expired)
+
+
+class IdentityDB(_Base):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._wallets: dict[tuple[str, str], tuple[bytes, bytes]] = {}
+        self._audit_info: dict[bytes, bytes] = {}
+
+    def register_wallet(self, wallet_id: str, role: str, identity: bytes,
+                        config: bytes = b"") -> None:
+        with self._mu:
+            self._wallets[(wallet_id, role)] = (bytes(identity), config)
+
+    def wallet_identity(self, wallet_id: str, role: str) -> bytes | None:
+        with self._mu:
+            entry = self._wallets.get((wallet_id, role))
+            return entry[0] if entry else None
+
+    def wallets(self, role: str | None = None) -> list[tuple[str, str, bytes]]:
+        with self._mu:
+            out = []
+            for (wid, r), (ident, _) in sorted(self._wallets.items()):
+                if role is not None and r != role:
+                    continue
+                out.append((wid, r, ident))
+            return out
+
+    def store_audit_info(self, identity: bytes, audit_info: bytes) -> None:
+        with self._mu:
+            self._audit_info[bytes(identity)] = audit_info
+
+    def get_audit_info(self, identity: bytes) -> bytes | None:
+        with self._mu:
+            return self._audit_info.get(bytes(identity))

@@ -268,7 +268,8 @@ class TransactionDB(_Base):
         return row[0] if row else TxStatus.UNKNOWN
 
     def query_transactions(self, tx_id: str | None = None,
-                           statuses: list[str] | None = None) -> list[TxRecord]:
+                           statuses: list[str] | None = None,
+                           action_type: str | None = None) -> list[TxRecord]:
         q = ("SELECT tx_id, action_type, sender, recipient, token_type, "
              "amount, status, timestamp, application_metadata "
              "FROM transactions")
@@ -280,6 +281,9 @@ class TransactionDB(_Base):
             clauses.append(
                 "status IN (" + ",".join("?" * len(statuses)) + ")")
             params.extend(statuses)
+        if action_type is not None:
+            clauses.append("action_type = ?")
+            params.append(action_type)
         if clauses:
             q += " WHERE " + " AND ".join(clauses)
         q += " ORDER BY seq"
@@ -324,7 +328,17 @@ class AuditDB(TransactionDB):
     """
 
     def acquire_locks(self, tx_id: str, eids: list[str]) -> None:
+        """All-or-nothing EID locking (auditor/auditor.go:80-100): an eid
+        held by ANOTHER transaction conflicts; re-acquiring under the same
+        transaction is idempotent."""
         with self._mu:
+            for eid in eids:
+                row = self.conn.execute(
+                    "SELECT tx_id FROM eid_locks WHERE eid=?",
+                    (eid,)).fetchone()
+                if row is not None and row[0] != tx_id:
+                    raise DBError(
+                        f"eid [{eid}] already locked by [{row[0]}]")
             for eid in eids:
                 self.conn.execute(
                     "INSERT OR REPLACE INTO eid_locks VALUES (?,?,?)",
@@ -366,7 +380,8 @@ class TokenLockDB(_Base):
     """
 
     def lock(self, token_id: ID, consumer_tx_id: str) -> bool:
-        """Returns True if the lock was acquired."""
+        """Returns True if the lock was acquired. Re-entrant for the SAME
+        consumer (sherdlock lease semantics)."""
         with self._mu:
             try:
                 self.conn.execute(
@@ -376,7 +391,11 @@ class TokenLockDB(_Base):
                 self.conn.commit()
                 return True
             except sqlite3.IntegrityError:
-                return False
+                row = self.conn.execute(
+                    "SELECT consumer_tx_id FROM token_locks WHERE tx_id=? "
+                    "AND idx=?",
+                    (token_id.tx_id, token_id.index)).fetchone()
+                return row is not None and row[0] == consumer_tx_id
 
     def unlock_by_consumer(self, consumer_tx_id: str) -> None:
         with self._mu:

@@ -1,0 +1,172 @@
+"""Multisig (escrow) identities: co-owned tokens requiring all signatures.
+
+Behavioral mirror of reference token/services/identity/multisig:
+  - ``MultiIdentity`` (identity.go:23-38): Go asn1.Marshal of
+    {Identities [][]byte} — SEQUENCE { SEQUENCE OF OCTET STRING };
+  - ``WrapIdentities`` (identity.go:41-56): typed identity with type "ms";
+  - ``MultiSignature`` + ``JoinSignatures`` (sig.go): one signature blob
+    carrying every co-owner's signature in identity order;
+  - ``Verifier`` (sig.go:52+): all co-signatures must verify;
+  - audit-info matcher (deserializer.go:25-122): per-co-owner audit infos
+    matched recursively.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ...crypto import serialization as ser
+from ...driver.identity import Identity
+from . import typed as typed_mod
+
+MULTISIG_TYPE = "ms"  # identity.go:21
+
+
+class MultisigError(Exception):
+    pass
+
+
+@dataclass
+class MultiIdentity:
+    """identity.go:23-38."""
+
+    identities: list[bytes] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        return ser.der_sequence(
+            ser.der_sequence(*[ser.der_octet_string(bytes(i))
+                               for i in self.identities]))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "MultiIdentity":
+        outer = ser.DerReader(raw).read_sequence()
+        inner = outer.read_sequence()
+        ids = []
+        while not inner.eof():
+            ids.append(inner.read_octet_string())
+        return cls(identities=ids)
+
+
+def wrap_identities(*identities: bytes) -> Identity:
+    """identity.go:41-56 WrapIdentities."""
+    if not identities:
+        raise MultisigError("no identities provided")
+    mi = MultiIdentity(identities=[bytes(i) for i in identities])
+    return typed_mod.wrap_with_type(MULTISIG_TYPE, mi.serialize())
+
+
+def unwrap(raw: bytes) -> tuple[bool, list[bytes]]:
+    """identity.go:59-74 Unwrap: (is_multisig, co-owner identities)."""
+    try:
+        ti = typed_mod.unmarshal_typed_identity(bytes(raw))
+    except Exception:
+        return False, []
+    if ti.type != MULTISIG_TYPE:
+        return False, []
+    return True, MultiIdentity.deserialize(ti.identity).identities
+
+
+@dataclass
+class MultiSignature:
+    """sig.go MultiSignature: {Signatures [][]byte} (Go asn1)."""
+
+    signatures: list[bytes] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        return ser.der_sequence(
+            ser.der_sequence(*[ser.der_octet_string(s)
+                               for s in self.signatures]))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "MultiSignature":
+        outer = ser.DerReader(raw).read_sequence()
+        inner = outer.read_sequence()
+        sigs = []
+        while not inner.eof():
+            sigs.append(inner.read_octet_string())
+        return cls(signatures=sigs)
+
+
+def join_signatures(identities: list[bytes],
+                    sigmas: dict[bytes, bytes]) -> bytes:
+    """sig.go JoinSignatures: signatures in identity order."""
+    sigs = []
+    for ident in identities:
+        sigma = sigmas.get(bytes(ident))
+        if sigma is None:
+            raise MultisigError(
+                "signature for a co-owner identity is missing")
+        sigs.append(sigma)
+    return MultiSignature(signatures=sigs).serialize()
+
+
+class MultisigVerifier:
+    """sig.go Verifier: every co-signature must verify, in order."""
+
+    def __init__(self, verifiers: list):
+        self.verifiers = verifiers
+
+    def verify(self, message: bytes, signature: bytes) -> None:
+        try:
+            sig = MultiSignature.deserialize(signature)
+        except Exception as e:
+            raise MultisigError(
+                f"failed to unmarshal multisig: {e}") from e
+        if len(self.verifiers) != len(sig.signatures):
+            raise MultisigError(
+                f"invalid multisig: expect [{len(self.verifiers)}] "
+                f"signatures, but received [{len(sig.signatures)}]")
+        for k, verifier in enumerate(self.verifiers):
+            try:
+                verifier.verify(message, sig.signatures[k])
+            except Exception as e:
+                raise MultisigError(
+                    f"invalid multisig: signature at index [{k}] does not "
+                    f"verify") from e
+
+
+def multisig_owner_resolver(resolve_verifier):
+    """Deserializer hook: TypedIdentity('ms', ...) -> MultisigVerifier with
+    recursively-resolved co-owner verifiers (deserializer.go:95-110)."""
+
+    def resolver(ti: typed_mod.TypedIdentity):
+        if ti.type != MULTISIG_TYPE:
+            return None
+        mi = MultiIdentity.deserialize(ti.identity)
+        return MultisigVerifier(
+            [resolve_verifier(Identity(i)) for i in mi.identities])
+
+    return resolver
+
+
+class MultisigInfoMatcher:
+    """deserializer.go:64-92: audit info is a JSON list of per-co-owner
+    audit infos; each must match its identity via the inner matcher."""
+
+    def __init__(self, inner_matcher):
+        self.inner = inner_matcher
+
+    def audit_info(self, owner_raw: bytes,
+                   info_for: "callable") -> bytes:
+        is_ms, ids = unwrap(owner_raw)
+        if not is_ms:
+            raise MultisigError("not a multisig identity")
+        infos = [info_for(i).hex() for i in ids]
+        return json.dumps({"identity_audit_infos": infos}).encode()
+
+    def match_identity(self, identity: bytes, audit_info: bytes) -> None:
+        is_ms, ids = unwrap(identity)
+        if not is_ms:
+            raise MultisigError("not a multisig identity")
+        try:
+            infos = [bytes.fromhex(h) for h in
+                     json.loads(audit_info)["identity_audit_infos"]]
+        except Exception as e:
+            raise MultisigError(
+                f"malformed multisig audit info: {e}") from e
+        if len(ids) != len(infos):
+            raise MultisigError(
+                f"expected {len(ids)} audit info but received {len(infos)}")
+        for ident, info in zip(ids, infos):
+            self.inner.match_identity(ident, info)

@@ -71,7 +71,18 @@ class TokenNode:
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:
-        return [self.name] if self.owner_wallet.owns(owner_raw) else []
+        """tokens.go:64-129 ownership resolution: personal tokens under the
+        node name; multisig co-owned (escrow) tokens under a separate
+        '<name>.ms' wallet so the ordinary selector never spends them
+        (ttx/multisig/wallet.go separation)."""
+        if self.owner_wallet.owns(owner_raw):
+            return [self.name]
+        from .identity.multisig import unwrap
+
+        is_ms, ids = unwrap(owner_raw)
+        if is_ms and any(self.owner_wallet.owns(i) for i in ids):
+            return [f"{self.name}.ms"]
+        return []
 
     def identity(self) -> bytes:
         return bytes(self.keys.identity)
@@ -87,6 +98,29 @@ class TokenNode:
         method, not attribute reach-through, so it works over any session
         transport (in-process or RPC)."""
         return bytes(self.keys.identity)
+
+    def owns_identity(self, owner_raw: bytes) -> bool:
+        """Responder view: does this node's wallet own the identity?"""
+        return self.owner_wallet.owns(owner_raw)
+
+    def sign_as_co_owner(self, tx_id: str, message: bytes,
+                         escrow_owner_raw: bytes) -> tuple[bytes, bytes]:
+        """Escrow co-signing responder view (ttx/multisig/spend.go): find
+        which component of the multisig identity this wallet owns, sign as
+        it, and return (component identity, signature) so the initiator can
+        join signatures in identity order."""
+        from .identity.multisig import MultisigError, unwrap
+
+        is_ms, ids = unwrap(escrow_owner_raw)
+        if not is_ms:
+            raise MultisigError("not a multisig owner")
+        for ident in ids:
+            if self.owner_wallet.owns(ident):
+                sigma = self.owner_wallet.sign(ident, message)
+                self.ttxdb.add_endorsement_ack(tx_id, self.identity(), sigma)
+                return bytes(ident), sigma
+        raise MultisigError(
+            f"node [{self.name}] owns no component of the escrow identity")
 
     def balance(self, token_type: str) -> int:
         return self.tokendb.balance(self.name, token_type)
@@ -191,6 +225,119 @@ class TokenNode:
             sender=self.name, recipient="" if redeem else to_node,
             token_type=token_type, amount=target, status=TxStatus.PENDING,
             timestamp=time.time()))
+        return tx
+
+    # --------------------------------------------------- escrow (multisig)
+    def lock_in_escrow(self, token_type: str, amount_hex: str,
+                       co_owner_nodes: list[str]) -> Transaction:
+        """ttx/multisig lock: transfer funds to a co-owned multisig
+        identity; every co-owner receives the opening."""
+        from ..token.request_builder import Request
+        from .identity.multisig import wrap_identities
+
+        tx_id = Transaction.new_anchor()
+        selection = self.selector.select(self.name, token_type, amount_hex,
+                                         tx_id)
+        target = q.to_quantity(amount_hex, self.precision).value
+        change = selection.sum - target
+        recips = [self.bus.node(n).recipient_identity()
+                  for n in co_owner_nodes]
+        escrow_owner = bytes(wrap_identities(*[r[0] for r in recips]))
+        specs = [OutputSpec(owner=escrow_owner, token_type=token_type,
+                            value=target, audit_info=escrow_owner)]
+        receivers = [None]  # distribution handled manually for co-owners
+        if change > 0:
+            change_owner, change_ai = self.owner_wallet.recipient_identity()
+            specs.append(OutputSpec(owner=change_owner,
+                                    token_type=token_type, value=change,
+                                    audit_info=change_ai))
+            receivers.append(self.name)
+        req = Request(tx_id, self.driver)
+        try:
+            req.transfer(selection.tokens, specs,
+                         wallet=self.tokendb.get_ledger_token,
+                         sender_audit_info=self.owner_wallet.audit_info_for,
+                         receivers=receivers)
+        except Exception:
+            self.selector.unselect(tx_id)
+            raise
+        tx = Transaction(
+            tx_id=tx_id, request=req.token_request(),
+            input_owners=[self.name] * len(selection.tokens),
+            input_owner_ids=req.input_owner_ids(),
+            metadata=req.request_metadata(),
+            distribution=req.distribution(),
+        )
+        if tx.metadata is not None:
+            # the escrow output's opening goes to EVERY co-owner
+            opening = tx.metadata.transfers[0].outputs[0].output_metadata
+            for n in co_owner_nodes:
+                tx.distribution.append((n, 0, opening))
+        tx.records.append(TxRecord(
+            tx_id=tx_id, action_type="transfer", sender=self.name,
+            recipient="escrow:" + ",".join(co_owner_nodes),
+            token_type=token_type, amount=target, status=TxStatus.PENDING,
+            timestamp=time.time()))
+        return tx
+
+    def spend_escrow(self, token_type: str, to_node: str,
+                     co_owner_nodes: list[str]) -> Transaction:
+        """ttx/multisig spend: move the escrow funds of `token_type`
+        co-owned with EXACTLY `co_owner_nodes` to `to_node`; requires every
+        co-owner's signature (collected by collect_endorsements).
+
+        Only tokens whose multisig identity the listed co-owners can fully
+        sign are selected (a node may hold escrows with different partner
+        sets); selection takes token locks like every other spend so
+        concurrent escrow spends fail fast instead of at ordering.
+        """
+        from ..token.request_builder import Request
+        from .identity.multisig import unwrap
+
+        tx_id = Transaction.new_anchor()
+        candidates = self.tokendb.unspent_tokens(f"{self.name}.ms",
+                                                 token_type)
+        rows = []
+        for r in candidates:
+            is_ms, ids = unwrap(bytes(r.owner))
+            if not is_ms:
+                continue
+            # every component must be signable by one of the listed nodes
+            covered = all(
+                any(self.bus.node(nm).owns_identity(i)
+                    for nm in co_owner_nodes) for i in ids)
+            if covered and self.lockdb.lock(r.id, tx_id):
+                rows.append(r)
+        if not rows:
+            raise TtxError("no escrow tokens to spend")
+        total = sum(int(r.quantity, 16) for r in rows)
+        try:
+            recipient_owner, recipient_ai = \
+                self.bus.node(to_node).recipient_identity()
+            req = Request(tx_id, self.driver)
+            req.transfer(rows,
+                         [OutputSpec(owner=recipient_owner,
+                                     token_type=token_type, value=total,
+                                     audit_info=recipient_ai)],
+                         wallet=self.tokendb.get_ledger_token,
+                         sender_audit_info=lambda raw: bytes(raw),
+                         receivers=[to_node])
+        except Exception:
+            self.lockdb.unlock_by_consumer(tx_id)
+            raise
+        tx = Transaction(
+            tx_id=tx_id, request=req.token_request(),
+            # a LIST of names marks a multisig input: every listed node
+            # must co-sign (collect_endorsements joins the signatures)
+            input_owners=[list(co_owner_nodes) for _ in rows],
+            input_owner_ids=req.input_owner_ids(),
+            metadata=req.request_metadata(),
+            distribution=req.distribution(),
+        )
+        tx.records.append(TxRecord(
+            tx_id=tx_id, action_type="transfer", sender=self.name,
+            recipient=to_node, token_type=token_type, amount=total,
+            status=TxStatus.PENDING, timestamp=time.time()))
         return tx
 
     def execute(self, tx: Transaction):

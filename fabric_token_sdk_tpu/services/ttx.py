@@ -99,8 +99,22 @@ def collect_endorsements(tx: Transaction, bus: SessionBus,
         sigma = responder.sign_issue(tx.tx_id, msg)
         tx.request.signatures.append(sigma)
     for i, owner_name in enumerate(tx.input_owners):
-        responder = bus.node(owner_name)
         owner_raw = tx.input_owner_ids[i] if tx.input_owner_ids else None
+        if isinstance(owner_name, (list, tuple)):
+            # multisig escrow input: every co-owner signs; signatures are
+            # joined in the multisig identity's own order
+            # (identity/multisig/sig.go JoinSignatures).
+            from .identity.multisig import join_signatures, unwrap
+
+            _, ids = unwrap(owner_raw)
+            sigmas: dict[bytes, bytes] = {}
+            for co_name in owner_name:
+                ident, sigma = bus.node(co_name).sign_as_co_owner(
+                    tx.tx_id, msg, owner_raw)
+                sigmas[ident] = sigma
+            tx.request.signatures.append(join_signatures(ids, sigmas))
+            continue
+        responder = bus.node(owner_name)
         sigma = responder.sign_transfer(tx.tx_id, msg, owner_raw)
         tx.request.signatures.append(sigma)
 

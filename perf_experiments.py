@@ -1,0 +1,145 @@
+"""Microbenchmarks for the field/EC kernel layer on the current backend.
+
+Usage: python perf_experiments.py [batch_log2]
+
+Measures steady-state throughput of mont_mul, the constant-operand
+Toeplitz-matmul variant (int8 nibble planes on the MXU), and complete
+point addition — the primitives everything above is made of.
+"""
+
+import sys
+import time
+
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+configure_jax_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fabric_token_sdk_tpu.ops import ec, field as F, limbs as L  # noqa: E402
+
+LOG2 = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+B = 1 << LOG2
+
+
+def _bench(fn, *args, iters=8, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    return (time.perf_counter() - t0) / iters
+
+
+# ---------------------------------------------------------------------------
+# int8 nibble Toeplitz prototype: cols(a) = sum_i a_i * C_{k-i} for constant C
+# ---------------------------------------------------------------------------
+
+def _nibble_split(a):
+    """(..., 16) uint32 limbs -> (..., 64) int8 nibbles, little-endian."""
+    n0 = (a & 0xF).astype(jnp.int8)
+    n1 = ((a >> 4) & 0xF).astype(jnp.int8)
+    n2 = ((a >> 8) & 0xF).astype(jnp.int8)
+    n3 = ((a >> 12) & 0xF).astype(jnp.int8)
+    return jnp.stack([n0, n1, n2, n3], axis=-1).reshape(*a.shape[:-1], 64)
+
+
+def _toeplitz_nibble_matrix(const_limbs, out_cols):
+    """(64, out_cols*4->folded) int8 matrix: nibble conv with the constant.
+
+    Result columns are NIBBLE positions (out_cols*4); each output nibble
+    column k sums a-nibble i times c-nibble (k-i): values <= 15*15*64 fits
+    int32 via int8 MXU accumulation.
+    """
+    c = []
+    for limb in const_limbs:
+        for shift in (0, 4, 8, 12):
+            c.append((int(limb) >> shift) & 0xF)
+    nc = len(c)
+    out_n = out_cols * 4
+    W = np.zeros((64, out_n), dtype=np.int8)
+    for i in range(64):
+        for j in range(nc):
+            if i + j < out_n:
+                W[i, i + j] = c[j]
+    return jnp.asarray(W)
+
+
+def make_const_product_nibble(const_limbs, out_cols):
+    W = _toeplitz_nibble_matrix([int(x) for x in const_limbs], out_cols)
+
+    def product(a):
+        nib = _nibble_split(a)                       # (..., 64) int8
+        cols_n = jax.lax.dot_general(
+            nib, W, (((nib.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)        # (..., out_cols*4)
+        # fold nibble columns (weights 1,16,256,4096) back to limb columns
+        cn = cols_n.reshape(*cols_n.shape[:-1], out_cols, 4).astype(jnp.uint32)
+        return (cn[..., 0] + (cn[..., 1] << 4) + (cn[..., 2] << 8)
+                + (cn[..., 3] << 12))                # lazy cols < 2^26
+
+    return product
+
+
+def mont_mul_mxu(a, b, spec, nprime_prod, mod_prod):
+    """mont_mul with the two constant-operand products on the int8 MXU."""
+    t_cols = F._shift_add_product(a, b, F.N, 2 * F.N)
+    T = F._carry_propagate(t_cols, 2 * F.N + 1)
+    m_cols = nprime_prod(T[..., :F.N])[..., :F.N]
+    m = F._carry_propagate(m_cols, F.N)
+    u_cols = mod_prod(m)
+    s = F._carry_propagate(
+        T + jnp.pad(u_cols, [(0, 0)] * (T.ndim - 1) + [(0, 1)]),
+        2 * F.N + 1)
+    res = s[..., F.N:]
+    return F._cond_sub_mod(res, spec)
+
+
+def main():
+    print(f"backend={jax.devices()[0].platform} B=2^{LOG2}={B}")
+    rng = np.random.default_rng(0)
+    spec = F.FP
+    a_int = [int.from_bytes(rng.bytes(31), "little") for _ in range(2)]
+    a = jnp.asarray(np.tile(L.int_to_limbs(a_int[0]), (B, 1)))
+    b = jnp.asarray(np.tile(L.int_to_limbs(a_int[1]), (B, 1)))
+
+    mm = jax.jit(lambda x, y: F.mont_mul(x, y, spec))
+    t = _bench(mm, a, b)
+    print(f"mont_mul       : {t*1e3:8.2f} ms  {B/t/1e6:8.2f} Mmul/s")
+
+    nprime_prod = make_const_product_nibble(spec.nprime, F.N)
+    mod_prod = make_const_product_nibble(spec.mod, 2 * F.N)
+    mmx = jax.jit(lambda x, y: mont_mul_mxu(x, y, spec, nprime_prod,
+                                            mod_prod))
+    # correctness first
+    got = np.asarray(mmx(a[:4], b[:4]))
+    want = np.asarray(mm(a[:4], b[:4]))
+    ok = bool((got == want).all())
+    t = _bench(mmx, a, b)
+    print(f"mont_mul_mxu   : {t*1e3:8.2f} ms  {B/t/1e6:8.2f} Mmul/s  "
+          f"correct={ok}")
+
+    # complete point add
+    P_b = 1 << max(0, LOG2 - 3)
+    from fabric_token_sdk_tpu.crypto import bn254
+
+    p1 = L.point_to_projective_limbs(bn254.g1_mul(bn254.G1_GENERATOR, 7))
+    p2 = L.point_to_projective_limbs(bn254.g1_mul(bn254.G1_GENERATOR, 9))
+    pa = jnp.asarray(np.tile(p1, (P_b, 1, 1)))
+    pb = jnp.asarray(np.tile(p2, (P_b, 1, 1)))
+    padd = jax.jit(ec.add)
+    t = _bench(padd, pa, pb)
+    print(f"ec.add         : {t*1e3:8.2f} ms  {P_b/t/1e6:8.2f} Madd/s "
+          f"({P_b} lanes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Shared DB contract suite run against EVERY backend (reference
+token/services/db/dbtest: same suite, many drivers)."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.services.db import memdb, sqldb
+from fabric_token_sdk_tpu.services.db.sqldb import DBError, TxRecord, TxStatus
+from fabric_token_sdk_tpu.token.model import ID
+
+BACKENDS = {"sqlite": sqldb, "memory": memdb}
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def db(request):
+    return BACKENDS[request.param]
+
+
+def test_tokendb_contract(db):
+    t = db.TokenDB(":memory:")
+    t.store_token(ID("tx", 0), b"o1", "USD", "0x64", ["alice"],
+                  ledger_token=b"LT", ledger_metadata=b"LM")
+    t.store_token(ID("tx", 1), b"o2", "USD", "0x1", ["bob"])
+    t.store_token(ID("tx", 2), b"o1", "EUR", "0x5", ["alice"])
+
+    assert t.balance("alice", "USD") == 100
+    assert t.balance(None, "USD") == 101
+    assert t.is_mine(ID("tx", 0), "alice") and not t.is_mine(ID("tx", 0),
+                                                             "bob")
+    assert [u.id for u in t.unspent_tokens("alice", "USD")] == [ID("tx", 0)]
+    assert t.get_ledger_token(ID("tx", 0)) == (b"LT", b"LM")
+    assert t.whose(ID("tx", 0)) == ["alice"]
+    assert t.get_token(ID("tx", 0)).quantity == "0x64"
+
+    t.delete_token(ID("tx", 0), spent_by="tx9")
+    assert t.balance("alice", "USD") == 0
+    assert t.get_token(ID("tx", 0)) is None
+    assert t.get_token(ID("tx", 0), include_deleted=True) is not None
+    assert t.get_ledger_token(ID("tx", 0)) is None
+
+
+def test_ttxdb_contract(db):
+    d = db.TransactionDB(":memory:")
+    rec = TxRecord(tx_id="t1", action_type="transfer", sender="alice",
+                   recipient="bob", token_type="USD", amount=5,
+                   status=TxStatus.PENDING, timestamp=time.time())
+    d.add_transaction(rec)
+    d.add_token_request("t1", b"req-bytes")
+    assert d.get_token_request("t1") == b"req-bytes"
+    assert d.get_status("t1") == TxStatus.PENDING
+    d.set_status("t1", TxStatus.CONFIRMED)
+    assert d.get_status("t1") == TxStatus.CONFIRMED
+    assert d.get_status("missing") == TxStatus.UNKNOWN
+    assert [r.tx_id for r in d.query_transactions()] == ["t1"]
+    assert d.query_transactions(action_type="issue") == []
+
+    d.add_endorsement_ack("t1", b"endorser", b"sig")
+    assert d.get_endorsement_acks("t1") == {b"endorser": b"sig"}
+
+    # statuses filter + validation record: identical across backends
+    assert [r.tx_id for r in
+            d.query_transactions(statuses=[TxStatus.CONFIRMED])] == ["t1"]
+    assert d.query_transactions(statuses=[TxStatus.PENDING]) == []
+    d.add_validation_record("t1", b"req", b"meta")
+    d.add_validation_record("t2", b"req2")  # metadata optional
+
+
+def test_auditdb_contract(db):
+    a = db.AuditDB(":memory:")
+    a.acquire_locks("t1", ["alice", "bob"])
+    assert a.locked_eids() == ["alice", "bob"]
+    # a second tx cannot lock an already-locked eid
+    with pytest.raises(DBError):
+        a.acquire_locks("t2", ["bob"])
+    # re-acquiring under the same tx is idempotent
+    a.acquire_locks("t1", ["alice"])
+    a.release_locks("t1")
+    assert a.locked_eids() == []
+
+    rec = TxRecord(tx_id="t1", action_type="transfer", sender="alice",
+                   recipient="bob", token_type="USD", amount=5,
+                   status=TxStatus.CONFIRMED, timestamp=time.time())
+    a.add_transaction(rec)
+    assert [r.tx_id for r in a.payments("bob")] == ["t1"]
+    assert a.payments("charlie") == []
+    # payments applies NO action-type filter (sqldb semantics): an issue
+    # record with a matching party appears too
+    a.add_transaction(TxRecord(tx_id="t2", action_type="issue", sender="",
+                               recipient="bob", token_type="USD", amount=1,
+                               status=TxStatus.CONFIRMED,
+                               timestamp=time.time()))
+    assert [r.tx_id for r in a.payments("bob")] == ["t1", "t2"]
+
+
+def test_tokenlockdb_contract(db):
+    lk = db.TokenLockDB(":memory:")
+    assert lk.lock(ID("t", 0), "c1")
+    assert lk.lock(ID("t", 0), "c1")       # re-entrant for same consumer
+    assert not lk.lock(ID("t", 0), "c2")   # held by c1
+    assert lk.holder(ID("t", 0)) == "c1"
+    lk.unlock_by_consumer("c1")
+    assert lk.lock(ID("t", 0), "c2")
+    # lease eviction frees stuck locks (sherdlock semantics)
+    assert lk.evict_expired(lease_seconds=0.0) == 1
+    assert lk.holder(ID("t", 0)) is None
+
+
+def test_identitydb_contract(db):
+    i = db.IdentityDB(":memory:")
+    i.register_wallet("alice", "owner", b"id-a")
+    i.register_wallet("issuer", "issuer", b"id-i")
+    assert i.wallet_identity("alice", "owner") == b"id-a"
+    assert i.wallet_identity("alice", "issuer") is None
+    assert [(w, r) for w, r, _ in i.wallets("owner")] == [("alice", "owner")]
+    i.store_audit_info(b"id-a", b"ai")
+    assert i.get_audit_info(b"id-a") == b"ai"
+    assert i.get_audit_info(b"missing") is None
+
+
+def test_concurrent_lock_contract(db):
+    """Only one consumer wins each token under concurrency."""
+    lk = db.TokenLockDB(":memory:")
+    wins = []
+
+    def worker(cid):
+        if lk.lock(ID("hot", 0), cid):
+            wins.append(cid)
+
+    threads = [threading.Thread(target=worker, args=(f"c{j}",))
+               for j in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1

@@ -1,0 +1,152 @@
+"""Multisig escrow: co-owned tokens requiring all co-signatures (reference
+token/services/identity/multisig + ttx/multisig)."""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.multisig import (
+    MultiIdentity, MultisigError, MultiSignature, MultisigVerifier,
+    join_signatures, unwrap, wrap_identities)
+from fabric_token_sdk_tpu.services.identity.x509 import (X509Verifier,
+                                                         new_signing_identity)
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+
+
+# ------------------------------------------------------------------- unit
+
+def test_multi_identity_roundtrip_and_unwrap():
+    a, b = b"alice-id", b"bob-id"
+    owner = wrap_identities(a, b)
+    is_ms, ids = unwrap(bytes(owner))
+    assert is_ms and ids == [a, b]
+    # non-multisig identities unwrap as (False, [])
+    assert unwrap(b"plain")[0] is False
+    mi = MultiIdentity([a, b])
+    assert MultiIdentity.deserialize(mi.serialize()).identities == [a, b]
+
+
+def test_multisig_verifier_requires_all_signatures():
+    k1, k2 = new_signing_identity(), new_signing_identity()
+    msg = b"spend escrow token"
+    verifier = MultisigVerifier([X509Verifier(k1.private_key.public_key()),
+                                 X509Verifier(k2.private_key.public_key())])
+    ids = [bytes(k1.identity), bytes(k2.identity)]
+    good = join_signatures(ids, {ids[0]: k1.sign(msg), ids[1]: k2.sign(msg)})
+    verifier.verify(msg, good)
+
+    # one signature swapped for garbage -> reject with index
+    bad = MultiSignature([k1.sign(msg), b"garbage"]).serialize()
+    with pytest.raises(MultisigError, match=r"index \[1\]"):
+        verifier.verify(msg, bad)
+
+    # wrong count
+    short = MultiSignature([k1.sign(msg)]).serialize()
+    with pytest.raises(MultisigError, match="expect"):
+        verifier.verify(msg, short)
+
+    # signatures in the WRONG order must fail (order is identity order)
+    swapped = MultiSignature([k2.sign(msg), k1.sign(msg)]).serialize()
+    with pytest.raises(MultisigError):
+        verifier.verify(msg, swapped)
+
+
+def test_join_signatures_missing_co_owner():
+    with pytest.raises(MultisigError, match="missing"):
+        join_signatures([b"a", b"b"], {b"a": b"s"})
+
+
+# -------------------------------------------------------------------- e2e
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    cc = TokenChaincode(fabtoken.new_validator(pp, Deserializer()),
+                        MemoryLedger(), pp.serialize())
+    bus = SessionBus()
+    nodes = {
+        "issuer": TokenNode("issuer", issuer_keys, bus, cc,
+                            auditor_name="auditor"),
+        "auditor": AuditorNode("auditor", auditor_keys, bus, cc,
+                               auditor_name="auditor"),
+    }
+    for n in ("alice", "bob", "charlie"):
+        nodes[n] = TokenNode(n, new_signing_identity(), bus, cc,
+                             auditor_name="auditor")
+    return nodes
+
+
+def test_escrow_lock_and_cosigned_spend(net):
+    alice, bob, charlie = net["alice"], net["bob"], net["charlie"]
+    assert alice.execute(
+        alice.issue("issuer", "alice", "USD", hex(100))).status == "VALID"
+
+    # lock 60 into escrow co-owned by alice+bob
+    tx = alice.lock_in_escrow("USD", hex(60), ["alice", "bob"])
+    ev = alice.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 40  # change only
+    assert alice.tokendb.balance("alice.ms", "USD") == 60
+    assert bob.tokendb.balance("bob.ms", "USD") == 60
+
+    # both co-owners sign -> spend to charlie succeeds
+    tx2 = alice.spend_escrow("USD", "charlie", ["alice", "bob"])
+    ev = alice.execute(tx2)
+    assert ev.status == "VALID", ev.message
+    assert charlie.balance("USD") == 60
+    assert alice.tokendb.balance("alice.ms", "USD") == 0
+
+
+def test_escrow_spend_without_co_owner_rejected(net):
+    alice, bob = net["alice"], net["bob"]
+    assert alice.execute(
+        alice.issue("issuer", "alice", "USD", hex(50))).status == "VALID"
+    tx = alice.lock_in_escrow("USD", hex(50), ["alice", "bob"])
+    assert alice.execute(tx).status == "VALID"
+
+    # alice alone tries to spend: selection fails fast — no escrow token is
+    # fully signable by the listed co-owners (ttx/multisig wallet filter)
+    with pytest.raises(Exception):
+        alice.spend_escrow("USD", "alice", ["alice"])
+    # escrow funds untouched
+    assert alice.tokendb.balance("alice.ms", "USD") == 50
+
+
+def test_escrow_partner_sets_do_not_mix(net):
+    """alice holds escrows with DIFFERENT partner sets; spending with one
+    set must only select that set's tokens."""
+    alice, bob, charlie = net["alice"], net["bob"], net["charlie"]
+    assert alice.execute(
+        alice.issue("issuer", "alice", "USD", hex(100))).status == "VALID"
+    assert alice.execute(
+        alice.lock_in_escrow("USD", hex(40), ["alice", "bob"])
+    ).status == "VALID"
+    assert alice.execute(
+        alice.lock_in_escrow("USD", hex(25), ["alice", "charlie"])
+    ).status == "VALID"
+    assert alice.tokendb.balance("alice.ms", "USD") == 65
+
+    tx = alice.spend_escrow("USD", "bob", ["alice", "bob"])
+    assert alice.execute(tx).status == "VALID"
+    # only the alice+bob escrow moved; the alice+charlie one remains
+    assert net["bob"].balance("USD") == 40
+    assert alice.tokendb.balance("alice.ms", "USD") == 25
+
+
+def test_escrow_wrong_cosigner_rejected(net):
+    """charlie (not a co-owner) cannot stand in for bob."""
+    alice, charlie = net["alice"], net["charlie"]
+    assert alice.execute(
+        alice.issue("issuer", "alice", "USD", hex(30))).status == "VALID"
+    tx = alice.lock_in_escrow("USD", hex(30), ["alice", "bob"])
+    assert alice.execute(tx).status == "VALID"
+    # charlie cannot cover bob's component: selection refuses the spend
+    with pytest.raises(Exception):
+        alice.spend_escrow("USD", "alice", ["alice", "charlie"])

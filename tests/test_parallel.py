@@ -1,37 +1,91 @@
-"""Sharded MSM verification on the virtual 8-device CPU mesh."""
+"""Sharded MSM verification on the virtual 8-device CPU mesh.
+
+VERDICT r1 #3: beyond the single toy case — dp*tp shape sweeps, uneven
+batches padded to the mesh, wider term counts, and a block-replay shape
+(BASELINE config 5's sharded backlog pattern at test scale).
+"""
 
 import random
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fabric_token_sdk_tpu.crypto import bn254
 from fabric_token_sdk_tpu.ops import limbs
-from fabric_token_sdk_tpu.parallel import make_mesh, sharded_msm_is_identity
+from fabric_token_sdk_tpu.parallel import (make_mesh, shard_batch,
+                                           sharded_msm_is_identity)
 
 rng = random.Random(0x5A)
 
 
-def _case(balanced: bool):
+def _case(T: int, balanced: bool):
     p = bn254.g1_mul(bn254.G1_GENERATOR, rng.randrange(1, bn254.R))
-    s = [rng.randrange(bn254.R) for _ in range(3)]
+    s = [rng.randrange(bn254.R) for _ in range(T - 1)]
     last = (bn254.R - sum(s) % bn254.R) % bn254.R
     if not balanced:
         last = (last + 1) % bn254.R
-    pts = [p, p, p, p]
-    scalars = s + [last]
-    return pts, scalars
+    return [p] * T, s + [last]
+
+
+def _batch(B: int, T: int, pattern):
+    rows = [_case(T, balanced=pattern(b)) for b in range(B)]
+    pts = jnp.asarray(np.stack(
+        [limbs.points_to_projective_limbs(r[0]) for r in rows]))
+    sc = jnp.asarray(np.stack(
+        [limbs.scalars_to_limbs(r[1]) for r in rows]))
+    return pts, sc
 
 
 def test_sharded_identity_check_dp_tp():
     assert len(jax.devices()) == 8, "conftest should force 8 virtual devices"
     mesh = make_mesh(8, dp=4, tp=2)
-    B, T = 4, 4
-    rows = [_case(balanced=(b % 2 == 0)) for b in range(B)]
-    pts = jnp.asarray(np.stack(
-        [limbs.points_to_projective_limbs(r[0]) for r in rows]))
-    sc = jnp.asarray(np.stack(
-        [limbs.scalars_to_limbs(r[1]) for r in rows]))
+    pts, sc = _batch(4, 4, lambda b: b % 2 == 0)
     got = np.asarray(sharded_msm_is_identity(mesh, pts, sc))
     assert list(got) == [True, False, True, False]
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (2, 4), (1, 8)])
+def test_mesh_shape_sweep(dp, tp):
+    """Every dp*tp factorization verifies identically."""
+    mesh = make_mesh(8, dp=dp, tp=tp)
+    B = max(dp, 2)
+    T = 8  # divisible by every tp in the sweep
+    pts, sc = _batch(B, T, lambda b: b != 1)
+    got = np.asarray(sharded_msm_is_identity(mesh, pts, sc))
+    assert list(got) == [b != 1 for b in range(B)]
+
+
+def test_uneven_batch_padded_to_mesh():
+    """B=5 on dp=4: pad with identity rows (exact no-ops) then slice."""
+    mesh = make_mesh(8, dp=4, tp=2)
+    B, T = 5, 4
+    pts, sc = _batch(B, T, lambda b: b in (0, 3, 4))
+    pad = 8 - B  # to a dp multiple
+    id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+    pts_p = jnp.concatenate(
+        [pts, jnp.broadcast_to(jnp.asarray(id_pt), (pad, T, 3, 16))])
+    sc_p = jnp.concatenate(
+        [sc, jnp.zeros((pad, T, limbs.NLIMBS), dtype=jnp.uint32)])
+    got = np.asarray(sharded_msm_is_identity(mesh, pts_p, sc_p))[:B]
+    assert list(got) == [b in (0, 3, 4) for b in range(B)]
+    # padding rows themselves are identities -> True
+    assert np.asarray(sharded_msm_is_identity(mesh, pts_p, sc_p))[B:].all()
+
+
+def test_block_replay_sharded_over_mesh():
+    """BASELINE config-5 shape at test scale: a backlog of checks larger
+    than the mesh, processed in dp-sharded slabs with device-resident
+    placement (shard_batch)."""
+    mesh = make_mesh(8, dp=8, tp=1)
+    B, T = 24, 4  # 3 slabs of 8
+    pattern = lambda b: (b % 5) != 2  # noqa: E731
+    pts, sc = _batch(B, T, pattern)
+    accept = []
+    for s in range(0, B, 8):
+        p_slab = shard_batch(mesh, pts[s:s + 8])
+        s_slab = shard_batch(mesh, sc[s:s + 8])
+        accept.extend(
+            np.asarray(sharded_msm_is_identity(mesh, p_slab, s_slab)))
+    assert accept == [pattern(b) for b in range(B)]
