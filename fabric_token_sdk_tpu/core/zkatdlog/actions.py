@@ -108,27 +108,79 @@ def _token_id_from_msg(raw: bytes) -> ID:
 
 
 @dataclass
+class UpgradeWitness:
+    """noghactions.proto TransferActionInputUpgradeWitness{1: fabtoken
+    Token, 2: Zr blinding_factor}: binds a plaintext (fabtoken-format)
+    ledger token to the commitment claimed for it, enabling old tokens to
+    be spent under the zkatdlog pp after a public-params update
+    (v1/tokens.go:208-284, validator_transfer.go:64-93)."""
+
+    owner: bytes
+    token_type: str
+    quantity: str                    # "0x..." base-16, fabtoken convention
+    blinding_factor: int
+
+    def serialize(self) -> bytes:
+        fab = (pw.bytes_field(1, self.owner)
+               + pw.string_field(2, self.token_type)
+               + pw.string_field(3, self.quantity))
+        return (pw.message_field(1, fab, present=True)
+                + pw.message_field(
+                    2, pw.bytes_field(1, ser.zr_to_bytes(
+                        self.blinding_factor)), present=True))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "UpgradeWitness":
+        fields = pw.parse_fields(raw)
+        if 1 not in fields or 2 not in fields:
+            raise ActionError("invalid upgrade witness")
+        fab = pw.parse_fields(bytes(fields[1][0]))
+        bf_fields = pw.parse_fields(bytes(fields[2][0]))
+        if 1 not in bf_fields:
+            raise ActionError("invalid upgrade witness: missing bf")
+        return cls(
+            owner=bytes(fab.get(1, [b""])[0]),
+            token_type=bytes(fab.get(2, [b""])[0]).decode(),
+            quantity=bytes(fab.get(3, [b""])[0]).decode(),
+            blinding_factor=ser.zr_from_bytes(bytes(bf_fields[1][0])),
+        )
+
+    def fabtoken_bytes(self) -> bytes:
+        """The plaintext token exactly as it sits on the ledger (typed
+        fabtoken envelope) — the content the spent-input key binds to."""
+        from ..fabtoken.actions import Output
+
+        return Output(owner=self.owner, type=self.token_type,
+                      quantity=self.quantity).serialize()
+
+
+@dataclass
 class ActionInput:
     """noghactions.proto TransferActionInput{1: TokenID, 2: Token,
-    3: upgrade witness (not produced by this framework)}."""
+    3: upgrade witness}."""
 
     id: ID
     token: Token
+    upgrade_witness: UpgradeWitness | None = None
 
     def serialize(self) -> bytes:
-        return (pw.message_field(1, _token_id_msg(self.id))
-                + pw.message_field(2, self.token.to_proto()))
+        out = (pw.message_field(1, _token_id_msg(self.id))
+               + pw.message_field(2, self.token.to_proto()))
+        if self.upgrade_witness is not None:
+            out += pw.message_field(3, self.upgrade_witness.serialize())
+        return out
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "ActionInput":
         fields = pw.parse_fields(raw)
         if 1 not in fields or 2 not in fields:
             raise ActionError("invalid transfer action input")
+        witness = None
         if 3 in fields and bytes(fields[3][0]):
-            raise ActionError(
-                "upgrade witnesses are not supported by this framework")
+            witness = UpgradeWitness.deserialize(bytes(fields[3][0]))
         return cls(id=_token_id_from_msg(bytes(fields[1][0])),
-                   token=Token.from_proto(bytes(fields[2][0])))
+                   token=Token.from_proto(bytes(fields[2][0])),
+                   upgrade_witness=witness)
 
 
 def _proof_msg(proof: bytes) -> bytes:
@@ -200,7 +252,16 @@ class TransferAction:
         return [inp.token for inp in self.inputs]
 
     def get_serialized_inputs(self) -> list[bytes]:
-        return [inp.token.serialize() for inp in self.inputs]
+        """Standalone forms as they sit ON THE LEDGER: commitment tokens
+        normally, the witness's plaintext fabtoken for upgrade inputs (the
+        spent-input key must bind to the ledger content)."""
+        out = []
+        for inp in self.inputs:
+            if inp.upgrade_witness is not None:
+                out.append(inp.upgrade_witness.fabtoken_bytes())
+            else:
+                out.append(inp.token.serialize())
+        return out
 
     def get_outputs(self) -> list[Token]:
         return list(self.outputs)

@@ -27,7 +27,8 @@ from ...services.tokens import ExtractedOutput
 from ...token.model import ID
 from ..fabtoken.driver import OutputSpec
 from . import actions as zk_actions
-from .actions import ActionInput, IssueAction, Token, TransferAction
+from .actions import (ActionInput, IssueAction, Token, TransferAction,
+                      UpgradeWitness)
 from .audit import Auditor
 from .metadata import (AuditableIdentity, IssueActionMetadata,
                        IssueOutputMetadata, RequestMetadata, TokenMetadata,
@@ -114,16 +115,47 @@ class ZkDlogDriverService:
         audit info (Idemix pseudonym openings; defaults to the identity
         bytes, the x509 equality convention).
         """
+        from ...crypto.bn254 import fr_rand
+
         if wallet is None:
             raise DriverError("zkatdlog transfers need a wallet of openings")
-        in_tokens, in_wits = [], []
+        in_tokens, in_wits, witnesses = [], [], []
         for row in input_rows:
             stored = wallet(row.id)
             if stored is None:
                 raise DriverError(f"no opening for token {row.id}")
             tok_raw, md_raw = stored
-            tok = Token.deserialize(tok_raw)
-            opening = TokenMetadata.deserialize(md_raw)
+            try:
+                tok = Token.deserialize(tok_raw)
+                is_comm = True
+            except Exception:
+                # dispatch on the typed-token tag: not a comm token means a
+                # fabtoken-format ledger token (pre-pp-update)
+                is_comm = False
+            if is_comm:
+                # commitment token: the opening MUST parse — a corrupt
+                # opening is a wallet error, never an upgrade
+                try:
+                    opening = TokenMetadata.deserialize(md_raw)
+                except Exception as e:
+                    raise DriverError(
+                        f"bad opening stored for token {row.id}: {e}"
+                    ) from e
+                witnesses.append(None)
+            else:
+                # UPGRADE: commit to the plaintext with a fresh blinding
+                # factor and attach the witness binding the commitment to
+                # the ledger token (v1/tokens.go:208-284).
+                value = int(row.quantity, 16)
+                bf = fr_rand()
+                com = token_commit.commit_token(
+                    row.type, value, bf, self.pp.pedersen_generators)
+                tok = Token(owner=bytes(row.owner), data=com)
+                opening = TokenMetadata(token_type=row.type, value=value,
+                                        blinding_factor=bf)
+                witnesses.append(UpgradeWitness(
+                    owner=bytes(row.owner), token_type=row.type,
+                    quantity=row.quantity, blinding_factor=bf))
             in_tokens.append(tok)
             in_wits.append((opening.token_type, opening.value,
                             opening.blinding_factor))
@@ -135,8 +167,9 @@ class ZkDlogDriverService:
             in_wits, [w.as_tuple() for w in out_wits],
             [t.data for t in in_tokens], out_coms, self.pp)
         action = TransferAction(
-            inputs=[ActionInput(id=row.id, token=tok)
-                    for row, tok in zip(input_rows, in_tokens)],
+            inputs=[ActionInput(id=row.id, token=tok, upgrade_witness=w)
+                    for row, tok, w in zip(input_rows, in_tokens,
+                                           witnesses)],
             outputs=[Token(owner=o.owner, data=c)
                      for o, c in zip(outputs, out_coms)],
             proof=proof,
@@ -206,7 +239,23 @@ class ZkDlogDriverService:
                             opening: bytes | None = None
                             ) -> ExtractedOutput | None:
         """Ledger-scan ingestion: a commitment token is opaque without its
-        opening — nodes only recover outputs they hold openings for."""
+        opening — nodes only recover outputs they hold openings for.
+
+        Fabtoken-format ledger tokens (written before a pp update) are in
+        the clear and ingest directly (reference Deobfuscate tries comm
+        then fabtoken, v1/tokens.go:111-127); they become spendable via the
+        upgrade-witness path.
+        """
+        from ..fabtoken.actions import Output as FabOutput
+
+        try:
+            out = FabOutput.deserialize(raw)
+            return ExtractedOutput(
+                index=0, owner_raw=bytes(out.owner), token_type=out.type,
+                quantity_hex=out.quantity, ledger_format="fabtoken",
+                ledger_token=raw)
+        except Exception:
+            pass
         if opening is None:
             return None
         tok = Token.deserialize(raw)

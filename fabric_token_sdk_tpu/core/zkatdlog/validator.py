@@ -52,13 +52,35 @@ def transfer_signature_validate(ctx: Context) -> None:
 def transfer_upgrade_witness_validate(ctx: Context) -> None:
     """validator_transfer.go:64-93: token-upgrade witnesses.
 
-    Upgrade (converting plaintext ledger tokens into commitments) is not yet
-    supported in this framework; actions carrying upgrade witnesses are
-    rejected, matching the reference's failure path for malformed witnesses.
+    An upgrade input claims a commitment for a plaintext (fabtoken-format)
+    ledger token; the witness must open the commitment to exactly the
+    plaintext (type, quantity) and carry the same owner. The spent-input
+    key separately binds the witness's plaintext to the actual ledger
+    content (actions.get_serialized_inputs), so a witness for a token that
+    is not on the ledger cannot commit.
     """
+    from ...crypto import token_commit
+    from ...token import quantity as q
+
     for inp in ctx.transfer_action.inputs:
-        if getattr(inp, "upgrade_witness", None) is not None:
-            raise ValidationError("upgrade witnesses are not supported")
+        witness = inp.upgrade_witness
+        if witness is None:
+            continue
+        if not witness.token_type or not witness.quantity:
+            raise ValidationError("fabtoken token not found in witness")
+        try:
+            value = q.to_quantity(witness.quantity,
+                                  ctx.pp.quantity_precision).value
+        except Exception as e:
+            raise ValidationError(
+                f"failed to unmarshal quantity: {e}") from e
+        com = token_commit.commit_token(
+            witness.token_type, value, witness.blinding_factor,
+            ctx.pp.pedersen_generators)
+        if com != inp.token.data:
+            raise ValidationError("recomputed commitment does not match")
+        if bytes(inp.token.owner) != bytes(witness.owner):
+            raise ValidationError("owners do not correspond")
 
 
 def transfer_zk_proof_validate(ctx: Context) -> None:

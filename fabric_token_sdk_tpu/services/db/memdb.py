@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from ...token.model import ID, UnspentToken
 from .sqldb import DBError, TxRecord, TxStatus  # shared contract types
@@ -134,7 +134,9 @@ class TransactionDB(_Base):
 
     def add_transaction(self, rec: TxRecord) -> None:
         with self._mu:
-            self._transactions.append(rec)
+            # copy on write: sqldb materializes rows, so live references
+            # must not alias the store across the shared contract
+            self._transactions.append(replace(rec))
             self._status.setdefault(rec.tx_id, (rec.status, ""))
 
     def add_token_request(self, tx_id: str, request: bytes,
@@ -170,7 +172,7 @@ class TransactionDB(_Base):
                     continue
                 if action_type is not None and rec.action_type != action_type:
                     continue
-                out.append(rec)
+                out.append(replace(rec))
             return out
 
     def add_endorsement_ack(self, tx_id: str, endorser: bytes,
@@ -221,7 +223,7 @@ class AuditDB(TransactionDB):
                     continue
                 if token_type is not None and rec.token_type != token_type:
                     continue
-                out.append(rec)
+                out.append(replace(rec))
             return out
 
 

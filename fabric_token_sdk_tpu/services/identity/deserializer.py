@@ -52,14 +52,12 @@ class Deserializer:
             return self._raw_verifier(identity)
         if ti.type == X509_TYPE:
             return self._raw_verifier(Identity(ti.identity))
-        if ti.type == "ms":
-            # multisig escrow: recursive resolution of every co-owner
-            # (identity/multisig/deserializer.go:95-110)
-            from .multisig import MultiIdentity, MultisigVerifier
+        from .multisig import MULTISIG_TYPE, multisig_owner_resolver
 
-            mi = MultiIdentity.deserialize(ti.identity)
-            return MultisigVerifier(
-                [self._resolve(Identity(i)) for i in mi.identities])
+        if ti.type == MULTISIG_TYPE:
+            # multisig escrow: recursive resolution of every co-owner
+            # (identity/multisig/deserializer.go:95-110) via the shared hook
+            return multisig_owner_resolver(self._resolve)(ti)
         for resolver in self.extra_owner_resolvers:
             v = resolver(ti)
             if v is not None:

@@ -302,11 +302,15 @@ class TokenNode:
             is_ms, ids = unwrap(bytes(r.owner))
             if not is_ms:
                 continue
-            # every component must be signable by one of the listed nodes
-            covered = all(
-                any(self.bus.node(nm).owns_identity(i)
-                    for nm in co_owner_nodes) for i in ids)
-            if covered and self.lockdb.lock(r.id, tx_id):
+            # exact partner-set match: every component signable by a listed
+            # node AND every listed node owns a component (a superset list
+            # would later fail co-signing and leak the token locks)
+            owns = {nm: [self.bus.node(nm).owns_identity(i) for i in ids]
+                    for nm in co_owner_nodes}
+            covered = all(any(owns[nm][j] for nm in co_owner_nodes)
+                          for j in range(len(ids)))
+            all_participate = all(any(flags) for flags in owns.values())
+            if covered and all_participate and self.lockdb.lock(r.id, tx_id):
                 rows.append(r)
         if not rows:
             raise TtxError("no escrow tokens to spend")

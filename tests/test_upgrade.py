@@ -1,0 +1,181 @@
+"""Token upgrade across a public-params update: fabtoken -> zkatdlog.
+
+The reference's TestPublicParamsUpdate scenario (fungible/dlog/dlog_test.go
+:50-58 + zkatdlog v1/tokens.go:208-284, validator_transfer.go:64-93): a
+network switches drivers; plaintext tokens already on the ledger are spent
+under the NEW zkatdlog pp by attaching upgrade witnesses that bind fresh
+commitments to the old plaintext.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken, zkatdlog
+from fabric_token_sdk_tpu.core.zkatdlog.actions import (ActionInput, Token,
+                                                        TransferAction,
+                                                        UpgradeWitness)
+from fabric_token_sdk_tpu.core.zkatdlog.driver import ZkDlogDriverService
+from fabric_token_sdk_tpu.crypto import bn254, setup as zk_setup, \
+    token_commit, transfer_proof
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+from fabric_token_sdk_tpu.token.model import ID
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture
+def world():
+    """Phase 1: a fabtoken network issues plaintext tokens. Phase 2: the
+    pp update swaps in the zkatdlog validator over the SAME ledger."""
+    issuer, auditor = new_signing_identity(), new_signing_identity()
+    alice, bob = new_signing_identity(), new_signing_identity()
+
+    fab_pp = fabtoken.setup(BIT_LENGTH)
+    fab_pp.issuer_ids = [issuer.identity]
+    fab_pp.auditor = bytes(auditor.identity)
+    ledger = MemoryLedger()
+    fab_cc = TokenChaincode(fabtoken.new_validator(fab_pp, Deserializer()),
+                            ledger, fab_pp.serialize())
+
+    # issue plaintext 77 USD to alice under the OLD pp
+    issue = fabtoken.IssueAction(
+        issuer=issuer.identity,
+        outputs=[fabtoken.Output(bytes(alice.identity), "USD", "0x4d")])
+    req = TokenRequest(issues=[issue.serialize()])
+    msg = req.message_to_sign(b"old1")
+    req.auditor_signatures = [auditor.sign(msg)]
+    req.signatures = [issuer.sign(msg)]
+    assert fab_cc.process_request("old1", req.to_bytes()).status == "VALID"
+
+    # pp UPDATE: same ledger, new validator + pp (TMSProvider.Update role)
+    zk_pp = zk_setup.setup(BIT_LENGTH)
+    zk_pp.issuer_ids = [issuer.identity]
+    zk_pp.auditor = bytes(auditor.identity)
+    zk_cc = TokenChaincode(
+        zkatdlog.new_validator(zk_pp, Deserializer(), device=False),
+        ledger, zk_pp.serialize())
+    return dict(zk_pp=zk_pp, zk_cc=zk_cc, issuer=issuer, auditor=auditor,
+                alice=alice, bob=bob, fab_out=issue.outputs[0])
+
+
+def _upgrade_transfer(world, bf=None, claim_value=None, owner=None):
+    """Build the upgrade spend: old plaintext token -> new commitments."""
+    pp = world["zk_pp"]
+    alice, bob = world["alice"], world["bob"]
+    value = claim_value if claim_value is not None else 0x4d
+    bf = bf if bf is not None else bn254.fr_rand()
+    owner = owner if owner is not None else bytes(alice.identity)
+    com = token_commit.commit_token("USD", value, bf,
+                                    pp.pedersen_generators)
+    witness = UpgradeWitness(owner=bytes(world["fab_out"].owner),
+                             token_type="USD", quantity="0x4d",
+                             blinding_factor=bf)
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [0x4d], "USD", pp.pedersen_generators)
+    proof = transfer_proof.transfer_prove(
+        [("USD", value, bf)], [w.as_tuple() for w in out_wits],
+        [com], out_coms, pp)
+    action = TransferAction(
+        inputs=[ActionInput(id=ID("old1", 0),
+                            token=Token(owner=owner, data=com),
+                            upgrade_witness=witness)],
+        outputs=[Token(owner=bytes(bob.identity), data=out_coms[0])],
+        proof=proof,
+    )
+    return action
+
+
+def _submit(world, tx_id, action, signer):
+    req = TokenRequest(transfers=[action.serialize()])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [world["auditor"].sign(msg)]
+    req.signatures = [signer.sign(msg)]
+    return world["zk_cc"].process_request(tx_id, req.to_bytes())
+
+
+def test_upgrade_spend_accepted(world):
+    action = _upgrade_transfer(world)
+    ev = _submit(world, "up1", action, world["alice"])
+    assert ev.status == "VALID", ev.message
+    # the plaintext token is spent; the commitment output is live
+    assert world["zk_cc"].are_tokens_spent([ID("old1", 0)]) == [True]
+
+    # wire round trip preserves the witness
+    restored = TransferAction.deserialize(action.serialize())
+    assert restored.inputs[0].upgrade_witness.quantity == "0x4d"
+    assert restored.serialize() == action.serialize()
+
+
+def test_upgrade_wrong_value_rejected(world):
+    """Witness claims 0x4d but the commitment holds a different value."""
+    action = _upgrade_transfer(world, claim_value=0x4e)
+    ev = _submit(world, "up2", action, world["alice"])
+    assert ev.status == "INVALID"
+    assert "commitment does not match" in ev.message
+
+
+def test_upgrade_wrong_owner_rejected(world):
+    """Claimed input owner (bob, who also signs) differs from the witness's
+    plaintext owner (alice): the witness step must reject."""
+    action = _upgrade_transfer(world, owner=bytes(world["bob"].identity))
+    ev = _submit(world, "up3", action, world["bob"])
+    assert ev.status == "INVALID"
+    assert "owners do not correspond" in ev.message
+
+
+def test_upgrade_nonexistent_ledger_token_rejected(world):
+    """A witness for plaintext that is NOT on the ledger cannot commit."""
+    action = _upgrade_transfer(world)
+    action.inputs[0].upgrade_witness.quantity = "0x10"  # ledger holds 0x4d
+    # recompute commitment/proof consistently with the lie
+    bf = action.inputs[0].upgrade_witness.blinding_factor
+    pp = world["zk_pp"]
+    com = token_commit.commit_token("USD", 0x10, bf,
+                                    pp.pedersen_generators)
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [0x10], "USD", pp.pedersen_generators)
+    proof = transfer_proof.transfer_prove(
+        [("USD", 0x10, bf)], [w.as_tuple() for w in out_wits],
+        [com], out_coms, pp)
+    action.inputs[0].token = Token(owner=bytes(world["alice"].identity),
+                                   data=com)
+    action.outputs = [Token(owner=bytes(world["bob"].identity),
+                            data=out_coms[0])]
+    action.proof = proof
+    ev = _submit(world, "up4", action, world["alice"])
+    assert ev.status == "INVALID"
+    assert "input must exist" in ev.message
+
+
+def test_upgrade_through_node_services(world):
+    """The full services path: a zkatdlog node ingests the OLD plaintext
+    token from the ledger scan and spends it with an auto-built witness."""
+    pp, cc = world["zk_pp"], world["zk_cc"]
+    bus = SessionBus()
+    driver = ZkDlogDriverService(pp, device=False)
+    alice_node = TokenNode("alice", world["alice"], bus, cc,
+                           precision=BIT_LENGTH, auditor_name="auditor",
+                           driver=driver)
+    TokenNode("issuer", world["issuer"], bus, cc, precision=BIT_LENGTH,
+              auditor_name="auditor", driver=driver)
+    AuditorNode("auditor", world["auditor"], bus, cc,
+                precision=BIT_LENGTH, auditor_name="auditor", driver=driver)
+    bob_node = TokenNode("bob", new_signing_identity(), bus, cc,
+                         precision=BIT_LENGTH, auditor_name="auditor",
+                         driver=driver)
+
+    # scan the ledger: the plaintext token ingests in the clear
+    alice_node._ingest_from_ledger("old1", {}, 1)
+    assert alice_node.balance("USD") == 0x4d
+
+    # spend it: the driver detects the fabtoken format and upgrades
+    tx = alice_node.transfer("USD", hex(0x20), "bob")
+    ev = alice_node.execute(tx)
+    assert ev.status == "VALID", ev.message
+    assert bob_node.balance("USD") == 0x20
+    assert alice_node.balance("USD") == 0x4d - 0x20

@@ -104,6 +104,21 @@ def test_transfer_action_bytes_equal_oracle():
     assert parsed.metadata == {"k1": b"v1", "k2": b"v2"}
 
 
+def test_upgrade_witness_bytes_equal_oracle():
+    import ftactions_pb2 as ft
+
+    from fabric_token_sdk_tpu.core.zkatdlog.actions import UpgradeWitness
+
+    ours = UpgradeWitness(owner=b"alice", token_type="USD",
+                          quantity="0x4d", blinding_factor=777)
+    oracle = na.TransferActionInputUpgradeWitness(
+        output=ft.Token(owner=b"alice", type="USD", quantity="0x4d"),
+        blinding_factor=nm.Zr(raw=ser.zr_to_bytes(777)))
+    assert ours.serialize() == oracle.SerializeToString()
+    rt = UpgradeWitness.deserialize(oracle.SerializeToString())
+    assert rt.quantity == "0x4d" and rt.blinding_factor == 777
+
+
 def test_issue_action_bytes_equal_oracle():
     ours = IssueAction(issuer=b"issuer-x", outputs=[Token(b"alice", P1)],
                        proof=b"zkp2")
