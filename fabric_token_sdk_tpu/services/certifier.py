@@ -1,0 +1,181 @@
+"""Token certification service (graph-hiding driver support).
+
+Behavioral mirror of reference token/services/certifier: a client scans the
+vault for uncertified unspent tokens and asks a certifier node to certify
+them over the session plane; the certifier loads the token outputs from the
+ledger, signs them with its certifier identity, and the client verifies and
+stores the certifications (interactive/client.go:98-210, service.go:63-120).
+A dummy driver (dummy/driver.go) treats every token as certified — the
+reference ships no driver with GraphHiding enabled, so dummy is the default
+there too (crypto/setup.go:243-245 GraphHiding=false).
+
+TPU note: certification of commitment tokens is signing, not proving — it
+stays on the host. The batchable part (re-verifying the commitments being
+certified) rides the same device MSM used by the auditor re-open
+(models/audit.py) when a driver with graph hiding lands.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from ..token.model import ID
+from .db.sqldb import CertificationDB
+
+
+class CertificationError(Exception):
+    pass
+
+
+def _certification_payload(namespace: str, token_id: ID,
+                           ledger_token: bytes) -> bytes:
+    """Domain-separated bytes the certifier signs for one token output."""
+    h = hashlib.sha256()
+    h.update(b"token-certification/v1\x00")
+    h.update(namespace.encode() + b"\x00")
+    h.update(token_id.tx_id.encode() + b"\x00")
+    h.update(token_id.index.to_bytes(8, "big"))
+    h.update(ledger_token)
+    return h.digest()
+
+
+class CertifierService:
+    """Certifier-node responder (interactive/service.go Call): load the
+    requested token outputs from the ledger and sign each one.
+
+    Registered on the session bus under its node name; the client reaches it
+    with bus.node(name).certify_tokens(...).
+    """
+
+    def __init__(self, name: str, keys, chaincode, bus,
+                 namespace: str = "token"):
+        self.name = name
+        self.keys = keys
+        self.cc = chaincode
+        self.namespace = namespace
+        bus.register(name, self)
+
+    def identity(self) -> bytes:
+        return bytes(self.keys.identity)
+
+    def certify_tokens(self, ids: list[ID]) -> list[bytes]:
+        """Responder view: one certification (signature) per requested id.
+
+        Unknown ids are an error — certifying a token that is not on the
+        ledger would certify a spend of nothing (service.go step 3 fails
+        when Backend.Load cannot resolve the outputs).
+        """
+        out = []
+        for token_id in ids:
+            raw = self.cc.ledger.get_state(
+                self.cc.keys.output_key(token_id.tx_id, token_id.index))
+            if raw is None:
+                raise CertificationError(
+                    f"cannot certify [{token_id.tx_id}:{token_id.index}]: "
+                    "no such token on the ledger")
+            out.append(self.keys.sign(
+                _certification_payload(self.namespace, token_id, raw)))
+        return out
+
+
+@dataclass
+class CertificationClient:
+    """Vault-side client (interactive/client.go): batch uncertified tokens,
+    request certification, verify + store the responses."""
+
+    node: object                 # TokenNode whose vault is being certified
+    certifier_name: str
+    certifier_identity: bytes
+    db: object = field(default_factory=lambda: CertificationDB(":memory:"))
+    namespace: str = "token"
+    max_attempts: int = 3
+    wait_time: float = 0.05
+
+    def is_certified(self, token_id: ID) -> bool:
+        return self.db.exists(token_id)
+
+    def request_certification(self, ids: list[ID]) -> None:
+        """interactive/client.go:104-137: skip already-certified ids, ask
+        the certifier (with bounded retry), verify every signature against
+        the certifier identity and this node's own view of the ledger, then
+        store."""
+        to_certify = [i for i in ids if not self.is_certified(i)]
+        if not to_certify:
+            return
+        last_err: Exception | None = None
+        for attempt in range(self.max_attempts):
+            try:
+                sigs = self.node.bus.node(self.certifier_name).certify_tokens(
+                    to_certify)
+                break
+            except CertificationError:
+                raise  # deterministic refusal (e.g. unknown token): no retry
+            except Exception as e:  # noqa: BLE001 — transient: retry
+                last_err = e
+                if attempt + 1 < self.max_attempts:
+                    time.sleep(self.wait_time)
+        else:
+            raise CertificationError(
+                f"certification request failed after {self.max_attempts} "
+                f"attempts: {last_err}")
+        if len(sigs) != len(to_certify):
+            raise CertificationError(
+                f"certifier returned {len(sigs)} certifications for "
+                f"{len(to_certify)} tokens")
+        self.db.store(dict(zip(to_certify, self._verify(to_certify, sigs))))
+
+    def _verify(self, ids: list[ID], sigs: list[bytes]) -> list[bytes]:
+        """VerifyCertifications (client.go step 4): recompute each payload
+        from this node's ledger view — a certifier cannot attest to bytes
+        the client does not itself see."""
+        from .identity.x509 import X509Verifier
+
+        verifier = X509Verifier.from_identity(self.certifier_identity)
+        cc = self.node.cc
+        for token_id, sig in zip(ids, sigs):
+            raw = cc.ledger.get_state(
+                cc.keys.output_key(token_id.tx_id, token_id.index))
+            if raw is None:
+                raise CertificationError(
+                    f"certified token [{token_id.tx_id}:{token_id.index}] "
+                    "is not on this node's ledger")
+            verifier.verify(
+                _certification_payload(self.namespace, token_id, raw), sig)
+        return sigs
+
+    def scan(self) -> int:
+        """interactive/client.go:141-177: walk unspent tokens, certify the
+        uncertified ones. Covers the node's whole vault — personal tokens
+        AND co-owned escrow tokens (filed under '<name>.ms' by
+        node._ownership; the reference iterates every vault token).
+        Returns how many were newly certified."""
+        pending = [
+            t.id
+            for wallet in (self.node.name, f"{self.node.name}.ms")
+            for t in self.node.tokendb.unspent_tokens(wallet)
+            if not self.is_certified(t.id)
+        ]
+        if pending:
+            self.request_certification(pending)
+        return len(pending)
+
+
+class DummyCertificationClient:
+    """dummy/driver.go: every token is born certified."""
+
+    def is_certified(self, token_id: ID) -> bool:
+        return True
+
+    def request_certification(self, ids: list[ID]) -> None:
+        return None
+
+    def scan(self) -> int:
+        return 0
+
+
+CERTIFICATION_DRIVERS = {
+    "interactive": CertificationClient,
+    "dummy": DummyCertificationClient,
+}

@@ -13,5 +13,6 @@ from .sqldb import (  # noqa: F401
     AuditDB,
     TokenLockDB,
     IdentityDB,
+    CertificationDB,
     TxStatus,
 )

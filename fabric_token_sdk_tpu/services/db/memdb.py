@@ -265,6 +265,25 @@ class TokenLockDB(_Base):
             return len(expired)
 
 
+class CertificationDB(_Base):
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(path)
+        self._certs: dict[tuple[str, int], bytes] = {}
+
+    def exists(self, token_id: ID) -> bool:
+        with self._mu:
+            return (token_id.tx_id, token_id.index) in self._certs
+
+    def store(self, certifications: dict[ID, bytes]) -> None:
+        with self._mu:
+            for i, c in certifications.items():
+                self._certs[(i.tx_id, i.index)] = bytes(c)
+
+    def get(self, token_id: ID) -> bytes | None:
+        with self._mu:
+            return self._certs.get((token_id.tx_id, token_id.index))
+
+
 class IdentityDB(_Base):
     def __init__(self, path: str = ":memory:"):
         super().__init__(path)

@@ -420,6 +420,41 @@ class TokenLockDB(_Base):
             return cur.rowcount
 
 
+class CertificationDB(_Base):
+    """Token-certification store (reference sdk/vault CertificationStorage:
+    Exists/Store over the vault's certification section)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS certifications (
+        tx_id TEXT NOT NULL,
+        idx INTEGER NOT NULL,
+        certification BLOB NOT NULL,
+        PRIMARY KEY (tx_id, idx)
+    );
+    """
+
+    def exists(self, token_id: ID) -> bool:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT 1 FROM certifications WHERE tx_id=? AND idx=?",
+                (token_id.tx_id, token_id.index)).fetchone()
+        return row is not None
+
+    def store(self, certifications: dict[ID, bytes]) -> None:
+        with self._mu:
+            self.conn.executemany(
+                "INSERT OR REPLACE INTO certifications VALUES (?,?,?)",
+                [(i.tx_id, i.index, c) for i, c in certifications.items()])
+            self.conn.commit()
+
+    def get(self, token_id: ID) -> bytes | None:
+        with self._mu:
+            row = self.conn.execute(
+                "SELECT certification FROM certifications WHERE tx_id=? AND "
+                "idx=?", (token_id.tx_id, token_id.index)).fetchone()
+        return row[0] if row else None
+
+
 class IdentityDB(_Base):
     """identitydb: wallet/identity persistence (identitydb, SURVEY §2.4)."""
 

@@ -1,0 +1,245 @@
+"""Role-based wallet registry + local membership.
+
+Behavioral mirror of reference token/services/identity/{role,wallet}
+(role/role.go MapToIdentity resolution order, wallet/service.go role
+registries, wallet/wallets.go concrete wallets) and the membership layer
+(identity/membership): a node holds one registry per role
+(Owner/Issuer/Auditor/Certifier), each backed by a local membership of
+long-term identities, persisted through IdentityDB so wallets and
+identity->enrollment bindings survive restart.
+
+Flattened from the reference's dig-DI shape: registries are plain objects;
+the cache layer (wallet/cache.go pre-derived pseudonyms) collapses into
+the Idemix key manager, which derives pseudonyms on demand.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .wallet import IdemixOwnerWallet, X509OwnerWallet
+
+
+class RoleType:
+    """identity.RoleType constants (identity/role/role.go)."""
+
+    OWNER = "owner"
+    ISSUER = "issuer"
+    AUDITOR = "auditor"
+    CERTIFIER = "certifier"
+
+    ALL = (OWNER, ISSUER, AUDITOR, CERTIFIER)
+
+
+class RegistryError(Exception):
+    pass
+
+
+@dataclass
+class IdentityInfo:
+    """idriver.IdentityInfo: a resolvable wallet entry."""
+
+    id: str
+    enrollment_id: str
+    remote: bool  # True for third-party recipient identities (no signer)
+
+
+class LocalMembership:
+    """identity/membership localMembership: the long-term identities this
+    node can sign with, for ONE role, keyed by label."""
+
+    def __init__(self, default_label: str | None = None):
+        self._by_label: dict[str, object] = {}   # label -> wallet object
+        self._eids: dict[str, str] = {}          # label -> enrollment id
+        self.default_label = default_label
+
+    def register(self, label: str, wallet, enrollment_id: str = "",
+                 default: bool = False) -> None:
+        self._by_label[label] = wallet
+        self._eids[label] = enrollment_id or label
+        if default or self.default_label is None:
+            self.default_label = label
+
+    def ids(self) -> list[str]:
+        return sorted(self._by_label)
+
+    def is_me(self, identity: bytes) -> bool:
+        return any(w.owns(identity) for w in self._by_label.values())
+
+    def get_identifier(self, identity: bytes) -> str | None:
+        for label, w in self._by_label.items():
+            if w.owns(identity):
+                return label
+        return None
+
+    def wallet(self, label: str):
+        return self._by_label.get(label)
+
+    def enrollment_id(self, label: str) -> str:
+        return self._eids.get(label, label)
+
+
+class Role:
+    """role/role.go: maps a WalletLookupID (label string, identity bytes,
+    or None) to a wallet identifier within one role's membership."""
+
+    def __init__(self, role_id: str, membership: LocalMembership):
+        self.role_id = role_id
+        self.membership = membership
+
+    def map_to_identifier(self, lookup) -> str | None:
+        """Resolution order of role.go mapStringToID/mapIdentityToID:
+        empty -> default; known label -> that label; owned identity ->
+        its label; unknown -> None (reference returns the raw label and
+        fails later at wallet construction; failing here is the same
+        observable outcome with a clearer error site)."""
+        m = self.membership
+        if lookup is None or lookup == "" or lookup == b"":
+            return m.default_label
+        if isinstance(lookup, str):
+            if lookup in m.ids():
+                return lookup
+            ident = lookup.encode()
+            return m.get_identifier(ident)
+        ident = bytes(lookup)
+        label = m.get_identifier(ident)
+        if label is not None:
+            return label
+        return None
+
+
+class WalletRegistry:
+    """wallet/wallets.go registry for one role: wallet lookup + identity
+    bindings, persisted via IdentityDB."""
+
+    def __init__(self, role: Role, identity_db):
+        self.role = role
+        self.db = identity_db
+        # identity bytes -> (enrollment id, wallet id); the ledger-visible
+        # pseudonyms bound to each wallet (BindIdentity)
+        self._bindings: dict[bytes, tuple[str, str]] = {}
+
+    def wallet_ids(self) -> list[str]:
+        return self.role.membership.ids()
+
+    def lookup(self, lookup=None):
+        """Returns (wallet, wallet_id). Raises RegistryError when the
+        lookup resolves to nothing."""
+        wid = self.role.map_to_identifier(lookup)
+        if wid is None:
+            raise RegistryError(
+                f"no {self.role.role_id} wallet for lookup [{lookup!r}]")
+        w = self.role.membership.wallet(wid)
+        if w is None:
+            raise RegistryError(
+                f"{self.role.role_id} wallet [{wid}] not registered")
+        return w, wid
+
+    def register_wallet(self, wallet_id: str, wallet,
+                        enrollment_id: str = "") -> None:
+        self.role.membership.register(wallet_id, wallet, enrollment_id)
+        ident = getattr(wallet, "long_term_identity", None)
+        if ident is not None:
+            self.db.register_wallet(wallet_id, self.role.role_id,
+                                    bytes(ident), enrollment_id)
+
+    def bind_identity(self, identity: bytes, enrollment_id: str,
+                      wallet_id: str, audit_info: bytes = b"") -> None:
+        """BindIdentity: associate a ledger identity (e.g. a fresh Idemix
+        pseudonym) with the wallet that controls it."""
+        self._bindings[bytes(identity)] = (enrollment_id, wallet_id)
+        if audit_info:
+            self.db.store_audit_info(bytes(identity), audit_info)
+
+    def contains_identity(self, identity: bytes,
+                          wallet_id: str | None = None) -> bool:
+        entry = self._bindings.get(bytes(identity))
+        if entry is not None:
+            return wallet_id is None or entry[1] == wallet_id
+        label = self.role.membership.get_identifier(bytes(identity))
+        if label is None:
+            return False
+        return wallet_id is None or label == wallet_id
+
+
+class WalletService:
+    """wallet/service.go: the per-TMS wallet manager — one registry per
+    role, plus third-party recipient registration."""
+
+    def __init__(self, identity_db, info_matcher=None):
+        self.db = identity_db
+        self.info_matcher = info_matcher
+        self.registries = {
+            r: WalletRegistry(Role(r, LocalMembership()), identity_db)
+            for r in RoleType.ALL
+        }
+        # third-party recipients: identity -> audit info
+        self._recipients: dict[bytes, bytes] = {}
+
+    # -------------------------------------------------------------- lookups
+    def owner_wallet(self, lookup=None):
+        return self.registries[RoleType.OWNER].lookup(lookup)[0]
+
+    def issuer_wallet(self, lookup=None):
+        return self.registries[RoleType.ISSUER].lookup(lookup)[0]
+
+    def auditor_wallet(self, lookup=None):
+        return self.registries[RoleType.AUDITOR].lookup(lookup)[0]
+
+    def certifier_wallet(self, lookup=None):
+        return self.registries[RoleType.CERTIFIER].lookup(lookup)[0]
+
+    def wallet_ids(self, role: str) -> list[str]:
+        return self.registries[role].wallet_ids()
+
+    # -------------------------------------------------------- registration
+    def register_owner_wallet(self, wallet_id: str, wallet,
+                              enrollment_id: str = "") -> None:
+        self.registries[RoleType.OWNER].register_wallet(
+            wallet_id, wallet, enrollment_id)
+
+    def register_issuer_wallet(self, wallet_id: str, wallet,
+                               enrollment_id: str = "") -> None:
+        self.registries[RoleType.ISSUER].register_wallet(
+            wallet_id, wallet, enrollment_id)
+
+    def register_recipient_identity(self, identity: bytes,
+                                    audit_info: bytes) -> None:
+        """service.go RegisterRecipientIdentity: a THIRD PARTY's recipient
+        data — verify the audit info matches the identity (Deserializer.
+        MatchIdentity) before trusting it for future outputs."""
+        if identity is None:
+            raise RegistryError("nil recipient data")
+        if self.info_matcher is not None:
+            self.info_matcher.match_identity(bytes(identity), audit_info)
+        self._recipients[bytes(identity)] = audit_info
+        self.db.store_audit_info(bytes(identity), audit_info)
+
+    def get_audit_info(self, identity: bytes) -> bytes | None:
+        info = self._recipients.get(bytes(identity))
+        if info is not None:
+            return info
+        return self.db.get_audit_info(bytes(identity))
+
+    # ------------------------------------------------------------- helpers
+    @classmethod
+    def for_node(cls, name: str, keys, identity_db, owner_wallet=None,
+                 idemix_km=None, info_matcher=None) -> "WalletService":
+        """Assemble the default registries of a TokenNode: the node's
+        ACTIVE owner wallet under the node's name (x509 from `keys` when
+        none is supplied; pseudonymous wallets persist no single long-term
+        identity), and the node key as issuer/auditor/certifier wallet —
+        the same defaulting the reference driver factory performs from
+        config (zkatdlog v1/driver/driver.go wallet service assembly)."""
+        ws = cls(identity_db, info_matcher=info_matcher)
+        if owner_wallet is None:
+            owner_wallet = X509OwnerWallet(keys)
+        ws.register_owner_wallet(name, owner_wallet, enrollment_id=name)
+        if idemix_km is not None:
+            ws.register_owner_wallet(f"{name}.idemix",
+                                     IdemixOwnerWallet(idemix_km),
+                                     enrollment_id=name)
+        for role in (RoleType.ISSUER, RoleType.AUDITOR, RoleType.CERTIFIER):
+            ws.registries[role].register_wallet(name, X509OwnerWallet(keys),
+                                                enrollment_id=name)
+        return ws

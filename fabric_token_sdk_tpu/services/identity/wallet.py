@@ -24,6 +24,9 @@ class X509OwnerWallet:
 
     def __init__(self, keys: X509KeyPair):
         self.keys = keys
+        # the registry persists long-term wallets to IdentityDB by this
+        # attribute; pseudonymous wallets have none
+        self.long_term_identity = bytes(keys.identity)
 
     def recipient_identity(self) -> tuple[bytes, bytes]:
         ident = bytes(self.keys.identity)

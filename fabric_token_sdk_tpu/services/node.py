@@ -18,7 +18,8 @@ from ..core.fabtoken.driver import FabTokenDriverService, OutputSpec
 from ..driver import TokenRequest
 from ..token import quantity as q
 from ..token.model import ID
-from .db.sqldb import TokenDB, TokenLockDB, TransactionDB, TxRecord, TxStatus
+from .db.sqldb import IdentityDB, TokenDB, TokenLockDB, TransactionDB, \
+    TxRecord, TxStatus
 from .selector import SherdLockSelector
 from .tokens import Tokens
 from .ttx import SessionBus, Transaction, TtxError, collect_endorsements, \
@@ -53,6 +54,13 @@ class TokenNode:
         self.tokendb = TokenDB(_db("tokens"))
         self.ttxdb = TransactionDB(_db("ttx"))
         self.lockdb = TokenLockDB(_db("locks"))
+        self.identitydb = IdentityDB(_db("identity"))
+        # role-based wallet manager (identity/wallet registry); the node's
+        # active owner wallet is registered under the node name
+        from .identity.registry import WalletService
+
+        self.wallets = WalletService.for_node(
+            name, keys, self.identitydb, owner_wallet=self.owner_wallet)
         self.selector = SherdLockSelector(self.tokendb, self.lockdb,
                                           precision=precision)
         self.tokens = Tokens(self.tokendb, self._ownership,

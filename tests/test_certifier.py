@@ -1,0 +1,118 @@
+"""Certifier service: interactive certification flow + dummy driver.
+
+Mirrors reference token/services/certifier (interactive/client.go scan/
+request/verify/store pipeline; dummy/driver.go pass-through) over the
+in-process session bus and memory ledger.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.certifier import (
+    CertificationClient,
+    CertificationError,
+    CertifierService,
+    DummyCertificationClient,
+)
+from fabric_token_sdk_tpu.services.db import memdb, sqldb
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, \
+    TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+from fabric_token_sdk_tpu.token.model import ID
+
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    certifier_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    validator = fabtoken.new_validator(pp, Deserializer())
+    cc = TokenChaincode(validator, MemoryLedger(), pp.serialize())
+    bus = SessionBus()
+    nodes = {
+        "issuer": TokenNode("issuer", issuer_keys, bus, cc,
+                            auditor_name="auditor"),
+        "auditor": AuditorNode("auditor", auditor_keys, bus, cc,
+                               auditor_name="auditor"),
+        "alice": TokenNode("alice", new_signing_identity(), bus, cc,
+                           auditor_name="auditor"),
+    }
+    service = CertifierService("certifier", certifier_keys, cc, bus)
+    return nodes, service
+
+
+def _fund(nodes, amount=500):
+    alice = nodes["alice"]
+    ev = alice.execute(alice.issue("issuer", "alice", "USD", hex(amount)))
+    assert ev.status == "VALID", ev.message
+
+
+def test_scan_certifies_unspent_tokens(net):
+    nodes, service = net
+    _fund(nodes)
+    client = CertificationClient(
+        node=nodes["alice"], certifier_name="certifier",
+        certifier_identity=service.identity())
+    unspent = [t.id for t in nodes["alice"].tokendb.unspent_tokens("alice")]
+    assert unspent and not any(client.is_certified(i) for i in unspent)
+
+    assert client.scan() == len(unspent)
+    assert all(client.is_certified(i) for i in unspent)
+    # idempotent: nothing new on a second scan
+    assert client.scan() == 0
+
+
+def test_certification_is_a_verifiable_signature(net):
+    nodes, service = net
+    _fund(nodes)
+    client = CertificationClient(
+        node=nodes["alice"], certifier_name="certifier",
+        certifier_identity=service.identity())
+    client.scan()
+    tok = nodes["alice"].tokendb.unspent_tokens("alice")[0]
+    cert = client.db.get(tok.id)
+    assert cert  # stored certification is the certifier's ECDSA signature
+
+    # a client pinned to the WRONG certifier identity rejects the response
+    rogue = CertificationClient(
+        node=nodes["alice"], certifier_name="certifier",
+        certifier_identity=bytes(new_signing_identity().identity))
+    with pytest.raises(Exception):
+        rogue.request_certification([tok.id])
+
+
+def test_certify_unknown_token_fails(net):
+    nodes, service = net
+    client = CertificationClient(
+        node=nodes["alice"], certifier_name="certifier",
+        certifier_identity=service.identity(), max_attempts=2,
+        wait_time=0.0)
+    with pytest.raises(CertificationError):
+        client.request_certification([ID("no-such-tx", 0)])
+
+
+def test_dummy_driver(net):
+    client = DummyCertificationClient()
+    assert client.is_certified(ID("anything", 3))
+    assert client.scan() == 0
+    client.request_certification([ID("x", 0)])
+
+
+@pytest.mark.parametrize("backend", [sqldb, memdb])
+def test_certificationdb_contract(backend):
+    db = backend.CertificationDB(":memory:")
+    assert not db.exists(ID("t", 0))
+    db.store({ID("t", 0): b"c0", ID("t", 1): b"c1"})
+    assert db.exists(ID("t", 0)) and db.exists(ID("t", 1))
+    assert db.get(ID("t", 0)) == b"c0"
+    assert db.get(ID("t", 9)) is None
+    # overwrite is last-write-wins (vault Store semantics)
+    db.store({ID("t", 0): b"c0'"})
+    assert db.get(ID("t", 0)) == b"c0'"

@@ -1,0 +1,127 @@
+"""Role-based wallet registry / local membership / recipient registration.
+
+Mirrors reference token/services/identity/{role,wallet}: role.go
+MapToIdentity resolution order, wallet registry lookup + BindIdentity,
+service.go RegisterRecipientIdentity with audit-info matching.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.services.db.sqldb import IdentityDB
+from fabric_token_sdk_tpu.services.identity.idemix import (
+    EnrollmentAuthority,
+    IdemixInfoMatcher,
+    IdemixKeyManager,
+)
+from fabric_token_sdk_tpu.services.identity.registry import (
+    LocalMembership,
+    RegistryError,
+    Role,
+    RoleType,
+    WalletService,
+)
+from fabric_token_sdk_tpu.services.identity.wallet import (
+    IdemixOwnerWallet,
+    X509OwnerWallet,
+)
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+
+
+def _ws():
+    keys = new_signing_identity()
+    ws = WalletService.for_node("alice", keys, IdentityDB(":memory:"))
+    return keys, ws
+
+
+def test_role_lookup_resolution_order():
+    keys = new_signing_identity()
+    wallet = X509OwnerWallet(keys)
+    m = LocalMembership()
+    m.register("alice", wallet, enrollment_id="alice-eid")
+    role = Role(RoleType.OWNER, m)
+
+    # empty lookup -> default wallet (role.go: empty label)
+    assert role.map_to_identifier(None) == "alice"
+    assert role.map_to_identifier("") == "alice"
+    # label -> itself; owned identity bytes -> its label
+    assert role.map_to_identifier("alice") == "alice"
+    assert role.map_to_identifier(bytes(keys.identity)) == "alice"
+    # unknown -> None
+    assert role.map_to_identifier("nobody") is None
+    assert role.map_to_identifier(b"\x01\x02") is None
+
+
+def test_wallet_service_roles_and_default():
+    keys, ws = _ws()
+    assert ws.owner_wallet() is ws.owner_wallet("alice")
+    assert ws.issuer_wallet().keys is keys
+    assert ws.auditor_wallet().owns(bytes(keys.identity))
+    assert ws.certifier_wallet() is not None
+    with pytest.raises(RegistryError):
+        ws.owner_wallet("bob")
+    assert ws.wallet_ids(RoleType.OWNER) == ["alice"]
+
+
+def test_multiple_owner_wallets_and_bindings():
+    keys, ws = _ws()
+    km = IdemixKeyManager("alice-eid", EnrollmentAuthority())
+    ws.register_owner_wallet("alice.anon", IdemixOwnerWallet(km),
+                             enrollment_id="alice-eid")
+    assert set(ws.wallet_ids(RoleType.OWNER)) == {"alice", "alice.anon"}
+
+    anon = ws.owner_wallet("alice.anon")
+    nym, audit_info = anon.recipient_identity()
+    # a fresh pseudonym resolves through the wallet that controls it
+    assert ws.owner_wallet(nym) is anon
+
+    reg = ws.registries[RoleType.OWNER]
+    reg.bind_identity(nym, "alice-eid", "alice.anon", audit_info)
+    assert reg.contains_identity(nym)
+    assert reg.contains_identity(nym, "alice.anon")
+    assert not reg.contains_identity(nym, "alice")
+    assert ws.get_audit_info(nym) == audit_info
+
+
+def test_register_recipient_identity_matches_audit_info():
+    authority = EnrollmentAuthority()
+    km = IdemixKeyManager("bob-eid", authority)
+    matcher = IdemixInfoMatcher(authority.ca_identity())
+    ws = WalletService(IdentityDB(":memory:"), info_matcher=matcher)
+
+    nym, audit_info = IdemixOwnerWallet(km).recipient_identity()
+    ws.register_recipient_identity(nym, audit_info)
+    assert ws.get_audit_info(nym) == audit_info
+
+    # mismatched audit info is rejected (service.go MatchIdentity)
+    other_nym, other_ai = IdemixOwnerWallet(km).recipient_identity()
+    with pytest.raises(Exception):
+        ws.register_recipient_identity(nym, other_ai)
+
+
+def test_identity_db_persistence():
+    keys = new_signing_identity()
+    db = IdentityDB(":memory:")
+    WalletService.for_node("alice", keys, db)
+    # long-term wallets are persisted for restart recovery
+    assert db.wallet_identity("alice", RoleType.OWNER) == bytes(keys.identity)
+    assert db.wallet_identity("alice", RoleType.ISSUER) == bytes(keys.identity)
+
+
+def test_node_exposes_wallet_manager():
+    from fabric_token_sdk_tpu.core import fabtoken
+    from fabric_token_sdk_tpu.services.identity.deserializer import \
+        Deserializer
+    from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, \
+        TokenChaincode
+    from fabric_token_sdk_tpu.services.node import TokenNode
+    from fabric_token_sdk_tpu.services.ttx import SessionBus
+
+    keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [keys.identity]
+    cc = TokenChaincode(fabtoken.new_validator(pp, Deserializer()),
+                        MemoryLedger(), pp.serialize())
+    node = TokenNode("alice", keys, SessionBus(), cc)
+    # the registry resolves to the SAME active owner-wallet object
+    assert node.wallets.owner_wallet() is node.owner_wallet
+    assert node.wallets.owner_wallet(bytes(keys.identity)) is node.owner_wallet
