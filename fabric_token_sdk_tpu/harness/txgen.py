@@ -1,0 +1,186 @@
+"""txgen: configurable transaction load generator + metrics collection.
+
+Behavioral mirror of reference integration/nwo/txgen ({model,service,
+executor}: user/issuer APIs, a configurable transaction-mix distribution,
+concurrent execution, per-request metrics). Drives any set of TokenNode
+facades — the in-process SessionBus net or the NWO multiprocess platform's
+node handles — through the same issue/transfer/redeem initiator views the
+applications use, and reports throughput/latency/error statistics.
+
+Determinism: the mix is drawn from a seeded RNG so a load profile replays
+identically (txgen's distribution model), which also makes failure counts
+assertable in tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TxProfile:
+    """The transaction-mix model (txgen model.go equivalents): weights of
+    each op plus the value range drawn for it."""
+
+    issue_weight: float = 0.2
+    transfer_weight: float = 0.7
+    redeem_weight: float = 0.1
+    min_value: int = 1
+    max_value: int = 50
+    token_type: str = "USD"
+
+
+@dataclass
+class TxOutcome:
+    op: str
+    ok: bool
+    seconds: float
+    error: str = ""
+
+
+@dataclass
+class LoadReport:
+    outcomes: list[TxOutcome] = field(default_factory=list)
+    wall_seconds: float = 0.0
+
+    # ------------------------------------------------------------- metrics
+    def _lat(self, ok_only=True) -> list[float]:
+        return sorted(o.seconds for o in self.outcomes
+                      if o.ok or not ok_only)
+
+    @property
+    def succeeded(self) -> int:
+        return sum(1 for o in self.outcomes if o.ok)
+
+    @property
+    def failed(self) -> int:
+        return sum(1 for o in self.outcomes if not o.ok)
+
+    def failures_by_error(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for o in self.outcomes:
+            if not o.ok:
+                out[o.error] = out.get(o.error, 0) + 1
+        return out
+
+    def throughput(self) -> float:
+        return self.succeeded / self.wall_seconds if self.wall_seconds else 0.0
+
+    def percentile_latency(self, p: float) -> float:
+        lat = self._lat()
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(p / 100.0 * len(lat)))]
+
+    def summary(self) -> dict:
+        return {
+            "total": len(self.outcomes),
+            "succeeded": self.succeeded,
+            "failed": self.failed,
+            "tx_per_sec": round(self.throughput(), 2),
+            "p50_latency_s": round(self.percentile_latency(50), 4),
+            "p95_latency_s": round(self.percentile_latency(95), 4),
+        }
+
+
+class LoadGenerator:
+    """txgen service/executor: drive a transaction mix over live nodes.
+
+    `users` are payer nodes; each op picks a payer and a distinct payee.
+    Issues go through `issuer_name` to the payer (the user-API Withdraw);
+    transfers move payer->payee; redeems burn at the payer.
+    """
+
+    def __init__(self, users: list, issuer_name: str,
+                 profile: TxProfile | None = None, seed: int = 7):
+        if not users:
+            raise ValueError("txgen needs at least one user node")
+        self.users = users
+        self.issuer_name = issuer_name
+        self.profile = profile or TxProfile()
+        self.rng = random.Random(seed)
+
+    # ------------------------------------------------------------ one op
+    def _pick_op(self) -> str:
+        p = self.profile
+        return self.rng.choices(
+            ["issue", "transfer", "redeem"],
+            weights=[p.issue_weight, p.transfer_weight, p.redeem_weight])[0]
+
+    def _run_one(self, op: str) -> TxOutcome:
+        p = self.profile
+        value = self.rng.randint(p.min_value, p.max_value)
+        payer = self.rng.choice(self.users)
+        t0 = time.perf_counter()
+        try:
+            if op == "issue":
+                tx = payer.issue(self.issuer_name, payer.name, p.token_type,
+                                 hex(value))
+            elif op == "transfer":
+                others = [u for u in self.users if u is not payer]
+                payee = self.rng.choice(others) if others else payer
+                tx = payer.transfer(p.token_type, hex(value), payee.name)
+            else:
+                tx = payer.transfer(p.token_type, hex(value), "", redeem=True)
+            ev = payer.execute(tx)
+            ok = ev.status == "VALID"
+            err = "" if ok else ev.message
+        except Exception as e:
+            ok, err = False, type(e).__name__
+        return TxOutcome(op, ok, time.perf_counter() - t0, err)
+
+    # ---------------------------------------------------------------- run
+    def run(self, n_txs: int, parallelism: int = 1,
+            bootstrap_value: int | None = None) -> LoadReport:
+        """Execute n_txs drawn from the profile. `parallelism` worker
+        threads share the stream (txgen's concurrent executors —
+        contention on the selector/locks is part of the workload).
+        `bootstrap_value`: optional initial issue to every user so
+        transfers don't all fail on empty wallets."""
+        report = LoadReport()
+        t_start = time.perf_counter()
+        if bootstrap_value:
+            for u in self.users:
+                out = self._bootstrap(u, bootstrap_value)
+                report.outcomes.append(out)
+        ops = [self._pick_op() for _ in range(n_txs)]
+        if parallelism <= 1:
+            report.outcomes.extend(self._run_one(op) for op in ops)
+        else:
+            mu = threading.Lock()
+            cursor = iter(ops)
+
+            def worker():
+                while True:
+                    with mu:
+                        op = next(cursor, None)
+                    if op is None:
+                        return
+                    out = self._run_one(op)
+                    with mu:
+                        report.outcomes.append(out)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(parallelism)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        report.wall_seconds = time.perf_counter() - t_start
+        return report
+
+    def _bootstrap(self, user, value: int) -> TxOutcome:
+        t0 = time.perf_counter()
+        try:
+            tx = user.issue(self.issuer_name, user.name,
+                            self.profile.token_type, hex(value))
+            ev = user.execute(tx)
+            return TxOutcome("issue", ev.status == "VALID",
+                             time.perf_counter() - t0, ev.message
+                             if ev.status != "VALID" else "")
+        except Exception as e:
+            return TxOutcome("issue", False, time.perf_counter() - t0,
+                             type(e).__name__)

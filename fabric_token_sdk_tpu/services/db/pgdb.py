@@ -1,0 +1,219 @@
+"""PostgreSQL backend: the sqlite query layer over a DB-API driver.
+
+Mirrors the reference's multi-driver SQL design (token/services/db/sql:
+ONE schema + query layer in db/sql/common, thin per-driver dialects in
+db/sql/{sqlite,postgres}): every store subclasses its sqldb counterpart
+and only the dialect changes. Translation happens at the connection
+boundary, so the store logic stays written once:
+
+  - `?` placeholders              -> `%s`
+  - `INSERT OR REPLACE INTO t`    -> `INSERT ... ON CONFLICT (pk) DO UPDATE`
+    (primary keys harvested from the shared SCHEMA declarations)
+  - `BLOB` / `x''`                -> `BYTEA` / `''::bytea`
+  - `INTEGER PRIMARY KEY AUTOINCREMENT` -> `BIGSERIAL PRIMARY KEY`
+  - sqlite3.IntegrityError        -> driver IntegrityError (re-raised as
+    the shared type so store-level except clauses fire identically)
+
+The driver module (psycopg2 or any DB-API 2 module with pyformat/format
+paramstyle) is injected, keeping this importable — and the translation
+logic testable with a fake connection — on hosts without postgres
+(reference runs its postgres contract tests only under testcontainers;
+tests/test_db_contract.py skips the postgres backend the same way).
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+import threading
+
+from . import sqldb
+
+
+def _pk_columns(schema: str) -> dict[str, str]:
+    """Harvest table -> 'col, col' primary-key map from a CREATE script."""
+    out: dict[str, str] = {}
+    for table_sql in schema.split(";"):
+        m = re.search(r"CREATE TABLE IF NOT EXISTS (\w+)", table_sql)
+        if not m:
+            continue
+        table = m.group(1)
+        pk = re.search(r"PRIMARY KEY \(([^)]*)\)", table_sql)
+        if pk:
+            out[table] = pk.group(1).strip()
+            continue
+        # inline form: "<col> <TYPE> ... PRIMARY KEY" on one column line
+        for line in table_sql.splitlines():
+            inline = re.match(r"\s*(\w+)\s+\w+.*PRIMARY KEY", line)
+            if inline and "CREATE TABLE" not in line:
+                out[table] = inline.group(1)
+                break
+    return out
+
+
+def translate_schema(schema: str) -> str:
+    """sqlite DDL -> postgres DDL for the shared store schemas."""
+    s = schema
+    s = s.replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                  "BIGSERIAL PRIMARY KEY")
+    s = s.replace("BLOB", "BYTEA")
+    s = s.replace("x''", "''::bytea")
+    s = re.sub(r"\bREAL\b", "DOUBLE PRECISION", s)
+    s = re.sub(r"\bINTEGER\b", "BIGINT", s)
+    return s
+
+
+def translate_query(sql: str, pks: dict[str, str]) -> str:
+    """One sqlite query -> postgres. Placeholders and upserts only — the
+    stores use no other sqlite-isms in DML."""
+    sql = sql.replace("?", "%s")
+    m = re.match(r"\s*INSERT OR REPLACE INTO (\w+)\s*(\(([^)]*)\))?\s*"
+                 r"VALUES\s*(\(.*\))", sql, re.S)
+    if m:
+        table, _, cols, values = m.groups()
+        pk = pks.get(table)
+        if pk is None:
+            raise ValueError(f"no primary key known for table [{table}]")
+        if cols is None:
+            raise ValueError(
+                f"INSERT OR REPLACE into [{table}] must list columns for "
+                "the postgres dialect")
+        col_list = [c.strip() for c in cols.split(",")]
+        pk_cols = {c.strip() for c in pk.split(",")}
+        updates = [f"{c} = EXCLUDED.{c}" for c in col_list
+                   if c not in pk_cols]
+        action = (f"DO UPDATE SET {', '.join(updates)}" if updates
+                  else "DO NOTHING")
+        return (f"INSERT INTO {table} ({', '.join(col_list)}) "
+                f"VALUES {values} ON CONFLICT ({pk}) {action}")
+    return sql
+
+
+class _Cursorish:
+    """The slice of sqlite3's connection-level execute API the stores use,
+    emulated over a DB-API cursor."""
+
+    def __init__(self, cursor):
+        self._cursor = cursor
+        self.rowcount = cursor.rowcount
+
+    def fetchone(self):
+        return self._cursor.fetchone()
+
+    def fetchall(self):
+        return self._cursor.fetchall()
+
+
+class _Prefetched:
+    """Result of a SELECT whose transaction was already closed."""
+
+    def __init__(self, rows):
+        self._rows = list(rows)
+        self.rowcount = len(self._rows)
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self):
+        return self._rows
+
+
+class PGConnection:
+    """Adapter giving a DB-API postgres connection the sqlite3 connection
+    surface the shared stores rely on (execute/executemany/executescript/
+    commit/close), translating each statement on the way through."""
+
+    def __init__(self, dbapi_conn, driver_module, pks: dict[str, str]):
+        self._conn = dbapi_conn
+        self._driver = driver_module
+        self._pks = pks
+
+    def execute(self, sql: str, params=()):
+        translated = translate_query(sql, self._pks)
+        cur = self._conn.cursor()
+        try:
+            cur.execute(translated, tuple(params))
+        except self._driver.IntegrityError as e:
+            self._conn.rollback()
+            raise sqlite3.IntegrityError(str(e)) from e
+        except Exception:
+            # any other failure would leave a real postgres connection in
+            # an aborted transaction, wedging every later statement
+            self._conn.rollback()
+            raise
+        if translated.lstrip().upper().startswith("SELECT"):
+            # end the implicit read transaction (no idle-in-transaction);
+            # rows are prefetched so the caller's fetch still works
+            rows = cur.fetchall()
+            self._conn.rollback()
+            return _Prefetched(rows)
+        return _Cursorish(cur)
+
+    def executemany(self, sql: str, seq_of_params):
+        cur = self._conn.cursor()
+        try:
+            cur.executemany(translate_query(sql, self._pks),
+                            [tuple(p) for p in seq_of_params])
+        except self._driver.IntegrityError as e:
+            self._conn.rollback()
+            raise sqlite3.IntegrityError(str(e)) from e
+        except Exception:
+            self._conn.rollback()
+            raise
+        return _Cursorish(cur)
+
+    def executescript(self, script: str):
+        cur = self._conn.cursor()
+        for stmt in translate_schema(script).split(";"):
+            if stmt.strip():
+                cur.execute(stmt)
+
+    def commit(self):
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+
+def _pg_base(store_cls):
+    """Build the postgres variant of one sqldb store class."""
+
+    class _PGStore(store_cls):
+        def __init__(self, dsn: str, driver_module=None):
+            if driver_module is None:
+                import psycopg2 as driver_module  # noqa: PLC0415
+            # bypass sqldb._Base.__init__ (sqlite connect); same schema
+            self.conn = PGConnection(driver_module.connect(dsn),
+                                     driver_module,
+                                     _pk_columns(self.SCHEMA))
+            self._mu = threading.RLock()
+            with self._mu:
+                self.conn.executescript(self.SCHEMA)
+                self.conn.commit()
+
+    _PGStore.__name__ = store_cls.__name__
+    _PGStore.__qualname__ = f"pg.{store_cls.__name__}"
+    return _PGStore
+
+
+TokenDB = _pg_base(sqldb.TokenDB)
+TransactionDB = _pg_base(sqldb.TransactionDB)
+AuditDB = _pg_base(sqldb.AuditDB)
+TokenLockDB = _pg_base(sqldb.TokenLockDB)
+IdentityDB = _pg_base(sqldb.IdentityDB)
+CertificationDB = _pg_base(sqldb.CertificationDB)
+
+# re-exported shared contract types
+DBError = sqldb.DBError
+TxRecord = sqldb.TxRecord
+TxStatus = sqldb.TxStatus
+
+
+def available() -> bool:
+    """True when a postgres driver module is importable (server liveness is
+    the contract tests' concern, mirroring dbtest + testcontainers)."""
+    try:
+        import psycopg2  # noqa: F401, PLC0415
+    except ImportError:
+        return False
+    return True

@@ -295,7 +295,8 @@ class TransactionDB(_Base):
                             sigma: bytes) -> None:
         with self._mu:
             self.conn.execute(
-                "INSERT OR REPLACE INTO endorsement_acks VALUES (?,?,?)",
+                "INSERT OR REPLACE INTO endorsement_acks (tx_id, endorser, sigma) "
+                "VALUES (?,?,?)",
                 (tx_id, endorser, sigma))
             self.conn.commit()
 
@@ -310,7 +311,8 @@ class TransactionDB(_Base):
                               metadata: bytes = b"") -> None:
         with self._mu:
             self.conn.execute(
-                "INSERT OR REPLACE INTO validation_records VALUES (?,?,?,?)",
+                "INSERT OR REPLACE INTO validation_records (tx_id, token_request, "
+                "metadata, timestamp) VALUES (?,?,?,?)",
                 (tx_id, token_request, metadata, time.time()))
             self.conn.commit()
 
@@ -341,7 +343,8 @@ class AuditDB(TransactionDB):
                         f"eid [{eid}] already locked by [{row[0]}]")
             for eid in eids:
                 self.conn.execute(
-                    "INSERT OR REPLACE INTO eid_locks VALUES (?,?,?)",
+                    "INSERT OR REPLACE INTO eid_locks (eid, tx_id, created_at) "
+                    "VALUES (?,?,?)",
                     (eid, tx_id, time.time()))
             self.conn.commit()
 
@@ -443,7 +446,8 @@ class CertificationDB(_Base):
     def store(self, certifications: dict[ID, bytes]) -> None:
         with self._mu:
             self.conn.executemany(
-                "INSERT OR REPLACE INTO certifications VALUES (?,?,?)",
+                "INSERT OR REPLACE INTO certifications (tx_id, idx, certification) "
+                "VALUES (?,?,?)",
                 [(i.tx_id, i.index, c) for i, c in certifications.items()])
             self.conn.commit()
 
@@ -481,7 +485,8 @@ class IdentityDB(_Base):
                         enrollment_id: str = "") -> None:
         with self._mu:
             self.conn.execute(
-                "INSERT OR REPLACE INTO wallets VALUES (?,?,?,?,?)",
+                "INSERT OR REPLACE INTO wallets (wallet_id, role, identity, "
+                "enrollment_id, created_at) VALUES (?,?,?,?,?)",
                 (wallet_id, role, identity, enrollment_id, time.time()))
             self.conn.commit()
 
@@ -504,7 +509,8 @@ class IdentityDB(_Base):
     def store_audit_info(self, identity: bytes, audit_info: bytes) -> None:
         with self._mu:
             self.conn.execute(
-                "INSERT OR REPLACE INTO audit_infos VALUES (?,?)",
+                "INSERT OR REPLACE INTO audit_infos (identity, audit_info) "
+                "VALUES (?,?)",
                 (identity, audit_info))
             self.conn.commit()
 

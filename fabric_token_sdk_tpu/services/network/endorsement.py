@@ -1,0 +1,223 @@
+"""FSC-style endorsement: re-validate, sign the RW set, check a policy.
+
+Behavioral mirror of reference token/services/network/fabric/endorsement
+(approval.go:40-259) and the fsc_endorsement config (docs/core-token.md
+policy `1outn` | `all`): instead of Fabric peers running the token
+chaincode, designated endorser nodes each re-run the driver Validator
+locally over the current ledger state, translate the verified actions into
+an RW set, and sign a digest of it. The client collects signatures under
+the configured policy into an envelope that CARRIES the endorsed RW set
+(Fabric tx.Envelope()); the ordering backend verifies the policy and the
+digest, then commits the RW set under MVCC — it does not re-execute.
+Deterministic re-execution across endorsers is enforced at collection
+time: a second endorser deriving a different RW set voids the envelope.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ...token.model import ID
+from .rws import MemoryRWSet, Translator
+from .tcc import CommitEvent, LedgerError
+
+
+class EndorsementError(Exception):
+    pass
+
+
+class Policy:
+    ALL = "all"          # every listed endorser must sign
+    ONE_OUT_N = "1outn"  # one valid endorsement suffices
+
+
+def rwset_digest(tx_id: str, reads: dict[str, bytes | None],
+                 writes: dict[str, bytes | None]) -> bytes:
+    """Canonical digest of an RW set: reads (with observed values) and
+    writes/deletes in key order — the byte string every endorser signs.
+    Deterministic re-execution makes this digest identical across honest
+    endorsers."""
+    h = hashlib.sha256()
+    h.update(b"token-rwset/v1\x00")
+    h.update(tx_id.encode() + b"\x00")
+    for tag, entries in ((b"R", reads), (b"W", writes)):
+        for key in sorted(entries):
+            val = entries[key]
+            h.update(tag + key.encode() + b"\x00")
+            h.update(b"\x00" if val is None else b"\x01" + val)
+    return h.digest()
+
+
+@dataclass
+class Envelope:
+    """The endorsed transaction the client broadcasts (tx.Envelope()):
+    the RW set derived by the endorsers plus their signatures."""
+
+    tx_id: str
+    request_raw: bytes
+    reads: dict[str, bytes | None]
+    writes: dict[str, bytes | None]
+    digest: bytes
+    # endorser identity -> signature over the digest
+    signatures: dict[bytes, bytes] = field(default_factory=dict)
+    n_outputs: int = 0
+
+
+class EndorserNode:
+    """RequestApprovalResponderView: one FSC endorser — re-validates the
+    token request against its ledger view and signs the RW-set digest."""
+
+    def __init__(self, name: str, keys, validator, ledger, bus=None):
+        self.name = name
+        self.keys = keys
+        self.validator = validator
+        self.ledger = ledger
+        if bus is not None:
+            bus.register(name, self)
+
+    def identity(self) -> bytes:
+        return bytes(self.keys.identity)
+
+    def endorse(self, tx_id: str, request_raw: bytes) -> Envelope:
+        """Returns a single-signature envelope; raises on invalid requests
+        — an endorser never signs a request it cannot validate."""
+        rws = self.ledger.new_rwset()
+        translator = Translator(tx_id=tx_id, rws=rws)
+
+        def get_state(token_id: ID) -> bytes | None:
+            return rws.get_state(self.ledger.keys.output_key(
+                token_id.tx_id, token_id.index))
+
+        try:
+            actions, _attrs = self.validator.verify_token_request_from_raw(
+                get_state, tx_id, request_raw)
+            translator.add_public_params_dependency()
+            for action in actions:
+                translator.write(action)
+            translator.commit_token_request(request_raw)
+        except Exception as e:
+            raise EndorsementError(
+                f"endorser [{self.name}] rejects tx [{tx_id}]: {e}") from e
+        digest = rwset_digest(tx_id, rws.reads, rws.writes)
+        return Envelope(
+            tx_id=tx_id, request_raw=request_raw, reads=dict(rws.reads),
+            writes=dict(rws.writes), digest=digest,
+            signatures={self.identity(): self.keys.sign(digest)},
+            n_outputs=sum(len(a.get_outputs()) for a in actions))
+
+
+class EndorsementService:
+    """Client side (RequestApprovalView + policy selection) and ordering
+    side (policy verification at commit) of FSC endorsement."""
+
+    def __init__(self, ledger, endorser_names: list[str], bus,
+                 endorser_identities: dict[str, bytes],
+                 policy: str = Policy.ALL):
+        if policy not in (Policy.ALL, Policy.ONE_OUT_N):
+            raise EndorsementError(f"unknown policy [{policy}]")
+        self.ledger = ledger
+        self.endorser_names = list(endorser_names)
+        self.bus = bus
+        self.identities = dict(endorser_identities)
+        self.policy = policy
+
+    # ------------------------------------------------------------- client
+    def request_approval(self, tx_id: str, request_raw: bytes) -> Envelope:
+        """Collect endorsements under the policy. ALL contacts every
+        endorser (parallel-collect in the reference); 1outn walks the list
+        until one endorsement succeeds."""
+        envelope: Envelope | None = None
+        errors: list[str] = []
+        for name in self.endorser_names:
+            try:
+                env = self.bus.node(name).endorse(tx_id, request_raw)
+            except Exception as e:  # endorser refused or unreachable
+                if self.policy == Policy.ALL:
+                    raise EndorsementError(
+                        f"policy [all]: endorser [{name}] failed: {e}") from e
+                errors.append(f"[{name}]: {e}")
+                continue
+            if envelope is None:
+                envelope = env
+            elif env.digest != envelope.digest:
+                # non-deterministic re-execution: never broadcastable
+                raise EndorsementError(
+                    f"endorser [{name}] derived a different RW set for "
+                    f"tx [{tx_id}]")
+            else:
+                envelope.signatures.update(env.signatures)
+            if self.policy == Policy.ONE_OUT_N:
+                return envelope
+        if envelope is None:
+            raise EndorsementError(
+                f"policy [{self.policy}]: no endorser approved tx "
+                f"[{tx_id}]: " + "; ".join(errors))
+        return envelope
+
+    # ----------------------------------------------------------- ordering
+    def verify_policy(self, envelope: Envelope) -> None:
+        """Ordering/commit-side check: the digest matches the carried RW
+        set, signatures verify over it, and the count satisfies the
+        policy threshold."""
+        from ..identity.x509 import X509Verifier
+
+        if rwset_digest(envelope.tx_id, envelope.reads,
+                        envelope.writes) != envelope.digest:
+            raise EndorsementError("envelope digest does not match RW set")
+        valid = 0
+        for ident, sig in envelope.signatures.items():
+            if ident not in self.identities.values():
+                raise EndorsementError("signature from unknown endorser")
+            X509Verifier.from_identity(ident).verify(envelope.digest, sig)
+            valid += 1
+        needed = len(self.endorser_names) if self.policy == Policy.ALL else 1
+        if valid < needed:
+            raise EndorsementError(
+                f"policy [{self.policy}] needs {needed} endorsements, "
+                f"got {valid}")
+
+    def broadcast(self, envelope: Envelope) -> CommitEvent:
+        """Ordering + commit of an endorsed envelope: verify the policy,
+        then apply the CARRIED RW set under MVCC (ledger.commit checks the
+        endorsement-time reads against current state, so a conflicting
+        commit in between invalidates this envelope) — the Fabric
+        committer path, no re-execution."""
+        try:
+            self.verify_policy(envelope)
+        except EndorsementError as e:
+            ev = CommitEvent(envelope.tx_id, "INVALID",
+                             f"endorsement policy: {e}")
+            self.ledger._emit(ev)
+            return ev
+        rws = MemoryRWSet(self.ledger.state)
+        rws.reads = dict(envelope.reads)
+        rws.writes = dict(envelope.writes)
+        return self.ledger.commit(envelope.tx_id, rws,
+                                  n_outputs=envelope.n_outputs)
+
+
+class LedgerQueryService:
+    """Network.QueryTokens / AreTokensSpent over the endorsement plane
+    (network/driver/network.go:38-90) for nodes that are not endorsers."""
+
+    def __init__(self, ledger):
+        self.ledger = ledger
+
+    def query_tokens(self, ids: list[ID]) -> list[bytes]:
+        out, missing = [], []
+        for tid in ids:
+            raw = self.ledger.get_state(
+                self.ledger.keys.output_key(tid.tx_id, tid.index))
+            if raw is None:
+                missing.append(str(tid))
+            else:
+                out.append(raw)
+        if missing:
+            raise LedgerError(f"tokens not found: {missing}")
+        return out
+
+    def are_tokens_spent(self, ids: list[ID]) -> list[bool]:
+        return [self.ledger.get_state(
+                    self.ledger.keys.output_key(t.tx_id, t.index)) is None
+                for t in ids]

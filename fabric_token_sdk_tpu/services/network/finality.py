@@ -1,0 +1,142 @@
+"""Finality listener manager: the delivery-type state machine.
+
+Behavioral mirror of the reference finality manager documented at
+docs/core-token.md:33-77 ("type: delivery") and implemented under
+fabric-smart-client's delivery listener manager: an LRU cache of recently
+finalized transactions plus a list of listeners waiting for future ones.
+A finality query escalates through four steps of decreasing probability:
+
+  a) recently final        -> LRU cache lookup
+  b) final shortly         -> wait on a registered listener with a timeout
+  c) final long ago        -> query the ledger for the transaction
+  d) beyond timeout/never  -> return UNKNOWN (caller may retry or give up)
+
+Eviction: the cache holds lruSize entries once it grows past
+lruSize + lruBuffer (docs/core-token.md lruSize/lruBuffer semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from .tcc import CommitEvent
+
+
+class FinalityStatus:
+    VALID = "VALID"
+    INVALID = "INVALID"
+    UNKNOWN = "UNKNOWN"
+
+
+@dataclass
+class _Waiter:
+    event: threading.Event
+    result: CommitEvent | None = None
+
+
+class FinalityManager:
+    """Delivery-plane finality manager bound to one ledger."""
+
+    def __init__(self, ledger, lru_size: int = 30, lru_buffer: int = 15,
+                 listener_timeout: float = 10.0):
+        self.ledger = ledger
+        self.lru_size = lru_size
+        self.lru_buffer = lru_buffer
+        self.listener_timeout = listener_timeout
+        self._mu = threading.Lock()
+        self._cache: "OrderedDict[str, CommitEvent]" = OrderedDict()
+        self._waiters: dict[str, list[_Waiter]] = {}
+        self._listeners: dict[str, list] = {}
+        ledger.add_finality_listener(self._on_event)
+
+    # ------------------------------------------------------------- delivery
+    def _on_event(self, ev: CommitEvent) -> None:
+        """One transaction from the delivery stream: cache it, wake waiters,
+        fire one-shot listeners."""
+        with self._mu:
+            self._cache[ev.tx_id] = ev
+            self._cache.move_to_end(ev.tx_id)
+            if len(self._cache) > self.lru_size + self.lru_buffer:
+                while len(self._cache) > self.lru_size:
+                    self._cache.popitem(last=False)
+            waiters = self._waiters.pop(ev.tx_id, [])
+            listeners = self._listeners.pop(ev.tx_id, [])
+        for w in waiters:
+            w.result = ev
+            w.event.set()
+        for cb in listeners:
+            cb(ev)
+
+    # -------------------------------------------------------------- queries
+    def add_finality_listener(self, tx_id: str, callback) -> None:
+        """Invoke callback(ev) when tx_id reaches finality. If it already
+        did (cache or ledger), the callback fires immediately — the
+        committer-type polling guarantee collapsed to a lookup."""
+        with self._mu:
+            ev = self._cache.get(tx_id)
+            if ev is None:
+                # register BEFORE the (slow) ledger query, under the same
+                # lock the delivery path takes: a commit landing after the
+                # cache miss will find and fire this callback
+                self._listeners.setdefault(tx_id, []).append(callback)
+        if ev is not None:
+            callback(ev)
+            return
+        ev = self._ledger_query(tx_id)
+        if ev is not None:
+            with self._mu:
+                cbs = self._listeners.get(tx_id, [])
+                if callback in cbs:
+                    cbs.remove(callback)
+                else:
+                    return  # delivery already fired it
+            callback(ev)
+
+    def remove_finality_listener(self, tx_id: str, callback) -> None:
+        with self._mu:
+            cbs = self._listeners.get(tx_id, [])
+            if callback in cbs:
+                cbs.remove(callback)
+
+    def is_final(self, tx_id: str, timeout: float | None = None) -> str:
+        """The a->b->c->d escalation. Returns a FinalityStatus constant."""
+        # a) recently final: cache
+        with self._mu:
+            ev = self._cache.get(tx_id)
+            if ev is not None:
+                return ev.status
+            # b) register a waiter under the lock so the delivery path
+            # cannot slip the event between lookup and registration
+            waiter = _Waiter(threading.Event())
+            self._waiters.setdefault(tx_id, []).append(waiter)
+        if waiter.event.wait(self.listener_timeout if timeout is None
+                             else timeout):
+            return waiter.result.status
+        with self._mu:
+            ws = self._waiters.get(tx_id, [])
+            if waiter in ws:
+                ws.remove(waiter)
+        # c) final long ago: query the ledger
+        ev = self._ledger_query(tx_id)
+        if ev is not None:
+            return ev.status
+        # d) unknown: beyond the timeout or never
+        return FinalityStatus.UNKNOWN
+
+    def _lookup(self, tx_id: str) -> CommitEvent | None:
+        with self._mu:
+            ev = self._cache.get(tx_id)
+        if ev is not None:
+            return ev
+        return self._ledger_query(tx_id)
+
+    def _ledger_query(self, tx_id: str) -> CommitEvent | None:
+        """Step c: a committed token transaction leaves its request hash at
+        the token-request key; presence on the ledger IS validity (invalid
+        transactions write nothing)."""
+        raw = self.ledger.get_state(self.ledger.keys.token_request_key(tx_id))
+        if raw is not None:
+            return CommitEvent(tx_id, FinalityStatus.VALID)
+        return None

@@ -1,0 +1,72 @@
+"""Fake DB-API-2 postgres driver for exercising the pgdb dialect layer.
+
+Plays the role testcontainers-postgres plays in the reference's db tests
+when no server is available: accepts the POSTGRES dialect pgdb emits
+(%s placeholders, ON CONFLICT upserts, BYTEA/BIGSERIAL DDL) and executes
+it on sqlite, whose ON CONFLICT (pk) DO UPDATE SET ... EXCLUDED semantics
+match postgres. What this validates: pgdb's query/DDL translation produces
+well-formed postgres SQL with correct upsert column handling — not
+postgres server behavior itself (the real-driver path is the same code
+with psycopg2 injected).
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+
+IntegrityError = sqlite3.IntegrityError
+
+
+def _to_sqlite_ddl(stmt: str) -> str:
+    s = stmt.replace("BIGSERIAL PRIMARY KEY",
+                     "INTEGER PRIMARY KEY AUTOINCREMENT")
+    s = s.replace("''::bytea", "x''")
+    s = s.replace("BYTEA", "BLOB")
+    s = s.replace("DOUBLE PRECISION", "REAL")
+    s = re.sub(r"\bBIGINT\b", "INTEGER", s)
+    return s
+
+
+class _Cursor:
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+        self._cur = None
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount if self._cur is not None else -1
+
+    def execute(self, sql: str, params=()):
+        self._cur = self._conn.execute(_to_sqlite_ddl(sql.replace("%s", "?")),
+                                       params)
+
+    def executemany(self, sql: str, seq):
+        self._cur = self._conn.executemany(sql.replace("%s", "?"), seq)
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+
+class _Connection:
+    def __init__(self):
+        self._conn = sqlite3.connect(":memory:", check_same_thread=False)
+
+    def cursor(self):
+        return _Cursor(self._conn)
+
+    def commit(self):
+        self._conn.commit()
+
+    def rollback(self):
+        self._conn.rollback()
+
+    def close(self):
+        self._conn.close()
+
+
+def connect(dsn: str) -> _Connection:
+    return _Connection()

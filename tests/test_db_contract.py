@@ -1,16 +1,54 @@
 """Shared DB contract suite run against EVERY backend (reference
-token/services/db/dbtest: same suite, many drivers)."""
+token/services/db/dbtest: same suite, many drivers).
 
+Backends: sqlite, memory, and the postgres dialect (pgdb). Without a
+postgres server/driver in the environment, pgdb runs over the fake DB-API
+driver (tests/fakepg.py) that validates the emitted postgres SQL on
+sqlite's matching ON CONFLICT machinery; set PG_DSN (with psycopg2
+installed) to run the same suite against a live server — the reference's
+testcontainers pattern."""
+
+import functools
+import os
 import threading
 import time
+import types
 
 import pytest
 
-from fabric_token_sdk_tpu.services.db import memdb, sqldb
+import fakepg
+from fabric_token_sdk_tpu.services.db import memdb, pgdb, sqldb
 from fabric_token_sdk_tpu.services.db.sqldb import DBError, TxRecord, TxStatus
 from fabric_token_sdk_tpu.token.model import ID
 
-BACKENDS = {"sqlite": sqldb, "memory": memdb}
+_STORES = ("TokenDB", "TransactionDB", "AuditDB", "TokenLockDB",
+           "IdentityDB", "CertificationDB")
+
+
+def _pg_store(store_cls, dsn, driver_module, _path=None):
+    # the contract suite passes a sqlite-style path; the pg dialect keys
+    # off its DSN instead
+    return store_cls(dsn, driver_module=driver_module)
+
+
+def _pg_backend(driver_module, dsn: str):
+    ns = types.SimpleNamespace()
+    for store in _STORES:
+        setattr(ns, store,
+                functools.partial(_pg_store, getattr(pgdb, store), dsn,
+                                  driver_module))
+    return ns
+
+
+BACKENDS = {
+    "sqlite": sqldb,
+    "memory": memdb,
+    "postgres-dialect": _pg_backend(fakepg, ":fake:"),
+}
+if pgdb.available() and os.environ.get("PG_DSN"):
+    import psycopg2
+
+    BACKENDS["postgres"] = _pg_backend(psycopg2, os.environ["PG_DSN"])
 
 
 @pytest.fixture(params=sorted(BACKENDS))

@@ -1,0 +1,218 @@
+"""Finality manager escalation + FSC endorsement policy.
+
+Mirrors reference docs/core-token.md:33-77 (delivery finality manager:
+LRU cache -> listener wait -> ledger re-query -> Unknown) and
+network/fabric/endorsement/approval.go + fsc_endorsement policy
+(`all` | `1outn`), including MVCC rejection of stale envelopes.
+"""
+
+import threading
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.endorsement import (
+    EndorsementError,
+    EndorsementService,
+    EndorserNode,
+    LedgerQueryService,
+    Policy,
+)
+from fabric_token_sdk_tpu.services.network.finality import (
+    FinalityManager,
+    FinalityStatus,
+)
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, \
+    TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+from fabric_token_sdk_tpu.token.model import ID
+
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    validator = fabtoken.new_validator(pp, Deserializer())
+    ledger = MemoryLedger()
+    cc = TokenChaincode(validator, ledger, pp.serialize())
+    bus = SessionBus()
+    issuer = TokenNode("issuer", issuer_keys, bus, cc)
+    alice = TokenNode("alice", new_signing_identity(), bus, cc)
+    return pp, validator, ledger, cc, bus, issuer, alice
+
+
+def _issue_tx(alice):
+    return alice.issue("issuer", "alice", "USD", hex(100))
+
+
+# --------------------------------------------------------------- finality
+def test_finality_cache_hit(net):
+    _, _, ledger, cc, _, _, alice = net
+    fm = FinalityManager(ledger)
+    ev = alice.execute(_issue_tx(alice))
+    assert ev.status == "VALID"
+    # step a: straight from the LRU cache, no wait
+    assert fm.is_final(ev.tx_id, timeout=0.0) == FinalityStatus.VALID
+
+
+def test_finality_waits_for_future_commit(net):
+    _, _, ledger, cc, _, _, alice = net
+    fm = FinalityManager(ledger, listener_timeout=5.0)
+    tx = _issue_tx(alice)
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(fm.is_final(tx.tx_id)))
+    t.start()
+    alice.execute(tx)  # commit while the waiter is parked (step b)
+    t.join(timeout=5)
+    assert results == [FinalityStatus.VALID]
+
+
+def test_finality_ledger_requery_after_eviction(net):
+    _, _, ledger, cc, _, _, alice = net
+    # tiny cache: the first tx is evicted by the ones after it
+    fm = FinalityManager(ledger, lru_size=1, lru_buffer=0,
+                         listener_timeout=0.0)
+    first = alice.execute(_issue_tx(alice))
+    for _ in range(3):
+        alice.execute(_issue_tx(alice))
+    assert first.tx_id not in fm._cache
+    # step c: found by ledger re-query
+    assert fm.is_final(first.tx_id, timeout=0.0) == FinalityStatus.VALID
+
+
+def test_finality_unknown(net):
+    _, _, ledger, *_ = net
+    fm = FinalityManager(ledger, listener_timeout=0.0)
+    assert fm.is_final("never-committed", timeout=0.0) == \
+        FinalityStatus.UNKNOWN
+
+
+def test_finality_listener_fires_immediately_for_past_tx(net):
+    _, _, ledger, cc, _, _, alice = net
+    fm = FinalityManager(ledger)
+    ev = alice.execute(_issue_tx(alice))
+    got = []
+    fm.add_finality_listener(ev.tx_id, got.append)
+    assert [e.tx_id for e in got] == [ev.tx_id]
+
+
+def test_finality_listener_for_evicted_tx_fires_via_ledger_query(net):
+    _, _, ledger, cc, _, _, alice = net
+    fm = FinalityManager(ledger, lru_size=1, lru_buffer=0)
+    first = alice.execute(_issue_tx(alice))
+    for _ in range(3):
+        alice.execute(_issue_tx(alice))
+    assert first.tx_id not in fm._cache
+    got = []
+    fm.add_finality_listener(first.tx_id, got.append)
+    assert [e.tx_id for e in got] == [first.tx_id]
+    # and the one-shot registration did not leak
+    assert not fm._listeners.get(first.tx_id)
+
+
+def test_invalid_tx_status_in_cache(net):
+    _, _, ledger, cc, _, _, alice = net
+    fm = FinalityManager(ledger)
+    ev = cc.process_request("bad-tx", b"\x00garbage")
+    assert ev.status == "INVALID"
+    assert fm.is_final("bad-tx", timeout=0.0) == FinalityStatus.INVALID
+
+
+# ------------------------------------------------------------ endorsement
+def _endorsement_net(net, policy, n_endorsers=2):
+    pp, validator, ledger, cc, bus, issuer, alice = net
+    names, idents = [], {}
+    for i in range(n_endorsers):
+        keys = new_signing_identity()
+        name = f"endorser{i}"
+        EndorserNode(name, keys, validator, ledger, bus)
+        names.append(name)
+        idents[name] = bytes(keys.identity)
+    svc = EndorsementService(ledger, names, bus, idents, policy=policy)
+    return svc, alice
+
+
+@pytest.mark.parametrize("policy", [Policy.ALL, Policy.ONE_OUT_N])
+def test_endorsed_issue_commits(net, policy):
+    svc, alice = _endorsement_net(net, policy)
+    tx = _issue_tx(alice)
+    # sign + audit via the normal choreography, then endorse + broadcast
+    from fabric_token_sdk_tpu.services.ttx import collect_endorsements
+
+    collect_endorsements(tx, alice.bus, None)
+    env = svc.request_approval(tx.tx_id, tx.request.to_bytes())
+    expected = len(svc.endorser_names) if policy == Policy.ALL else 1
+    assert len(env.signatures) == expected
+    ev = svc.broadcast(env)
+    assert ev.status == "VALID", ev.message
+    assert alice.balance("USD") == 100
+
+
+def test_endorser_rejects_invalid_request(net):
+    svc, alice = _endorsement_net(net, Policy.ALL)
+    with pytest.raises(EndorsementError):
+        svc.request_approval("tx-bad", b"\x00garbage")
+
+
+def test_stale_envelope_rejected_by_mvcc(net):
+    svc, alice = _endorsement_net(net, Policy.ALL)
+    from fabric_token_sdk_tpu.services.ttx import collect_endorsements
+
+    # two transfers endorsed against the same state: issue, then race
+    ev = alice.execute(_issue_tx(alice))
+    assert ev.status == "VALID"
+    tx1 = alice.transfer("USD", hex(40), "issuer")
+    collect_endorsements(tx1, alice.bus, None)
+    env1 = svc.request_approval(tx1.tx_id, tx1.request.to_bytes())
+    alice.selector.unselect(tx1.tx_id)  # release locks to allow the race
+    tx2 = alice.transfer("USD", hex(40), "issuer")
+    collect_endorsements(tx2, alice.bus, None)
+    env2 = svc.request_approval(tx2.tx_id, tx2.request.to_bytes())
+
+    assert svc.broadcast(env1).status == "VALID"
+    ev2 = svc.broadcast(env2)  # same input now spent: stale endorsement
+    assert ev2.status == "INVALID"
+    assert "MVCC" in ev2.message
+
+
+def test_tampered_envelope_rejected(net):
+    svc, alice = _endorsement_net(net, Policy.ALL)
+    from fabric_token_sdk_tpu.services.ttx import collect_endorsements
+
+    tx = _issue_tx(alice)
+    collect_endorsements(tx, alice.bus, None)
+    env = svc.request_approval(tx.tx_id, tx.request.to_bytes())
+    victim = next(k for k, v in env.writes.items() if v)
+    env.writes[victim] = b"tampered"
+    ev = svc.broadcast(env)
+    assert ev.status == "INVALID" and "digest" in ev.message
+
+
+def test_policy_1outn_survives_endorser_failure(net):
+    svc, alice = _endorsement_net(net, Policy.ONE_OUT_N)
+    from fabric_token_sdk_tpu.services.ttx import collect_endorsements
+
+    # first endorser goes down: 1outn falls through to the second
+    class Down:
+        def endorse(self, *a):
+            raise RuntimeError("unreachable")
+
+    svc.bus.register("endorser0", Down())
+    tx = _issue_tx(alice)
+    collect_endorsements(tx, alice.bus, None)
+    env = svc.request_approval(tx.tx_id, tx.request.to_bytes())
+    assert svc.broadcast(env).status == "VALID"
+
+
+def test_query_service(net):
+    svc, alice = _endorsement_net(net, Policy.ALL)
+    ev = alice.execute(_issue_tx(alice))
+    qs = LedgerQueryService(alice.cc.ledger)
+    tok = alice.tokendb.unspent_tokens("alice")[0]
+    assert qs.query_tokens([tok.id])
+    assert qs.are_tokens_spent([tok.id, ID("missing", 0)]) == [False, True]
