@@ -76,6 +76,47 @@ class TokenNode:
         self._pending_openings: "OrderedDict[str, dict[int, bytes]]" = \
             OrderedDict()
         self._pending_openings_cap = 10_000
+        # ManagementService facades, one per TMSID (management_service)
+        self._tms: dict = {}
+
+    def management_service(self, tmsid=None):
+        """The token.ManagementService view of this node (tms.go:32):
+        the TMS facade over this node's driver, with the node-scoped
+        vault/wallets/selector/signing bound (sdk/dig wiring). One cached
+        instance per TMSID, like TMSProvider (core/tms.go:63), so bind()
+        customisations persist across calls."""
+        from ..core.registry import TMSID, DriverBundle, RegistryError
+        from ..token.tms import TokenManagementService, Vault
+
+        tmsid = tmsid or TMSID("default")
+        cached = self._tms.get(tmsid)
+        if cached is not None:
+            return cached
+        pp = getattr(self.driver, "pp", None)
+        if pp is None:
+            # plaintext driver holds no pp object: rebuild from the ledger's
+            # setup key (the fetcher leg of pp resolution, tms.go:207-274)
+            from ..core.fabtoken.setup import PublicParams
+
+            pp_raw = self.cc.query_public_params()
+            if pp_raw is None:
+                raise RegistryError(
+                    f"cannot resolve public parameters for TMS [{tmsid}]: "
+                    "no setup state on the ledger")
+            pp = PublicParams.deserialize(pp_raw)
+        bundle = DriverBundle(
+            label=getattr(self.driver, "label", "fabtoken"),
+            public_params=pp,
+            services=self.driver,
+            validator=self.cc.validator,
+            deserializer=getattr(self.cc.validator, "deserializer", None))
+        tms = TokenManagementService(tmsid, bundle).bind(
+            vault=Vault(self.tokendb, self.ttxdb),
+            wallet_manager=self.wallets,
+            selector_manager=self.selector,
+            sig_service=self.keys)
+        self._tms[tmsid] = tms
+        return tms
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:

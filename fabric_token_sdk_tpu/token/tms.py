@@ -12,6 +12,46 @@ from __future__ import annotations
 from .request_builder import Request
 
 
+class Vault:
+    """sdk/vault/vault.go:20-90: the {tokendb, ttxdb, auditdb} triple plus
+    the certification storage, exposed through a QueryEngine."""
+
+    def __init__(self, tokendb, ttxdb=None, auditdb=None,
+                 certification_db=None):
+        self.tokendb = tokendb
+        self.ttxdb = ttxdb
+        self.auditdb = auditdb
+        self.certification_db = certification_db
+
+    # ---- QueryEngine (driver/vault.go surface)
+    def unspent_tokens_iterator(self, wallet_id=None, token_type=None):
+        return iter(self.tokendb.unspent_tokens(wallet_id, token_type))
+
+    def unspent_tokens(self, wallet_id=None, token_type=None):
+        return self.tokendb.unspent_tokens(wallet_id, token_type)
+
+    def balance(self, wallet_id, token_type) -> int:
+        return self.tokendb.balance(wallet_id, token_type)
+
+    def is_mine(self, token_id, wallet_id) -> bool:
+        return self.tokendb.is_mine(token_id, wallet_id)
+
+    def get_status(self, tx_id) -> str:
+        if self.ttxdb is None:
+            raise LookupError("vault has no transaction store")
+        return self.ttxdb.get_status(tx_id)
+
+    # ---- CertificationStorage (sdk/vault CertificationStorage)
+    def certification_exists(self, token_id) -> bool:
+        return (self.certification_db is not None
+                and self.certification_db.exists(token_id))
+
+    def store_certifications(self, certifications) -> None:
+        if self.certification_db is None:
+            raise LookupError("vault has no certification store")
+        self.certification_db.store(certifications)
+
+
 class PublicParametersManager:
     """token/ppm.go facade over the driver's pp (serialize / validate /
     precision / auditors / issuers surface)."""
@@ -43,12 +83,36 @@ class PublicParametersManager:
 
 
 class TokenManagementService:
-    """token.ManagementService (tms.go:32): facade over one driver bundle."""
+    """token.ManagementService (tms.go:32): facade over one driver bundle.
+
+    The node-scoped components (vault, wallet manager, selector, signing
+    identity) attach via ``bind`` — the reference wires the same pieces
+    into the TMS through dig providers at node bootstrap (sdk/dig)."""
 
     def __init__(self, tmsid, bundle):
         self.tmsid = tmsid
         self._bundle = bundle
         self._ppm = PublicParametersManager(bundle.public_params)
+        self._vault = None
+        self._wallet_manager = None
+        self._selector_manager = None
+        self._sig_service = None
+
+    # -------------------------------------------------------------- binding
+    def bind(self, vault=None, wallet_manager=None, selector_manager=None,
+             sig_service=None) -> "TokenManagementService":
+        self._vault = vault or self._vault
+        self._wallet_manager = wallet_manager or self._wallet_manager
+        self._selector_manager = selector_manager or self._selector_manager
+        self._sig_service = sig_service or self._sig_service
+        return self
+
+    def _bound(self, obj, what: str):
+        if obj is None:
+            raise LookupError(
+                f"TMS [{self.tmsid}] has no {what} bound (node-scoped "
+                "component; attach with .bind())")
+        return obj
 
     # ------------------------------------------------------------ accessors
     def public_parameters_manager(self) -> PublicParametersManager:
@@ -65,6 +129,22 @@ class TokenManagementService:
     def driver_services(self):
         return self._bundle.services
 
+    def vault(self) -> Vault:
+        """tms.go Vault(): the node's token/tx/audit stores."""
+        return self._bound(self._vault, "vault")
+
+    def wallet_manager(self):
+        """tms.go WalletManager(): the role-based wallet registry."""
+        return self._bound(self._wallet_manager, "wallet manager")
+
+    def selector_manager(self):
+        """tms.go SelectorManager(): the token selector."""
+        return self._bound(self._selector_manager, "selector manager")
+
+    def sig_service(self):
+        """tms.go SigService(): the node's signing identity."""
+        return self._bound(self._sig_service, "sig service")
+
     @property
     def label(self) -> str:
         return self._bundle.label
@@ -74,3 +154,40 @@ class TokenManagementService:
         """token.NewRequest (tms.go/request.go:165): an empty request bound
         to this TMS and anchor."""
         return Request(anchor, self._bundle.services)
+
+    def new_full_request_from_bytes(self, raw: bytes) -> "FullRequest":
+        """tms.go NewFullRequestFromBytes: unmarshal a wire TokenRequest
+        AND its driver actions through this TMS's validator — the bound
+        shape finality listeners re-derive tokens from."""
+        from ..driver.request import TokenRequest
+
+        wire = TokenRequest.from_bytes(raw)
+        actions = self._bundle.validator.unmarshal_actions(raw)
+        return FullRequest(wire=wire, actions=actions)
+
+
+class FullRequest:
+    """A received (fully assembled) request: the wire TokenRequest plus its
+    deserialized driver actions (token/request.go NewFullRequestFromBytes
+    result surface used by ingestion)."""
+
+    def __init__(self, wire, actions):
+        self.wire = wire
+        self.actions = actions
+
+    def token_request(self):
+        return self.wire
+
+    def to_bytes(self) -> bytes:
+        return self.wire.to_bytes()
+
+    def message_to_sign(self, anchor: bytes) -> bytes:
+        return self.wire.message_to_sign(anchor)
+
+    def outputs(self):
+        """All output slots across actions, ingestion order (issues then
+        transfers — the global output numbering)."""
+        out = []
+        for action in self.actions:
+            out.extend(action.get_outputs())
+        return out

@@ -1,0 +1,93 @@
+"""ManagementService facade completeness: vault, wallet manager, selector,
+sig service, pp manager, request factory (reference token/tms.go:32-185,
+sdk/vault/vault.go)."""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.core.registry import TMSID, TMSProvider, \
+    default_registry
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import MemoryLedger, \
+    TokenChaincode
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus
+from fabric_token_sdk_tpu.token.tms import TokenManagementService, Vault
+
+
+@pytest.fixture
+def node():
+    keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [keys.identity]
+    cc = TokenChaincode(fabtoken.new_validator(pp, Deserializer()),
+                        MemoryLedger(), pp.serialize())
+    bus = SessionBus()
+    issuer = TokenNode("issuer", keys, bus, cc)
+    alice = TokenNode("alice", new_signing_identity(), bus, cc)
+    ev = alice.execute(alice.issue("issuer", "alice", "USD", hex(100)))
+    assert ev.status == "VALID"
+    return alice
+
+
+def test_node_bound_tms_surface(node):
+    tms = node.management_service()
+    assert tms.label == "fabtoken"
+    # vault QueryEngine reflects the node's token store
+    vault = tms.vault()
+    assert vault.balance("alice", "USD") == 100
+    toks = vault.unspent_tokens("alice")
+    assert list(vault.unspent_tokens_iterator("alice")) == toks
+    assert vault.is_mine(toks[0].id, "alice")
+    assert vault.get_status("missing") == "Unknown"
+    # wallet manager is the node's registry; selector is the node's
+    assert tms.wallet_manager() is node.wallets
+    assert tms.selector_manager() is node.selector
+    assert tms.sig_service() is node.keys
+    # pp manager reads the ledger-derived public parameters
+    assert tms.public_parameters_manager().precision() == 64
+    assert tms.public_parameters_manager().issuers()
+
+
+def test_tms_request_roundtrip(node):
+    tms = node.management_service()
+    # a real committed request re-derives its wire bytes AND actions
+    raw = node.ttxdb.get_token_request(
+        node.tokendb.unspent_tokens("alice")[0].id.tx_id)
+    restored = tms.new_full_request_from_bytes(raw)
+    assert restored.to_bytes() == raw
+    outs = restored.outputs()
+    assert len(outs) == 1  # the single issue output
+    # caching: one facade per TMSID, so bind() state persists
+    assert node.management_service() is node.management_service()
+
+
+def test_unbound_components_raise():
+    reg = default_registry()
+    provider = TMSProvider(reg)
+    pp = fabtoken.setup(64)
+    tmsid = TMSID("n1", "c1", "ns1")
+    provider.store_public_params(tmsid, pp.serialize())
+    tms = provider.get_management_service(tmsid)
+    assert isinstance(tms, TokenManagementService)
+    with pytest.raises(LookupError):
+        tms.vault()
+    with pytest.raises(LookupError):
+        tms.wallet_manager()
+    # binding attaches node-scoped parts
+    from fabric_token_sdk_tpu.services.db.sqldb import TokenDB
+
+    tms.bind(vault=Vault(TokenDB(":memory:")))
+    assert tms.vault().balance("w", "USD") == 0
+
+
+def test_vault_certification_storage():
+    from fabric_token_sdk_tpu.services.db.sqldb import CertificationDB, \
+        TokenDB
+    from fabric_token_sdk_tpu.token.model import ID
+
+    v = Vault(TokenDB(":memory:"), certification_db=CertificationDB())
+    assert not v.certification_exists(ID("t", 0))
+    v.store_certifications({ID("t", 0): b"c"})
+    assert v.certification_exists(ID("t", 0))
