@@ -44,7 +44,7 @@ class BatchAuditReopen:
         if len(gens) != 3:
             raise ValueError("length of Pedersen basis != 3")
         gen_dev = jnp.asarray(limbs.points_to_projective_limbs(gens))
-        self.tables = jax.jit(ec.fixed_base_tables)(gen_dev)
+        self.tables = jax.jit(ec.fixed_base_planes)(gen_dev)
 
     def verify(self, openings: list[tuple]) -> np.ndarray:
         """openings: list of (data G1, token_type str, value, bf).

@@ -48,12 +48,16 @@ from ..crypto import bn254, rp
 from ..crypto import serialization as ser
 from ..crypto.bn254 import (fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub,
                             hash_to_zr)
+from ..native import load_frmont
 from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows
 from .batching import next_pow2 as _next_pow2
 from .batching import pad_rows as _pad_rows
 
 R = bn254.R
+
+# Native host-phase accelerator (C Montgomery Fr); None -> pure Python.
+_FRNATIVE = load_frmont()
 
 
 # --------------------------------------------------------------------------
@@ -95,7 +99,7 @@ def affine_batch_to_bytes(arr: np.ndarray) -> np.ndarray:
 # Kernels are jitted separately: fusing them into one graph makes XLA:CPU
 # compile superlinearly; split, each compiles in seconds and the persistent
 # cache reuses them across runs.
-_tables_kernel = jax.jit(ec.fixed_base_tables)
+_tables_kernel = jax.jit(ec.fixed_base_planes)
 _affine_rows_kernel = jax.jit(ec.to_affine_batch)
 _affine_kernel = jax.jit(ec.to_affine)
 
@@ -142,8 +146,8 @@ def _exact_pass_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
 class RangeVerifierParams:
     """Device-resident public parameters for one (pp, bit_length) config.
 
-    Fixed-base table layout (one 8-bit windowed table per generator,
-    ec.fixed_base_tables): index order is
+    Fixed-base table layout (one 8-bit windowed byte-plane table per
+    generator, ec.fixed_base_planes): index order is
         [G_0..G_{n-1}, H_0..H_{n-1}, P, Q, cg0, cg1, S_G]
     where S_G = sum_i G_i (K's G-coefficients are all -z, so the whole G
     block collapses to one term in the K equation).
@@ -156,7 +160,7 @@ class RangeVerifierParams:
     P: object
     Q: object
     commitment_gen: list    # [cg0, cg1] (pedersen_generators[1:3])
-    tables: jnp.ndarray     # (2n+5, 32, 256, 3, 16) all generators
+    tables: jnp.ndarray     # (2n+5, 32, 256, 96) bf16 planes, all gens
     k_idx: jnp.ndarray      # (n+2,) indexes of H_i ++ [P, S_G] into tables
     rgp_idx: jnp.ndarray    # (n,) indexes of H_i into tables
     # precomputed transcript prefix: bytes of right_gen' are per-proof, but
@@ -287,6 +291,12 @@ class _ProofTranscript:
     pol_eval: int
     k_fixed_scalars: list[int]
     k_var_scalars: list[int]
+    # native path: the same scalars as packed 32-byte-LE blobs (set when
+    # the _frmont extension produced them; consumers then skip the
+    # int->limb conversions entirely)
+    yinv_packed: bytes | None = None
+    pol_eval_packed: bytes | None = None
+    k_fixed_packed: bytes | None = None
 
 
 def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
@@ -295,6 +305,21 @@ def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
     d = proof.data
     x = rp.challenge_x(d.T1, d.T2)
     y, z = rp.challenges_y_z(d.C, d.D, commitment)
+
+    if _FRNATIVE is not None:
+        # fused native assembly (frmont.c phase_a, parity-pinned)
+        raw = _FRNATIVE.phase_a(
+            n, y.to_bytes(32, "little") + z.to_bytes(32, "little")
+            + (d.delta % R).to_bytes(32, "little"))
+        s = 32
+        return _ProofTranscript(
+            x=x, y=y, z=z,
+            y_pows=[], yinv_pows=[], pol_eval=0, k_fixed_scalars=[],
+            k_var_scalars=[x, 1],
+            yinv_packed=raw[n * s:2 * n * s],
+            pol_eval_packed=raw[2 * n * s:(2 * n + 1) * s],
+            k_fixed_packed=raw[(2 * n + 1) * s:])
+
     z_sq = fr_mul(z, z)
     y_inv = fr_inv(y)
 
@@ -335,10 +360,15 @@ class _ProofEquations:
     fixed order (matches RangeVerifierParams.tables):
         G_0..G_{n-1}, H_0..H_{n-1}, P, Q, cg0, cg1, S_G(unused->0)
     var order: D, C, L_0..L_{r-1}, R_0..R_{r-1}, T1, T2, Com
+
+    Native path: the same vectors as packed 32-byte-LE blobs instead of
+    int lists (exactly one of the representations is populated).
     """
 
     fixed: list[int]
     var: list[int]
+    fixed_packed: bytes | None = None
+    var_packed: bytes | None = None
 
 
 def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
@@ -362,6 +392,22 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     x_ipa = hash_to_zr(raw)
 
     round_ch = [rp.ipa_round_challenge(L, Rp) for L, Rp in zip(ipa.L, ipa.R)]
+
+    if _FRNATIVE is not None:
+        # fused native assembly (frmont.c phase_b, parity-pinned); round
+        # inversions ride the same extension
+        ch_packed = limbs.pack_scalars(round_ch)
+        inv_packed = _FRNATIVE.batch_inv(ch_packed)
+        scalars = limbs.pack_scalars(
+            [ipa.left, ipa.right, ts.z, x, x_ipa, d.inner_product, d.tau,
+             d.delta]) + ts.pol_eval_packed
+        out = _FRNATIVE.phase_b(n, len(round_ch), scalars, ts.yinv_packed,
+                                ch_packed, inv_packed)
+        split = (2 * n + 5) * 32
+        return _ProofEquations(fixed=[], var=[],
+                               fixed_packed=out[:split],
+                               var_packed=out[split:])
+
     # one batched inversion for (y, every round challenge)
     round_inv = fr_batch_inv(round_ch)
     pairs = list(zip(round_ch, round_inv))
@@ -434,12 +480,21 @@ class BatchRangeVerifier:
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
         id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
 
-        yinv_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
+        if _FRNATIVE is not None:
+            yinv_np = limbs.packed_to_limbs(
+                b"".join(transcripts[i].yinv_packed for i in live)
+            ).reshape(len(live), n, limbs.NLIMBS)
+            k_fixed_np = limbs.packed_to_limbs(
+                b"".join(transcripts[i].k_fixed_packed for i in live)
+            ).reshape(len(live), n + 2, limbs.NLIMBS)
+        else:
+            yinv_np = np.stack(
+                [limbs.scalars_to_limbs(transcripts[i].yinv_pows)
+                 for i in live])
+            k_fixed_np = np.stack(
+                [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
+                 for i in live])
         yinv = jnp.asarray(_pad_rows(yinv_np, b_bucket, zero_sc))
-        k_fixed_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
-             for i in live])
         k_fixed = jnp.asarray(_pad_rows(k_fixed_np, b_bucket, zero_sc))
         dc_pts_np = np.stack(
             [limbs.points_to_projective_limbs(
@@ -493,26 +548,56 @@ class BatchRangeVerifier:
         n = params.bit_length
         rr = params.rounds
         n_fixed = 2 * n + 5
+        n_eq2 = 2 + 2 * rr
 
-        fixed_acc = [0] * n_fixed
         var_pts: list = []
-        var_sc: list[int] = []
         for i in live:
-            w1 = 1 + secrets.randbelow(R - 1)
-            w2 = 1 + secrets.randbelow(R - 1)
-            eq = equations[i]
-            # fixed layout: G(n), H(n) @ w2 | P, Q @ w2 | cg0, cg1 @ w1
-            for j in range(2 * n + 2):
-                fixed_acc[j] = fr_add(fixed_acc[j], fr_mul(w2, eq.fixed[j]))
-            for j in (2 * n + 2, 2 * n + 3):
-                fixed_acc[j] = fr_add(fixed_acc[j], fr_mul(w1, eq.fixed[j]))
             d = proofs[i].data
-            pts = [d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R \
-                + [d.T1, d.T2, commitments[i]]
-            n_eq2 = 2 + 2 * rr
-            weights = [w2] * n_eq2 + [w1] * 3
-            var_pts.extend(pts)
-            var_sc.extend(fr_mul(w, s) for w, s in zip(weights, eq.var))
+            var_pts.extend([d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R
+                           + [d.T1, d.T2, commitments[i]])
+
+        if _FRNATIVE is not None:
+            fixed_acc_packed = bytes(32 * n_fixed)
+            var_sc_packed: list[bytes] = []
+            zero32 = bytes(32)
+            for i in live:
+                w1 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
+                w2 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
+                eq = equations[i]
+                # fixed layout: G(n), H(n), P, Q @ w2 | cg0, cg1 @ w1 | S_G
+                weights = w2 * (2 * n + 2) + w1 * 2 + zero32
+                fixed_acc_packed = _FRNATIVE.addmul_many(
+                    fixed_acc_packed, eq.fixed_packed, weights)
+                var_sc_packed.append(_FRNATIVE.mul_many(
+                    eq.var_packed, w2 * n_eq2 + w1 * 3))
+
+            def var_scalar_limbs(n_pad: int) -> np.ndarray:
+                return limbs.packed_to_limbs(
+                    b"".join(var_sc_packed) + bytes(32) * n_pad)
+
+            fixed_np = limbs.packed_to_limbs(fixed_acc_packed)
+        else:
+            fixed_acc = [0] * n_fixed
+            var_sc: list[int] = []
+            for i in live:
+                w1 = 1 + secrets.randbelow(R - 1)
+                w2 = 1 + secrets.randbelow(R - 1)
+                eq = equations[i]
+                # fixed layout: G(n), H(n) @ w2 | P, Q @ w2 | cg0, cg1 @ w1
+                for j in range(2 * n + 2):
+                    fixed_acc[j] = fr_add(fixed_acc[j],
+                                          fr_mul(w2, eq.fixed[j]))
+                for j in (2 * n + 2, 2 * n + 3):
+                    fixed_acc[j] = fr_add(fixed_acc[j],
+                                          fr_mul(w1, eq.fixed[j]))
+                weights = [w2] * n_eq2 + [w1] * 3
+                var_sc.extend(fr_mul(w, s)
+                              for w, s in zip(weights, equations[i].var))
+
+            def var_scalar_limbs(n_pad: int) -> np.ndarray:
+                return limbs.scalars_to_limbs(var_sc + [0] * n_pad)
+
+            fixed_np = limbs.scalars_to_limbs(fixed_acc)
 
         # pad the variable MSM to the next {2^k, 1.5*2^k} bucket: still a
         # handful of compiled shapes, but at most 33% padding waste (a
@@ -522,10 +607,9 @@ class BatchRangeVerifier:
         v_target = (3 * p // 4) if v <= 3 * p // 4 else p
         pts_np = limbs.points_to_projective_limbs(
             var_pts + [bn254.G1_IDENTITY] * (v_target - v))
-        sc_np = limbs.scalars_to_limbs(var_sc + [0] * (v_target - v))
-        ok = _combined_kernel(params.tables, jnp.asarray(
-            limbs.scalars_to_limbs(fixed_acc)), jnp.asarray(pts_np),
-            jnp.asarray(sc_np))
+        sc_np = var_scalar_limbs(v_target - v)
+        ok = _combined_kernel(params.tables, jnp.asarray(fixed_np),
+                              jnp.asarray(pts_np), jnp.asarray(sc_np))
         return bool(ok)
 
     # ------------------------------------------------------------------
@@ -541,6 +625,7 @@ class BatchRangeVerifier:
 
         eq1_pt_rows, eq1_sc_rows = [], []
         eq2_pt_rows, eq2_sc_rows = [], []
+        native = _FRNATIVE is not None
         for i in live:
             eq = equations[i]
             d = proofs[i].data
@@ -548,25 +633,39 @@ class BatchRangeVerifier:
             eq1_pt_rows.append([params.commitment_gen[0],
                                 params.commitment_gen[1],
                                 d.T1, d.T2, commitments[i]])
-            eq1_sc_rows.append([eq.fixed[2 * n + 2], eq.fixed[2 * n + 3],
-                                eq.var[-3], eq.var[-2], eq.var[-1]])
             # eq2: G_i ++ H_i ++ [P, Q, D, C] ++ L_r ++ R_r
             eq2_pt_rows.append(
                 params.left_gen + params.right_gen + [params.P, params.Q,
                                                       d.D, d.C]
                 + proofs[i].ipa.L + proofs[i].ipa.R)
-            eq2_sc_rows.append(
-                eq.fixed[: 2 * n + 2] + eq.var[:2]
-                + eq.var[2 : 2 + 2 * rr])
+            if native:
+                f, v = eq.fixed_packed, eq.var_packed
+                eq1_sc_rows.append(f[(2 * n + 2) * 32:(2 * n + 4) * 32]
+                                   + v[-3 * 32:])
+                eq2_sc_rows.append(f[:(2 * n + 2) * 32] + v[:2 * 32]
+                                   + v[2 * 32:(2 + 2 * rr) * 32])
+            else:
+                eq1_sc_rows.append([eq.fixed[2 * n + 2],
+                                    eq.fixed[2 * n + 3],
+                                    eq.var[-3], eq.var[-2], eq.var[-1]])
+                eq2_sc_rows.append(
+                    eq.fixed[: 2 * n + 2] + eq.var[:2]
+                    + eq.var[2 : 2 + 2 * rr])
 
         eq1_pts_np = np.stack(
             [limbs.points_to_projective_limbs(rw) for rw in eq1_pt_rows])
-        eq1_sc_np = np.stack(
-            [limbs.scalars_to_limbs(rw) for rw in eq1_sc_rows])
         eq2_pts_np = np.stack(
             [limbs.points_to_projective_limbs(rw) for rw in eq2_pt_rows])
-        eq2_sc_np = np.stack(
-            [limbs.scalars_to_limbs(rw) for rw in eq2_sc_rows])
+        if native:
+            eq1_sc_np = limbs.packed_to_limbs(b"".join(eq1_sc_rows)).reshape(
+                len(live), 5, limbs.NLIMBS)
+            eq2_sc_np = limbs.packed_to_limbs(b"".join(eq2_sc_rows)).reshape(
+                len(live), 2 * n + 2 * rr + 4, limbs.NLIMBS)
+        else:
+            eq1_sc_np = np.stack(
+                [limbs.scalars_to_limbs(rw) for rw in eq1_sc_rows])
+            eq2_sc_np = np.stack(
+                [limbs.scalars_to_limbs(rw) for rw in eq2_sc_rows])
         eq1_pts_np, eq1_sc_np = _pad_terms(eq1_pts_np, eq1_sc_np, 8)
         eq2_pts_np, eq2_sc_np = _pad_terms(eq2_pts_np, eq2_sc_np, t_bucket)
 

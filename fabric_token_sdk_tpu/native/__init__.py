@@ -1,0 +1,77 @@
+"""Native host-runtime components (C, built on demand).
+
+The compute path is JAX/XLA on the device; the host runtime around it —
+field arithmetic feeding the transcripts — is C where the reference uses
+gnark-crypto assembly. The extension builds lazily with the system
+compiler on first use and degrades to the pure-Python oracle when no
+toolchain is available (`load_frmont()` returns None).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(__file__)
+_LOCK = threading.Lock()
+_CACHED = False
+_MODULE = None
+
+
+def _so_path() -> str:
+    tag = f"cpython-{sys.version_info.major}{sys.version_info.minor}"
+    return os.path.join(_DIR, f"_frmont.{tag}.so")
+
+
+def _build() -> str | None:
+    src = os.path.join(_DIR, "frmont.c")
+    out = _so_path()
+    include = sysconfig.get_paths()["include"]
+    # compile to a private temp name, then atomically rename: concurrent
+    # builders (pytest workers, bench + tests) must never dlopen a
+    # half-written .so
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["cc", "-O2", "-shared", "-fPIC", f"-I{include}", src, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"frmont build failed:\n{proc.stderr}\n")
+        return None
+    try:
+        os.replace(tmp, out)
+    except OSError:
+        os.unlink(tmp)
+        return None
+    return out
+
+
+def load_frmont():
+    """The `_frmont` module, building it if needed; None when unavailable
+    (no compiler). Thread-safe; result cached for the process."""
+    global _CACHED, _MODULE
+    with _LOCK:
+        if _CACHED:
+            return _MODULE
+        _CACHED = True
+        path = _so_path()
+        if not os.path.exists(path) or (
+                os.path.getmtime(path)
+                < os.path.getmtime(os.path.join(_DIR, "frmont.c"))):
+            if _build() is None:
+                return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_frmont", path)
+        try:
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+        _MODULE = mod
+        return _MODULE

@@ -1,0 +1,731 @@
+/* _frmont: BN254 scalar-field (Fr) batch arithmetic, CPython extension.
+ *
+ * The native runtime piece of the host phase of the batched TPU verifier
+ * (models/range_verifier.py): where the reference leans on gnark-crypto's
+ * assembly field arithmetic (SURVEY.md §2.7 "IBM/mathlib -> gnark"), this
+ * module provides 4x64-bit Montgomery CIOS multiplication with batch entry
+ * points shaped for the verifier's hot loops:
+ *
+ *   - fold_coeffs: the IPA generator-folding expansion (2n muls/proof)
+ *   - powers:      y^i / y^-i ladders
+ *   - mul_many / addmul_many: elementwise fused scalar assembly
+ *   - batch_inv:   Montgomery-trick inversion (one Fermat pow in C)
+ *
+ * I/O convention: packed little-endian 32-byte scalars (b"" blobs hold k
+ * scalars at 32-byte stride), standard (non-Montgomery) representation at
+ * the boundary; conversion to/from Montgomery happens once per call.
+ * Parity is pinned against the pure-Python oracle in
+ * tests/test_frmont_native.py.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+
+/* BN254 r and Montgomery constants (R = 2^256 mod r domain) */
+static const u64 MOD[4] = {0x43e1f593f0000001ULL, 0x2833e84879b97091ULL,
+                           0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+static const u64 N0 = 0xc2e1f593efffffffULL; /* -r^{-1} mod 2^64 */
+static const u64 R2[4] = {0x1bb8e645ae216da7ULL, 0x53fe3ab1e35c59e3ULL,
+                          0x8c49833d53bb8085ULL, 0x0216d0b17f4e44a5ULL};
+static const u64 ONE_STD[4] = {1ULL, 0, 0, 0};
+
+/* a >= b ? */
+static int geq(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] > b[i]) return 1;
+        if (a[i] < b[i]) return 0;
+    }
+    return 1;
+}
+
+static void sub_nored(u64 out[4], const u64 a[4], const u64 b[4]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        out[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static void add_mod(u64 out[4], const u64 a[4], const u64 b[4]) {
+    u128 carry = 0;
+    u64 t[4];
+    for (int i = 0; i < 4; i++) {
+        u128 s = (u128)a[i] + b[i] + carry;
+        t[i] = (u64)s;
+        carry = s >> 64;
+    }
+    if (carry || geq(t, MOD)) sub_nored(out, t, MOD);
+    else memcpy(out, t, 32);
+}
+
+static void sub_mod(u64 out[4], const u64 a[4], const u64 b[4]) {
+    if (geq(a, b)) sub_nored(out, a, b);
+    else {
+        u64 t[4];
+        sub_nored(t, b, a);
+        sub_nored(out, MOD, t);
+    }
+}
+
+/* Montgomery CIOS multiplication: out = a*b*R^{-1} mod r */
+static void mont_mul(u64 out[4], const u64 a[4], const u64 b[4]) {
+    u64 t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 s = (u128)t[j] + (u128)a[j] * b[i] + carry;
+            t[j] = (u64)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[4] + carry;
+        t[4] = (u64)s;
+        t[5] = (u64)(s >> 64);
+
+        u64 m = t[0] * N0;
+        carry = ((u128)t[0] + (u128)m * MOD[0]) >> 64;
+        for (int j = 1; j < 4; j++) {
+            u128 s2 = (u128)t[j] + (u128)m * MOD[j] + carry;
+            t[j - 1] = (u64)s2;
+            carry = s2 >> 64;
+        }
+        s = (u128)t[4] + carry;
+        t[3] = (u64)s;
+        t[4] = t[5] + (u64)(s >> 64);
+        t[5] = 0;
+    }
+    if (t[4] || geq(t, MOD)) sub_nored(out, t, MOD);
+    else memcpy(out, t, 32);
+}
+
+static void to_mont(u64 out[4], const u64 a[4]) { mont_mul(out, a, R2); }
+static void from_mont(u64 out[4], const u64 a[4]) { mont_mul(out, a, ONE_STD); }
+
+/* out = base^e mod r, all in Montgomery form; e is a standard 4-limb int */
+static void mont_pow(u64 out[4], const u64 base[4], const u64 e[4]) {
+    u64 acc[4], sq[4];
+    to_mont(acc, ONE_STD);
+    memcpy(sq, base, 32);
+    for (int limb = 0; limb < 4; limb++) {
+        u64 bits = e[limb];
+        for (int i = 0; i < 64; i++) {
+            if (bits & 1) mont_mul(acc, acc, sq);
+            bits >>= 1;
+            if (limb == 3 && bits == 0 && i == 63) break;
+            mont_mul(sq, sq, sq);
+        }
+    }
+    memcpy(out, acc, 32);
+}
+
+/* ---------- packed-buffer helpers ---------- */
+
+static int unpack_arg(PyObject *obj, const u64 **out, Py_ssize_t *count,
+                      const char *name) {
+    /* bytes only: an immutable exporter whose storage outlives the call
+     * (the args tuple holds a reference). Mutable buffer-protocol objects
+     * (bytearray, numpy) could be resized mid-call after a
+     * PyBuffer_Release, so they are rejected rather than risked. */
+    char *buf;
+    Py_ssize_t len;
+    if (!PyBytes_Check(obj)) {
+        PyErr_Format(PyExc_TypeError, "%s: expected bytes", name);
+        return -1;
+    }
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return -1;
+    if (len % 32) {
+        PyErr_Format(PyExc_ValueError, "%s: length %zd not a multiple of 32",
+                     name, len);
+        return -1;
+    }
+    *out = (const u64 *)buf;
+    *count = len / 32;
+    return 0;
+}
+
+/* ---------- module functions ---------- */
+
+/* mul_many(a: bytes k*32, b: bytes k*32 | 32) -> bytes k*32 */
+static PyObject *py_mul_many(PyObject *self, PyObject *args) {
+    PyObject *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OO", &ao, &bo)) return NULL;
+    const u64 *a, *b;
+    Py_ssize_t ka, kb;
+    if (unpack_arg(ao, &a, &ka, "a") < 0) return NULL;
+    if (unpack_arg(bo, &b, &kb, "b") < 0) return NULL;
+    if (kb != ka && kb != 1) {
+        PyErr_SetString(PyExc_ValueError, "b must have k or 1 scalars");
+        return NULL;
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, ka * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 bm_shared[4];
+    if (kb == 1) to_mont(bm_shared, b);
+    for (Py_ssize_t i = 0; i < ka; i++) {
+        u64 am[4], bm[4], t[4];
+        to_mont(am, a + 4 * i);
+        if (kb == 1) memcpy(bm, bm_shared, 32);
+        else to_mont(bm, b + 4 * i);
+        mont_mul(t, am, bm);
+        from_mont(out + 4 * i, t);
+    }
+    return res;
+}
+
+/* add_many / sub_many(a, b) -> bytes (b broadcastable like mul_many) */
+static PyObject *addsub_many(PyObject *args, int is_sub) {
+    PyObject *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OO", &ao, &bo)) return NULL;
+    const u64 *a, *b;
+    Py_ssize_t ka, kb;
+    if (unpack_arg(ao, &a, &ka, "a") < 0) return NULL;
+    if (unpack_arg(bo, &b, &kb, "b") < 0) return NULL;
+    if (kb != ka && kb != 1) {
+        PyErr_SetString(PyExc_ValueError, "b must have k or 1 scalars");
+        return NULL;
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, ka * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    for (Py_ssize_t i = 0; i < ka; i++) {
+        const u64 *bi = (kb == 1) ? b : b + 4 * i;
+        if (is_sub) sub_mod(out + 4 * i, a + 4 * i, bi);
+        else add_mod(out + 4 * i, a + 4 * i, bi);
+    }
+    return res;
+}
+
+static PyObject *py_add_many(PyObject *self, PyObject *args) {
+    return addsub_many(args, 0);
+}
+static PyObject *py_sub_many(PyObject *self, PyObject *args) {
+    return addsub_many(args, 1);
+}
+
+/* addmul_many(acc, a, b) -> acc + a*b elementwise (b broadcastable) */
+static PyObject *py_addmul_many(PyObject *self, PyObject *args) {
+    PyObject *acco, *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OOO", &acco, &ao, &bo)) return NULL;
+    const u64 *acc, *a, *b;
+    Py_ssize_t kacc, ka, kb;
+    if (unpack_arg(acco, &acc, &kacc, "acc") < 0) return NULL;
+    if (unpack_arg(ao, &a, &ka, "a") < 0) return NULL;
+    if (unpack_arg(bo, &b, &kb, "b") < 0) return NULL;
+    if (ka != kacc || (kb != ka && kb != 1)) {
+        PyErr_SetString(PyExc_ValueError, "shape mismatch");
+        return NULL;
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, ka * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 bm_shared[4];
+    if (kb == 1) to_mont(bm_shared, b);
+    for (Py_ssize_t i = 0; i < ka; i++) {
+        u64 am[4], bm[4], t[4], std[4];
+        to_mont(am, a + 4 * i);
+        if (kb == 1) memcpy(bm, bm_shared, 32);
+        else to_mont(bm, b + 4 * i);
+        mont_mul(t, am, bm);
+        from_mont(std, t);
+        add_mod(out + 4 * i, acc + 4 * i, std);
+    }
+    return res;
+}
+
+/* powers(base: bytes32, n, invert=False) -> bytes n*32 : [1, b, b^2, ...] */
+static PyObject *py_powers(PyObject *self, PyObject *args) {
+    PyObject *bo;
+    Py_ssize_t n;
+    int invert = 0;
+    if (!PyArg_ParseTuple(args, "On|p", &bo, &n, &invert)) return NULL;
+    const u64 *b;
+    Py_ssize_t kb;
+    if (unpack_arg(bo, &b, &kb, "base") < 0) return NULL;
+    if (kb != 1 || n < 0) {
+        PyErr_SetString(PyExc_ValueError, "base must be one scalar, n >= 0");
+        return NULL;
+    }
+    u64 base_m[4];
+    to_mont(base_m, b);
+    if (invert) {
+        /* base^(r-2) via Fermat */
+        u64 e[4];
+        memcpy(e, MOD, 32);
+        e[0] -= 2;
+        u64 inv[4];
+        mont_pow(inv, base_m, e);
+        memcpy(base_m, inv, 32);
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, n * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 acc[4];
+    to_mont(acc, ONE_STD);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        from_mont(out + 4 * i, acc);
+        mont_mul(acc, acc, base_m);
+    }
+    return res;
+}
+
+/* fold_coeffs(ch: bytes r*32, inv: bytes r*32, n, invert_first) -> n*32
+ *
+ * Mirrors models/range_verifier._fold_coefficients: coefficients built by
+ * repeated doubling, challenges consumed in REVERSE round order (round 1
+ * binds the index MSB — reference ipa.go:343-356 fold semantics). */
+static PyObject *py_fold_coeffs(PyObject *self, PyObject *args) {
+    PyObject *cho, *invo;
+    Py_ssize_t n;
+    int invert_first;
+    if (!PyArg_ParseTuple(args, "OOnp", &cho, &invo, &n, &invert_first))
+        return NULL;
+    const u64 *ch, *inv;
+    Py_ssize_t kc, ki;
+    if (unpack_arg(cho, &ch, &kc, "challenges") < 0) return NULL;
+    if (unpack_arg(invo, &inv, &ki, "inverses") < 0) return NULL;
+    if (kc != ki || (((Py_ssize_t)1) << kc) != n) {
+        PyErr_SetString(PyExc_ValueError, "need 2^rounds == n");
+        return NULL;
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, n * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    /* work in Montgomery form throughout the expansion */
+    u64 *coeffs = (u64 *)PyMem_Malloc(n * 32);
+    if (!coeffs) {
+        Py_DECREF(res);
+        return PyErr_NoMemory();
+    }
+    to_mont(coeffs, ONE_STD);
+    Py_ssize_t cur = 1;
+    for (Py_ssize_t r = kc - 1; r >= 0; r--) { /* reverse round order */
+        u64 lo[4], hi[4];
+        if (invert_first) {
+            to_mont(lo, inv + 4 * r);
+            to_mont(hi, ch + 4 * r);
+        } else {
+            to_mont(lo, ch + 4 * r);
+            to_mont(hi, inv + 4 * r);
+        }
+        for (Py_ssize_t i = 0; i < cur; i++) {
+            u64 c[4];
+            memcpy(c, coeffs + 4 * i, 32);
+            mont_mul(coeffs + 4 * i, c, lo);
+            mont_mul(coeffs + 4 * (cur + i), c, hi);
+        }
+        cur <<= 1;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) from_mont(out + 4 * i, coeffs + 4 * i);
+    PyMem_Free(coeffs);
+    return res;
+}
+
+/* batch_inv(a: bytes k*32) -> bytes k*32 (zero maps to error) */
+static PyObject *py_batch_inv(PyObject *self, PyObject *args) {
+    PyObject *ao;
+    if (!PyArg_ParseTuple(args, "O", &ao)) return NULL;
+    const u64 *a;
+    Py_ssize_t k;
+    if (unpack_arg(ao, &a, &k, "a") < 0) return NULL;
+    PyObject *res = PyBytes_FromStringAndSize(NULL, k * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 *pref = (u64 *)PyMem_Malloc((k + 1) * 32);
+    u64 *am = (u64 *)PyMem_Malloc(k * 32);
+    if (!pref || !am) {
+        PyMem_Free(pref);
+        PyMem_Free(am);
+        Py_DECREF(res);
+        return PyErr_NoMemory();
+    }
+    to_mont(pref, ONE_STD);
+    for (Py_ssize_t i = 0; i < k; i++) {
+        static const u64 ZERO[4] = {0, 0, 0, 0};
+        if (memcmp(a + 4 * i, ZERO, 32) == 0) {
+            PyMem_Free(pref);
+            PyMem_Free(am);
+            Py_DECREF(res);
+            PyErr_SetString(PyExc_ZeroDivisionError, "inverse of zero in Fr");
+            return NULL;
+        }
+        to_mont(am + 4 * i, a + 4 * i);
+        mont_mul(pref + 4 * (i + 1), pref + 4 * i, am + 4 * i);
+    }
+    u64 e[4], run[4];
+    memcpy(e, MOD, 32);
+    e[0] -= 2;
+    mont_pow(run, pref + 4 * k, e); /* (prod all)^{-1} */
+    for (Py_ssize_t i = k - 1; i >= 0; i--) {
+        u64 t[4];
+        mont_mul(t, run, pref + 4 * i); /* a_i^{-1} in Montgomery */
+        from_mont(out + 4 * i, t);
+        mont_mul(run, run, am + 4 * i);
+    }
+    PyMem_Free(pref);
+    PyMem_Free(am);
+    return res;
+}
+
+/* ---------- base-field (Fp) point conversion ----------
+ *
+ * points_to_limbs: affine (x, y, inf) host points -> Montgomery projective
+ * limb encoding the device kernels consume (ops/limbs.py
+ * point_to_projective_limbs), without per-coordinate Python bigint math.
+ * Identity encodes as (0 : R1 : 0).
+ */
+
+static const u64 FP_MOD[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                              0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+static const u64 FP_N0 = 0x87d20782e4866389ULL;
+static const u64 FP_R2[4] = {0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
+                             0x47ab1eff0a417ff6ULL, 0x06d89f71cab8351fULL};
+static const u64 FP_R1[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                             0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+
+static int fp_geq(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] > b[i]) return 1;
+        if (a[i] < b[i]) return 0;
+    }
+    return 1;
+}
+
+static void fp_mont_mul(u64 out[4], const u64 a[4], const u64 b[4]) {
+    u64 t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 s = (u128)t[j] + (u128)a[j] * b[i] + carry;
+            t[j] = (u64)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[4] + carry;
+        t[4] = (u64)s;
+        t[5] = (u64)(s >> 64);
+
+        u64 m = t[0] * FP_N0;
+        carry = ((u128)t[0] + (u128)m * FP_MOD[0]) >> 64;
+        for (int j = 1; j < 4; j++) {
+            u128 s2 = (u128)t[j] + (u128)m * FP_MOD[j] + carry;
+            t[j - 1] = (u64)s2;
+            carry = s2 >> 64;
+        }
+        s = (u128)t[4] + carry;
+        t[3] = (u64)s;
+        t[4] = t[5] + (u64)(s >> 64);
+        t[5] = 0;
+    }
+    if (t[4] || fp_geq(t, FP_MOD)) sub_nored(out, t, FP_MOD);
+    else memcpy(out, t, 32);
+}
+
+/* points_to_limbs(xy: bytes k*65) -> bytes k*96
+ * input per point: x(32 LE) ++ y(32 LE) ++ inf(1 byte)
+ * output per point: X_mont(32 LE) ++ Y_mont(32 LE) ++ Z_mont(32 LE) */
+static PyObject *py_points_to_limbs(PyObject *self, PyObject *args) {
+    PyObject *po;
+    if (!PyArg_ParseTuple(args, "O", &po)) return NULL;
+    char *buf;
+    Py_ssize_t blen;
+    if (!PyBytes_Check(po)) {
+        PyErr_SetString(PyExc_TypeError, "expected bytes");
+        return NULL;
+    }
+    if (PyBytes_AsStringAndSize(po, &buf, &blen) < 0) return NULL;
+    if (blen % 65) {
+        PyErr_SetString(PyExc_ValueError, "need k*65 bytes (x||y||inf)");
+        return NULL;
+    }
+    Py_ssize_t k = blen / 65;
+    const unsigned char *in = (const unsigned char *)buf;
+    PyObject *res = PyBytes_FromStringAndSize(NULL, k * 96);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    for (Py_ssize_t i = 0; i < k; i++) {
+        const unsigned char *p = in + 65 * i;
+        u64 *o = out + 12 * i;
+        if (p[64]) { /* identity: (0 : R1 : 0) */
+            memset(o, 0, 32);
+            memcpy(o + 4, FP_R1, 32);
+            memset(o + 8, 0, 32);
+            continue;
+        }
+        u64 x[4], y[4];
+        memcpy(x, p, 32);
+        memcpy(y, p + 32, 32);
+        fp_mont_mul(o, x, FP_R2);      /* X in Montgomery */
+        fp_mont_mul(o + 4, y, FP_R2);  /* Y in Montgomery */
+        memcpy(o + 8, FP_R1, 32);      /* Z = 1 in Montgomery */
+    }
+    return res;
+}
+
+/* ---------- fused verifier host phases ----------
+ *
+ * Scalar assembly of models/range_verifier._host_phase_a/_host_phase_b,
+ * whole computation in Montgomery form. Pinned 1:1 against the Python
+ * implementations by tests/test_frmont_native.py; layouts:
+ *   phase_a -> y_pows(n) ++ yinv_pows(n) ++ [pol_eval] ++ k_fixed(n+2)
+ *   phase_b -> fixed(2n+5) ++ var(2n+2r+5)
+ */
+
+static void read_scalar(const u64 *buf, Py_ssize_t idx, u64 out[4]) {
+    memcpy(out, buf + 4 * idx, 32);
+}
+
+/* phase_a(n, x_unused, y, z, delta) all scalars packed; returns packed */
+static PyObject *py_phase_a(PyObject *self, PyObject *args) {
+    Py_ssize_t n;
+    PyObject *so;
+    if (!PyArg_ParseTuple(args, "nO", &n, &so)) return NULL;
+    const u64 *s;
+    Py_ssize_t ks;
+    if (unpack_arg(so, &s, &ks, "scalars") < 0) return NULL;
+    if (ks != 3) {
+        PyErr_SetString(PyExc_ValueError, "need packed [y, z, delta]");
+        return NULL;
+    }
+    u64 y[4], z[4], delta[4];
+    read_scalar(s, 0, y);
+    read_scalar(s, 1, z);
+    read_scalar(s, 2, delta);
+
+    PyObject *res = PyBytes_FromStringAndSize(NULL, (3 * n + 3) * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 *y_pows = out;               /* n */
+    u64 *yinv_pows = out + 4 * n;    /* n */
+    u64 *pol_eval = out + 8 * n;     /* 1 */
+    u64 *k_fixed = out + 8 * n + 4;  /* n + 2 */
+
+    u64 ym[4], yim[4], e[4];
+    to_mont(ym, y);
+    memcpy(e, MOD, 32);
+    e[0] -= 2;
+    mont_pow(yim, ym, e); /* y^{-1} in Montgomery */
+
+    u64 one_m[4], acc[4], acci[4];
+    to_mont(one_m, ONE_STD);
+    memcpy(acc, one_m, 32);
+    memcpy(acci, one_m, 32);
+    /* ipy = sum y^i ; ip2 = sum 2^i ; two_pows in Montgomery */
+    u64 ipy[4] = {0, 0, 0, 0}, ip2[4] = {0, 0, 0, 0};
+    u64 two_m[4], p2[4];
+    u64 two_std[4] = {2, 0, 0, 0};
+    to_mont(two_m, two_std);
+    memcpy(p2, one_m, 32);
+
+    u64 zm[4], z_sq[4], z_cube[4], dm[4];
+    to_mont(zm, z);
+    mont_mul(z_sq, zm, zm);
+    mont_mul(z_cube, z_sq, zm);
+    to_mont(dm, delta);
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        from_mont(y_pows + 4 * i, acc);
+        from_mont(yinv_pows + 4 * i, acci);
+        add_mod(ipy, ipy, acc);
+        add_mod(ip2, ip2, p2);
+        /* k_fixed[i] = z + z^2 * 2^i * yinv^i */
+        u64 t[4];
+        mont_mul(t, z_sq, p2);
+        mont_mul(t, t, acci);
+        add_mod(t, t, zm);
+        from_mont(k_fixed + 4 * i, t);
+        mont_mul(acc, acc, ym);
+        mont_mul(acci, acci, yim);
+        mont_mul(p2, p2, two_m);
+    }
+    /* pol_eval = (z - z^2) * ipy - z^3 * ip2 */
+    u64 t1[4], t2[4], pe[4];
+    sub_mod(t1, zm, z_sq);
+    mont_mul(t1, t1, ipy);
+    mont_mul(t2, z_cube, ip2);
+    sub_mod(pe, t1, t2);
+    from_mont(pol_eval, pe);
+    /* k_fixed[n] = -delta ; k_fixed[n+1] = -z */
+    u64 zero[4] = {0, 0, 0, 0}, nd[4], nz[4];
+    sub_mod(nd, zero, dm);
+    from_mont(k_fixed + 4 * n, nd);
+    sub_mod(nz, zero, zm);
+    from_mont(k_fixed + 4 * (n + 1), nz);
+    return res;
+}
+
+/* phase_b(n, rounds, scalars, yinv_pows, round_ch, round_inv)
+ * scalars packed: [a, b, z, x, x_ipa, ip, tau, delta, pol_eval]
+ * returns fixed(2n+5) ++ var(2n+2r+5), packed standard form */
+static PyObject *py_phase_b(PyObject *self, PyObject *args) {
+    Py_ssize_t n, rounds;
+    PyObject *so, *yo, *co, *io;
+    if (!PyArg_ParseTuple(args, "nnOOOO", &n, &rounds, &so, &yo, &co, &io))
+        return NULL;
+    const u64 *s, *yinv, *rch, *rinv;
+    Py_ssize_t ks, ky, kc, ki;
+    if (unpack_arg(so, &s, &ks, "scalars") < 0) return NULL;
+    if (unpack_arg(yo, &yinv, &ky, "yinv_pows") < 0) return NULL;
+    if (unpack_arg(co, &rch, &kc, "round_ch") < 0) return NULL;
+    if (unpack_arg(io, &rinv, &ki, "round_inv") < 0) return NULL;
+    if (ks != 9 || ky != n || kc != rounds || ki != rounds ||
+        (((Py_ssize_t)1) << rounds) != n) {
+        PyErr_SetString(PyExc_ValueError, "phase_b: shape mismatch");
+        return NULL;
+    }
+    u64 a[4], b[4], z[4], x[4], x_ipa[4], ip[4], tau[4], delta[4], pe[4];
+    read_scalar(s, 0, a);
+    read_scalar(s, 1, b);
+    read_scalar(s, 2, z);
+    read_scalar(s, 3, x);
+    read_scalar(s, 4, x_ipa);
+    read_scalar(s, 5, ip);
+    read_scalar(s, 6, tau);
+    read_scalar(s, 7, delta);
+    read_scalar(s, 8, pe);
+
+    Py_ssize_t n_fixed = 2 * n + 5;
+    Py_ssize_t n_var = 2 + 2 * rounds + 3;
+    PyObject *res =
+        PyBytes_FromStringAndSize(NULL, (n_fixed + n_var) * 32);
+    if (!res) return NULL;
+    u64 *out = (u64 *)PyBytes_AS_STRING(res);
+    u64 *fixed = out;
+    u64 *var = out + 4 * n_fixed;
+
+    /* Montgomery inputs */
+    u64 am[4], bm[4], zm[4], xm[4], xim[4], ipm[4], z_sq[4], x_sq[4];
+    to_mont(am, a);
+    to_mont(bm, b);
+    to_mont(zm, z);
+    to_mont(xm, x);
+    to_mont(xim, x_ipa);
+    to_mont(ipm, ip);
+    mont_mul(z_sq, zm, zm);
+    mont_mul(x_sq, xm, xm);
+
+    /* fold coefficients, Montgomery domain, reverse round order */
+    u64 *ac = (u64 *)PyMem_Malloc(n * 32);
+    u64 *bc = (u64 *)PyMem_Malloc(n * 32);
+    if (!ac || !bc) {
+        PyMem_Free(ac);
+        PyMem_Free(bc);
+        Py_DECREF(res);
+        return PyErr_NoMemory();
+    }
+    u64 one_m[4];
+    to_mont(one_m, ONE_STD);
+    memcpy(ac, one_m, 32);
+    memcpy(bc, one_m, 32);
+    Py_ssize_t cur = 1;
+    for (Py_ssize_t r = rounds - 1; r >= 0; r--) {
+        u64 xr[4], xr_inv[4];
+        to_mont(xr, rch + 4 * r);
+        to_mont(xr_inv, rinv + 4 * r);
+        for (Py_ssize_t i = 0; i < cur; i++) {
+            u64 c[4];
+            /* a: lo=inv, hi=ch ; b: lo=ch, hi=inv */
+            memcpy(c, ac + 4 * i, 32);
+            mont_mul(ac + 4 * i, c, xr_inv);
+            mont_mul(ac + 4 * (cur + i), c, xr);
+            memcpy(c, bc + 4 * i, 32);
+            mont_mul(bc + 4 * i, c, xr);
+            mont_mul(bc + 4 * (cur + i), c, xr_inv);
+        }
+        cur <<= 1;
+    }
+
+    u64 two_std[4] = {2, 0, 0, 0}, two_m[4], p2[4];
+    to_mont(two_m, two_std);
+    memcpy(p2, one_m, 32);
+    for (Py_ssize_t j = 0; j < n; j++) {
+        u64 t[4], yv[4];
+        /* G_j: a * a_coeffs[j] + z */
+        mont_mul(t, am, ac + 4 * j);
+        add_mod(t, t, zm);
+        from_mont(fixed + 4 * j, t);
+        /* H_j: b*b_coeffs[j]*yinv_j - z - z^2*2^j*yinv_j */
+        to_mont(yv, yinv + 4 * j);
+        u64 h[4], t2[4];
+        mont_mul(h, bm, bc + 4 * j);
+        mont_mul(h, h, yv);
+        sub_mod(h, h, zm);
+        mont_mul(t2, z_sq, p2);
+        mont_mul(t2, t2, yv);
+        sub_mod(h, h, t2);
+        from_mont(fixed + 4 * (n + j), h);
+        mont_mul(p2, p2, two_m);
+    }
+    PyMem_Free(ac);
+    PyMem_Free(bc);
+    /* P: delta ; Q: x_ipa*(a*b - ip) ; cg0: ip - pol_eval ; cg1: tau ;
+     * S_G: 0 */
+    memcpy(fixed + 4 * (2 * n), delta, 32);
+    u64 q[4], pem[4], taum[4];
+    mont_mul(q, am, bm);
+    sub_mod(q, q, ipm);
+    mont_mul(q, q, xim);
+    from_mont(fixed + 4 * (2 * n + 1), q);
+    to_mont(pem, pe);
+    u64 cg0[4];
+    sub_mod(cg0, ipm, pem);
+    from_mont(fixed + 4 * (2 * n + 2), cg0);
+    memcpy(fixed + 4 * (2 * n + 3), tau, 32);
+    memset(fixed + 4 * (2 * n + 4), 0, 32);
+
+    /* var: D=-x, C=-1, L_r=-(x_r^2), R_r=-(x_r^-2), T1=-x, T2=-x^2,
+     * Com=-z^2 */
+    u64 zero[4] = {0, 0, 0, 0}, t[4];
+    sub_mod(t, zero, xm);
+    from_mont(var + 0, t); /* D */
+    u64 neg_one[4];
+    sub_mod(neg_one, zero, one_m);
+    from_mont(var + 4, neg_one); /* C */
+    for (Py_ssize_t r = 0; r < rounds; r++) {
+        u64 xr[4], sq[4];
+        to_mont(xr, rch + 4 * r);
+        mont_mul(sq, xr, xr);
+        sub_mod(sq, zero, sq);
+        from_mont(var + 4 * (2 + r), sq);
+        to_mont(xr, rinv + 4 * r);
+        mont_mul(sq, xr, xr);
+        sub_mod(sq, zero, sq);
+        from_mont(var + 4 * (2 + rounds + r), sq);
+    }
+    sub_mod(t, zero, xm);
+    from_mont(var + 4 * (2 + 2 * rounds), t); /* T1 */
+    sub_mod(t, zero, x_sq);
+    from_mont(var + 4 * (2 + 2 * rounds + 1), t); /* T2 */
+    sub_mod(t, zero, z_sq);
+    from_mont(var + 4 * (2 + 2 * rounds + 2), t); /* Com */
+    return res;
+}
+
+static PyMethodDef Methods[] = {
+    {"points_to_limbs", py_points_to_limbs, METH_VARARGS,
+     "affine points (x||y||inf @65B) -> Montgomery projective (96B)"},
+    {"phase_a", py_phase_a, METH_VARARGS,
+     "fused host phase a: y ladders + pol_eval + K fixed scalars"},
+    {"phase_b", py_phase_b, METH_VARARGS,
+     "fused host phase b: fold + eq1/eq2 scalar assembly"},
+    {"mul_many", py_mul_many, METH_VARARGS,
+     "elementwise a*b mod r over packed 32-byte scalars (b broadcastable)"},
+    {"add_many", py_add_many, METH_VARARGS, "elementwise a+b mod r"},
+    {"sub_many", py_sub_many, METH_VARARGS, "elementwise a-b mod r"},
+    {"addmul_many", py_addmul_many, METH_VARARGS, "acc + a*b mod r"},
+    {"powers", py_powers, METH_VARARGS,
+     "powers(base, n, invert=False): [base^0 .. base^(n-1)]"},
+    {"fold_coeffs", py_fold_coeffs, METH_VARARGS,
+     "IPA fold-coefficient expansion (reverse round order)"},
+    {"batch_inv", py_batch_inv, METH_VARARGS, "Montgomery batch inversion"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_frmont",
+                                       "BN254 Fr batch arithmetic", -1,
+                                       Methods};
+
+PyMODINIT_FUNC PyInit__frmont(void) { return PyModule_Create(&moduledef); }

@@ -267,15 +267,54 @@ def _tree_sum_shrink(pts: jnp.ndarray) -> jnp.ndarray:
     return pts[..., 0, :, :]
 
 
+def _to_byte_planes(tables: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3, 16) uint32 limb tables -> (..., 96) bf16 byte planes.
+
+    Each 16-bit limb splits into (lo, hi) bytes; integers <= 255 are exact
+    in bf16, so a one-hot selection matmul over these planes is bit-exact
+    on the MXU at its native (single-pass bf16) precision. f32 planes are
+    NOT safe: TPU matmuls truncate f32 operands to bf16 by default, and
+    16-bit limb values lose their low bits."""
+    flat = tables.reshape(*tables.shape[:-2], 3 * L.NLIMBS)
+    lo = (flat & 0xFF).astype(jnp.bfloat16)
+    hi = ((flat >> 8) & 0xFF).astype(jnp.bfloat16)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def _from_byte_planes(sel: jnp.ndarray) -> jnp.ndarray:
+    """(..., 96) f32 selected planes -> (..., 3, 16) uint32 limbs."""
+    u = sel.astype(jnp.uint32)
+    c = 3 * L.NLIMBS
+    out = u[..., :c] + (u[..., c:] << 8)
+    return out.reshape(*out.shape[:-1], 3, L.NLIMBS)
+
+
+def _select_onehot(tables_planes: jnp.ndarray, digits: jnp.ndarray,
+                   entries: int) -> jnp.ndarray:
+    """Table selection as a one-hot MXU matmul (no gather).
+
+    tables_planes: (..., T, entries, 96) bf16 byte planes
+    (_to_byte_planes); digits: (..., T) int32 in [0, entries).
+    Returns (..., T, 3, 16) uint32 — bit-exact (single 1 per one-hot row,
+    plane values <= 255), riding the MXU instead of HBM scatter/gather,
+    which is the difference between ~ms and ~100s of ms per pass on TPU.
+    """
+    onehot = jax.nn.one_hot(digits, entries, dtype=jnp.bfloat16)
+    sel = jnp.einsum("...tv,...tvc->...tc", onehot, tables_planes,
+                     preferred_element_type=jnp.float32)
+    return _from_byte_planes(sel)
+
+
 def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
     """Windowed batched MSM: (..., T, 3, 16) x (..., T, 16) -> (..., 3, 16).
 
     Builds a 16-entry multiple table per term (15 sequential adds, T-wide),
     then scans 64 4-bit windows MSB-first: 4 shared doublings + per-term
-    table select + tree-sum per window.
+    one-hot table select (MXU) + tree-sum per window.
     """
     batch = points.shape[:-3]
     tables = _multiple_table(points, 16)           # (..., T, 16, 3, 16)
+    tables_planes = _to_byte_planes(tables)        # (..., T, 16, 96)
     digits = window_digits4(scalars)               # (..., T, 64)
 
     def body(i, acc):
@@ -283,10 +322,8 @@ def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
             acc = add(acc, acc)
         d = jax.lax.dynamic_slice_in_dim(
             digits, _W4_WINDOWS - 1 - i, 1, axis=-1)   # (..., T, 1)
-        sel = jnp.take_along_axis(
-            tables, d[..., None, None].astype(jnp.int32),
-            axis=-3)                                   # (..., T, 1, 3, 16)
-        term = _tree_sum_shrink(sel[..., 0, :, :])
+        sel = _select_onehot(tables_planes, d[..., 0].astype(jnp.int32), 16)
+        term = _tree_sum_shrink(sel)
         return add(acc, term)
 
     return jax.lax.fori_loop(0, _W4_WINDOWS, body, identity(batch))
@@ -313,31 +350,50 @@ def fixed_base_tables(points: jnp.ndarray) -> jnp.ndarray:
     return _multiple_table(bases, 256)             # (T, 32, 256, 3, 16)
 
 
-def fixed_base_gather(tables: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
-    """Per-term fixed-base scalar mul via table gather.
+def fixed_base_planes(points: jnp.ndarray) -> jnp.ndarray:
+    """Precompute the byte-plane form of the 8-bit fixed-base tables.
 
-    tables: (T, 32, 256, 3, 16); scalars: (..., T, 16) plain limbs.
+    points: (T, 3, 16) -> (T, 32, 256, 96) bf16 — what the fixed-base
+    kernels consume. Built once per PublicParams set (half the memory of
+    the uint32 tables and no per-call conversion)."""
+    return _to_byte_planes(fixed_base_tables(points))
+
+
+def _fixed_base_select(table_planes: jnp.ndarray,
+                       scalars: jnp.ndarray) -> jnp.ndarray:
+    """One-hot-select every (term, window) table entry for the scalars.
+
+    table_planes: (T, 32, 256, 96) bf16 (fixed_base_planes);
+    scalars: (..., T, 16) plain limbs.
+    Returns (..., T, 32, 3, 16) = digit_{t,w} * 2^(8w) * P_t, via the MXU
+    (see _select_onehot for why byte-plane selection is exact)."""
+    digits = window_digits8(scalars)               # (..., T, 32)
+    onehot = jax.nn.one_hot(digits.astype(jnp.int32), 256,
+                            dtype=jnp.bfloat16)    # (..., T, 32, 256)
+    sel = jnp.einsum("...twv,twvc->...twc", onehot, table_planes,
+                     preferred_element_type=jnp.float32)
+    return _from_byte_planes(sel)
+
+
+def fixed_base_gather(table_planes: jnp.ndarray,
+                      scalars: jnp.ndarray) -> jnp.ndarray:
+    """Per-term fixed-base scalar mul via one-hot table selection.
+
+    table_planes: (T, 32, 256, 96) bf16; scalars: (..., T, 16) plain limbs.
     Returns (..., T, 3, 16) = scalars[t] * P_t. 31 complete adds per term.
     """
-    digits = window_digits8(scalars)               # (..., T, 32)
-    lead = digits.ndim - 2
-    tb = tables.reshape((1,) * lead + tables.shape)
-    sel = jnp.take_along_axis(tb, digits[..., None, None, None].astype(jnp.int32),
-                              axis=-3)             # (..., T, 32, 1, 3, 16)
-    return _tree_sum_shrink(sel[..., 0, :, :])     # fold the 32-window axis
+    sel = _fixed_base_select(table_planes, scalars)  # (..., T, 32, 3, 16)
+    return _tree_sum_shrink(sel)                   # fold the 32-window axis
 
 
-def fixed_base_msm(tables: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+def fixed_base_msm(table_planes: jnp.ndarray,
+                   scalars: jnp.ndarray) -> jnp.ndarray:
     """Fixed-base MSM: sum_t scalars[t] * P_t over precomputed tables.
 
-    tables: (T, 32, 256, 3, 16); scalars: (..., T, 16) -> (..., 3, 16).
-    Folds the window and term axes in one tree (31 + T-1 adds total depth
-    log2(32*T))."""
-    digits = window_digits8(scalars)               # (..., T, 32)
-    lead = digits.ndim - 2
-    tb = tables.reshape((1,) * lead + tables.shape)
-    sel = jnp.take_along_axis(tb, digits[..., None, None, None].astype(jnp.int32),
-                              axis=-3)[..., 0, :, :]  # (..., T, 32, 3, 16)
+    table_planes: (T, 32, 256, 96) bf16; scalars: (..., T, 16)
+    -> (..., 3, 16). Folds the window and term axes in one tree
+    (31 + T-1 adds total depth log2(32*T))."""
+    sel = _fixed_base_select(table_planes, scalars)  # (..., T, 32, 3, 16)
     flat = sel.reshape(*sel.shape[:-4], -1, 3, L.NLIMBS)
     return _tree_sum_shrink(flat)
 
@@ -353,28 +409,25 @@ def to_affine_batch(p: jnp.ndarray) -> jnp.ndarray:
     one = jnp.broadcast_to(FP.r1_arr, Z.shape)
     z_safe = jnp.where(inf[..., None], one, Z)
 
-    # Inclusive prefix products along K (log2 K levels of mont_mul).
+    # Inclusive prefix and suffix products along K (log2 K mont_mul levels
+    # each — no K-step sequential chain; the only serial part is the single
+    # Fermat inversion of the row total).
     def combine(a, b):
         return field.mont_mul(a, b, FP)
 
-    prefix = jax.lax.associative_scan(combine, z_safe, axis=-2)
+    k_axis = z_safe.ndim - 2  # nonnegative: reverse=True rejects -2
+    prefix = jax.lax.associative_scan(combine, z_safe, axis=k_axis)
+    suffix = jax.lax.associative_scan(combine, z_safe, axis=k_axis,
+                                      reverse=True)
     total_inv = field.inv(prefix[..., -1, :], FP)  # one Fermat per row
 
-    # zinv[k] = total_inv(k..K-1 suffix) * prefix[k-1]; walk backwards.
-    def step(carry, xs):
-        z_k, prefix_km1 = xs
-        zinv_k = field.mont_mul(carry, prefix_km1, FP)
-        carry = field.mont_mul(carry, z_k, FP)
-        return carry, zinv_k
-
-    K = p.shape[-3]
     ones = jnp.broadcast_to(FP.r1_arr, z_safe[..., :1, :].shape)
     prefix_shift = jnp.concatenate([ones, prefix[..., :-1, :]], axis=-2)
-    # scan over the K axis, reversed: move K to axis 0.
-    z_t = jnp.moveaxis(z_safe, -2, 0)
-    pr_t = jnp.moveaxis(prefix_shift, -2, 0)
-    _, zinv_t = jax.lax.scan(step, total_inv, (z_t, pr_t), reverse=True)
-    zinv = jnp.moveaxis(zinv_t, 0, -2)
+    suffix_shift = jnp.concatenate([suffix[..., 1:, :], ones], axis=-2)
+    # zinv[k] = prefix[k-1] * suffix[k+1] * (prod all)^-1
+    zinv = field.mont_mul(
+        field.mont_mul(prefix_shift, suffix_shift, FP),
+        jnp.broadcast_to(total_inv[..., None, :], z_safe.shape), FP)
 
     xa = field.from_mont(field.mont_mul(X, zinv, FP), FP)
     ya = field.from_mont(field.mont_mul(Y, zinv, FP), FP)

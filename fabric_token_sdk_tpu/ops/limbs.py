@@ -98,7 +98,21 @@ def point_to_projective_limbs(p: bn254.G1) -> np.ndarray:
 
 
 def points_to_projective_limbs(points) -> np.ndarray:
-    """(N, 3, NLIMBS) uint32 from a list of host points."""
+    """(N, 3, NLIMBS) uint32 from a list of host points.
+
+    Rides the native Fp Montgomery converter when available (one C call
+    for the whole list); falls back to per-point Python bigint math."""
+    from ..native import load_frmont
+
+    native = load_frmont()
+    if native is not None and points:
+        blob = b"".join(
+            (b"\x00" * 64 + b"\x01") if p.inf else
+            (p.x.to_bytes(32, "little") + p.y.to_bytes(32, "little")
+             + b"\x00")
+            for p in points)
+        out = np.frombuffer(native.points_to_limbs(blob), dtype="<u2")
+        return out.astype(np.uint32).reshape(len(points), 3, NLIMBS)
     return np.stack([point_to_projective_limbs(p) for p in points])
 
 
@@ -116,3 +130,16 @@ def projective_limbs_to_point(arr: np.ndarray) -> bn254.G1:
 def scalars_to_limbs(scalars) -> np.ndarray:
     """Scalars mod r -> (N, NLIMBS) uint32 (plain integers, not Montgomery)."""
     return np.stack([int_to_limbs(s % R_INT) for s in scalars])
+
+
+def packed_to_limbs(raw: bytes) -> np.ndarray:
+    """Packed little-endian 32-byte scalars (the native _frmont wire form,
+    already reduced mod r) -> (N, NLIMBS) uint32. Pure numpy reshape: the
+    16-bit limb layout IS the byte layout."""
+    arr = np.frombuffer(raw, dtype="<u2").reshape(-1, NLIMBS)
+    return arr.astype(np.uint32)
+
+
+def pack_scalars(scalars) -> bytes:
+    """Ints mod r -> packed 32-byte little-endian (the _frmont wire form)."""
+    return b"".join((s % R_INT).to_bytes(32, "little") for s in scalars)

@@ -10,10 +10,60 @@ from __future__ import annotations
 import os
 
 
+def raise_stack_limit() -> None:
+    """Lift RLIMIT_STACK before XLA compiles anything.
+
+    LLVM's recursive passes compiling the large unrolled EC kernels can
+    blow the default 8 MiB thread stack on XLA:CPU (observed as a SIGSEGV
+    inside compile_or_get_cached on single-core hosts). Must run before
+    jax creates its compilation threads — their stack size is fixed at
+    thread creation from the soft limit."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        want = 512 * 1024 * 1024
+        if soft != resource.RLIM_INFINITY and soft < want:
+            new_soft = want if hard == resource.RLIM_INFINITY \
+                else min(want, hard)
+            resource.setrlimit(resource.RLIMIT_STACK, (new_soft, hard))
+    except (ImportError, ValueError, OSError):
+        pass  # best effort: platform without rlimits or no privilege
+
+
+def _host_tag() -> str:
+    """Fingerprint of the host CPU feature set.
+
+    XLA:CPU AOT cache entries bake in the compile machine's features;
+    loading them on a host with a different set fails or SIGILLs
+    (observed: /tmp/jax_cache carried over from an avx512+amx machine
+    crashed the suite mid-compile). Keying the cache dir by the feature
+    set makes stale entries unreachable instead of fatal."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
+
+
 def configure_jax_cache() -> None:
     import jax
 
+    raise_stack_limit()
+    base = os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache")
+    # Segment by backend platform AND host CPU: the axon (remote-TPU)
+    # client writes XLA:CPU AOT artifacts compiled on the REMOTE host into
+    # the cache; loading those under the local cpu backend SIGILLs/aborts
+    # (root cause of the mid-suite faulthandler crashes).
+    platform = (jax.config.jax_platforms or "default").replace(",", "_")
     jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache"))
+                      f"{base}-{platform}-{_host_tag()}")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

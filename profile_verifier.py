@@ -53,12 +53,20 @@ def main():
     zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
     id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
     t0 = time.perf_counter()
-    yinv_np = np.stack(
-        [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
+    if rv._FRNATIVE is not None:
+        yinv_np = limbs.packed_to_limbs(
+            b"".join(transcripts[i].yinv_packed for i in live)
+        ).reshape(len(live), n, limbs.NLIMBS)
+        k_fixed_np = limbs.packed_to_limbs(
+            b"".join(transcripts[i].k_fixed_packed for i in live)
+        ).reshape(len(live), n + 2, limbs.NLIMBS)
+    else:
+        yinv_np = np.stack(
+            [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
+        k_fixed_np = np.stack(
+            [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
+             for i in live])
     yinv = jnp.asarray(rv._pad_rows(yinv_np, b_bucket, zero_sc))
-    k_fixed_np = np.stack(
-        [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
-         for i in live])
     k_fixed = jnp.asarray(rv._pad_rows(k_fixed_np, b_bucket, zero_sc))
     dc_pts_np = np.stack(
         [limbs.points_to_projective_limbs(

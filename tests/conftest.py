@@ -10,6 +10,14 @@ here are too late, so the platform override must go through jax.config.
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# stack limit must rise BEFORE jax spawns compilation threads
+from fabric_token_sdk_tpu.utils.jaxcfg import raise_stack_limit  # noqa: E402
+
+raise_stack_limit()
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -21,10 +29,6 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the limbed EC kernels trace to large graphs
 # (256-step fori_loop bodies); caching makes re-runs cheap. Set via config,
 # not env — jax is already imported (sitecustomize), so env vars are too late.
-import sys  # noqa: E402
-from pathlib import Path  # noqa: E402
-
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache  # noqa: E402
 
 configure_jax_cache()

@@ -54,7 +54,7 @@ def test_msm_windowed_identity_row():
 @pytest.fixture(scope="module")
 def fb():
     pts = _rand_points(3)
-    tables = ec.fixed_base_tables(
+    tables = ec.fixed_base_planes(
         jnp.asarray(limbs.points_to_projective_limbs(pts)))
     return pts, tables
 
