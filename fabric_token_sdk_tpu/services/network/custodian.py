@@ -1,0 +1,186 @@
+"""Custodian-mediated ledger backend (the Orion-style network family).
+
+Behavioral mirror of reference token/services/network/orion: clients never
+talk to the ledger database directly — a CUSTODIAN node fronts it. The
+client asks the custodian for approval (the custodian runs the driver
+Validator over current state and signs off — orion/approval.go:74-109,
+140-272) and then asks it to broadcast (submit + commit with bounded
+retries — orion/broadcast.go:52,128-137). Finality events flow back to
+client subscribers through the custodian's event fan-out.
+
+`CustodianChaincodeFacade` exposes the same surface as TokenChaincode
+(process_request / query_* / .ledger reads / finality listeners), so a
+TokenNode runs on this backend unchanged — the backend swap the reference
+achieves behind driver.Network (network/driver/network.go:38).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .rws import KeyTranslator
+from .tcc import CommitEvent
+
+
+class CustodianError(Exception):
+    pass
+
+
+def _approval_digest(tx_id: str, request_raw: bytes) -> bytes:
+    """Domain-separated bytes the custodian signs for an approval; shared
+    by signer and verifier so the framing cannot drift apart."""
+    import hashlib
+
+    return hashlib.sha256(
+        b"custodian-approval\x00" + tx_id.encode() + b"\x00"
+        + request_raw).digest()
+
+
+class CustodianNode:
+    """The custodian: sole owner of the ledger + chaincode; serves
+    approval/broadcast/query views over the session plane."""
+
+    def __init__(self, name: str, keys, chaincode, bus,
+                 max_broadcast_attempts: int = 3, retry_wait: float = 0.01):
+        self.name = name
+        self.keys = keys
+        self.cc = chaincode
+        self.max_broadcast_attempts = max_broadcast_attempts
+        self.retry_wait = retry_wait
+        self._subscribers: list = []
+        # test/fault hook: raised-once transient failures (broadcast.go
+        # retry path); a callable returning True means "fail this attempt"
+        self.fault_hook = None
+        bus.register(name, self)
+        chaincode.ledger.add_finality_listener(self._forward_event)
+
+    # ------------------------------------------------------------ views
+    def request_approval(self, tx_id: str, request_raw: bytes) -> bytes:
+        """orion/approval.go: the custodian validates the request against
+        CURRENT ledger state and signs its approval. No state change."""
+        rws = self.cc.ledger.new_rwset()
+
+        def get_state(token_id):
+            return rws.get_state(self.cc.keys.output_key(
+                token_id.tx_id, token_id.index))
+
+        try:
+            self.cc.validator.verify_token_request_from_raw(
+                get_state, tx_id, request_raw)
+        except Exception as e:
+            raise CustodianError(
+                f"custodian rejects tx [{tx_id}]: {e}") from e
+        return self.keys.sign(_approval_digest(tx_id, request_raw))
+
+    def broadcast(self, tx_id: str, request_raw: bytes) -> CommitEvent:
+        """orion/broadcast.go:52: submit for ordering + commit, retrying
+        transient submission failures (:128-137)."""
+        last_err: Exception | None = None
+        for attempt in range(self.max_broadcast_attempts):
+            try:
+                if self.fault_hook is not None and self.fault_hook(attempt):
+                    raise ConnectionError("transient submission failure")
+                return self.cc.process_request(tx_id, request_raw)
+            except ConnectionError as e:
+                last_err = e
+                if attempt + 1 < self.max_broadcast_attempts:
+                    time.sleep(self.retry_wait)
+        raise CustodianError(
+            f"broadcast of [{tx_id}] failed after "
+            f"{self.max_broadcast_attempts} attempts: {last_err}")
+
+    def query_state(self, key: str) -> bytes | None:
+        return self.cc.ledger.get_state(key)
+
+    def query_public_params(self) -> bytes | None:
+        return self.cc.query_public_params()
+
+    def emit_invalid(self, tx_id: str, message: str) -> CommitEvent:
+        """Fan an INVALID event out to every subscriber — the custodian
+        equivalent of TokenChaincode emitting validation failures
+        ledger-wide (tcc.py _process_request), so distributed openings and
+        pending ttxdb records get cleaned up on every node."""
+        ev = CommitEvent(tx_id, "INVALID", message)
+        self._forward_event(ev)
+        return ev
+
+    def subscribe(self, callback) -> None:
+        self._subscribers.append(callback)
+
+    def unsubscribe(self, callback) -> None:
+        if callback in self._subscribers:
+            self._subscribers.remove(callback)
+
+    def _forward_event(self, ev: CommitEvent) -> None:
+        for cb in list(self._subscribers):
+            try:
+                cb(ev)
+            except Exception:  # subscriber isolation, like MemoryLedger
+                import logging
+
+                logging.getLogger(
+                    "fabric_token_sdk_tpu.custodian").exception(
+                    "custodian subscriber failed for tx [%s]", ev.tx_id)
+
+
+class _CustodianLedgerView:
+    """Read-only ledger facade: every access is a custodian query."""
+
+    def __init__(self, custodian: CustodianNode):
+        self._custodian = custodian
+
+    def get_state(self, key: str) -> bytes | None:
+        return self._custodian.query_state(key)
+
+    def add_finality_listener(self, listener) -> None:
+        self._custodian.subscribe(listener)
+
+    def remove_finality_listener(self, listener) -> None:
+        self._custodian.unsubscribe(listener)
+
+
+class CustodianChaincodeFacade:
+    """Client-side stand-in for TokenChaincode over the custodian.
+
+    process_request == approval + broadcast through the custodian
+    (the orion transaction path); reads and finality ride the custodian's
+    query/event views. The local validator handles unmarshalling only
+    (nodes hold the pp; the custodian owns validation-for-commit).
+    """
+
+    def __init__(self, custodian: CustodianNode, validator,
+                 approval_required: bool = True):
+        from ..identity.x509 import X509Verifier
+
+        self.keys = KeyTranslator()
+        self.validator = validator
+        self.ledger = _CustodianLedgerView(custodian)
+        self._custodian = custodian
+        self.approval_required = approval_required
+        # one DER parse for the custodian's static identity, not one per tx
+        self._custodian_verifier = X509Verifier.from_identity(
+            bytes(custodian.keys.identity))
+
+    def process_request(self, tx_id: str, request_raw: bytes) -> CommitEvent:
+        if self.approval_required:
+            try:
+                approval = self._custodian.request_approval(tx_id,
+                                                            request_raw)
+            except CustodianError as e:
+                # fan the rejection out like the chaincode path does, so
+                # every node's finality listener cleans up pending state
+                return self._custodian.emit_invalid(tx_id, str(e))
+            # the approval is the custodian's signature; verify before
+            # submitting (client-side sanity, approval.go response check)
+            self._custodian_verifier.verify(
+                _approval_digest(tx_id, request_raw), approval)
+        try:
+            return self._custodian.broadcast(tx_id, request_raw)
+        except CustodianError as e:
+            # broadcast exhaustion must surface as an INVALID event, never
+            # an exception: node.execute only releases the selector locks
+            # on a returned non-VALID event
+            return self._custodian.emit_invalid(tx_id, str(e))
+
+    def query_public_params(self) -> bytes | None:
+        return self._custodian.query_public_params()

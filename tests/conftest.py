@@ -21,7 +21,14 @@ raise_stack_limit()
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_parallel_codegen_split_count" not in flags:
+    # Single-core host: parallel LLVM codegen buys nothing and its extra
+    # compiler threads/memory are implicated in nondeterministic SIGSEGVs
+    # while compiling the big MSM kernels (faulthandler dumps inside
+    # _compile_and_write_cache). One split = one stable compile.
+    flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402  (after XLA_FLAGS so the CPU client sees it)
 
