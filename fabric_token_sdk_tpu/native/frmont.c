@@ -289,6 +289,10 @@ static PyObject *py_fold_coeffs(PyObject *self, PyObject *args) {
     Py_ssize_t kc, ki;
     if (unpack_arg(cho, &ch, &kc, "challenges") < 0) return NULL;
     if (unpack_arg(invo, &inv, &ki, "inverses") < 0) return NULL;
+    if (kc < 0 || kc > 62) { /* bound before the shift: UB otherwise */
+        PyErr_SetString(PyExc_ValueError, "rounds out of range");
+        return NULL;
+    }
     if (kc != ki || (((Py_ssize_t)1) << kc) != n) {
         PyErr_SetString(PyExc_ValueError, "need 2^rounds == n");
         return NULL;
@@ -472,7 +476,7 @@ static PyObject *py_points_to_limbs(PyObject *self, PyObject *args) {
  * whole computation in Montgomery form. Pinned 1:1 against the Python
  * implementations by tests/test_frmont_native.py; layouts:
  *   phase_a -> y_pows(n) ++ yinv_pows(n) ++ [pol_eval] ++ k_fixed(n+2)
- *   phase_b -> fixed(2n+5) ++ var(2n+2r+5)
+ *   phase_b -> fixed(2n+5) ++ var(2r+5)
  */
 
 static void read_scalar(const u64 *buf, Py_ssize_t idx, u64 out[4]) {
@@ -560,7 +564,8 @@ static PyObject *py_phase_a(PyObject *self, PyObject *args) {
 
 /* phase_b(n, rounds, scalars, yinv_pows, round_ch, round_inv)
  * scalars packed: [a, b, z, x, x_ipa, ip, tau, delta, pol_eval]
- * returns fixed(2n+5) ++ var(2n+2r+5), packed standard form */
+ * returns fixed(2n+5) ++ var(2r+5), packed standard form
+ * (var layout: D, C, L_r..., R_r..., T1, T2, Com = 2 + 2r + 3) */
 static PyObject *py_phase_b(PyObject *self, PyObject *args) {
     Py_ssize_t n, rounds;
     PyObject *so, *yo, *co, *io;
@@ -572,6 +577,10 @@ static PyObject *py_phase_b(PyObject *self, PyObject *args) {
     if (unpack_arg(yo, &yinv, &ky, "yinv_pows") < 0) return NULL;
     if (unpack_arg(co, &rch, &kc, "round_ch") < 0) return NULL;
     if (unpack_arg(io, &rinv, &ki, "round_inv") < 0) return NULL;
+    if (rounds < 0 || rounds > 62) { /* bound before the shift: UB */
+        PyErr_SetString(PyExc_ValueError, "phase_b: rounds out of range");
+        return NULL;
+    }
     if (ks != 9 || ky != n || kc != rounds || ki != rounds ||
         (((Py_ssize_t)1) << rounds) != n) {
         PyErr_SetString(PyExc_ValueError, "phase_b: shape mismatch");

@@ -267,17 +267,30 @@ def _tree_sum_shrink(pts: jnp.ndarray) -> jnp.ndarray:
     return pts[..., 0, :, :]
 
 
-def _to_byte_planes(tables: jnp.ndarray) -> jnp.ndarray:
-    """(..., 3, 16) uint32 limb tables -> (..., 96) bf16 byte planes.
+def plane_dtype() -> jnp.dtype:
+    """Element type for the one-hot byte-plane selection matmuls.
 
-    Each 16-bit limb splits into (lo, hi) bytes; integers <= 255 are exact
-    in bf16, so a one-hot selection matmul over these planes is bit-exact
-    on the MXU at its native (single-pass bf16) precision. f32 planes are
-    NOT safe: TPU matmuls truncate f32 operands to bf16 by default, and
-    16-bit limb values lose their low bits."""
+    bf16 on TPU: integers <= 255 are exact in bf16, so the selection rides
+    the MXU at its native single-pass precision; f32 planes are NOT safe
+    there because TPU matmuls truncate f32 operands to bf16 by default and
+    16-bit limb values would lose their low bits. f32 on CPU: XLA:CPU's
+    DotThunk cannot execute bf16 x bf16 -> f32 dots at all, and f32
+    selection is equally exact (values <= 255, single 1 per one-hot row).
+    Resolved at trace time from the default backend; tables and one-hot
+    operands both funnel through this so they cannot disagree in-process.
+    """
+    return jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+
+def _to_byte_planes(tables: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3, 16) uint32 limb tables -> (..., 96) byte planes.
+
+    Each 16-bit limb splits into (lo, hi) bytes; dtype per plane_dtype()
+    (bf16 on TPU for MXU exactness, f32 on CPU for dispatchability)."""
     flat = tables.reshape(*tables.shape[:-2], 3 * L.NLIMBS)
-    lo = (flat & 0xFF).astype(jnp.bfloat16)
-    hi = ((flat >> 8) & 0xFF).astype(jnp.bfloat16)
+    dt = plane_dtype()
+    lo = (flat & 0xFF).astype(dt)
+    hi = ((flat >> 8) & 0xFF).astype(dt)
     return jnp.concatenate([lo, hi], axis=-1)
 
 
@@ -299,7 +312,7 @@ def _select_onehot(tables_planes: jnp.ndarray, digits: jnp.ndarray,
     plane values <= 255), riding the MXU instead of HBM scatter/gather,
     which is the difference between ~ms and ~100s of ms per pass on TPU.
     """
-    onehot = jax.nn.one_hot(digits, entries, dtype=jnp.bfloat16)
+    onehot = jax.nn.one_hot(digits, entries, dtype=plane_dtype())
     sel = jnp.einsum("...tv,...tvc->...tc", onehot, tables_planes,
                      preferred_element_type=jnp.float32)
     return _from_byte_planes(sel)
@@ -353,9 +366,10 @@ def fixed_base_tables(points: jnp.ndarray) -> jnp.ndarray:
 def fixed_base_planes(points: jnp.ndarray) -> jnp.ndarray:
     """Precompute the byte-plane form of the 8-bit fixed-base tables.
 
-    points: (T, 3, 16) -> (T, 32, 256, 96) bf16 — what the fixed-base
-    kernels consume. Built once per PublicParams set (half the memory of
-    the uint32 tables and no per-call conversion)."""
+    points: (T, 3, 16) -> (T, 32, 256, 96) in plane_dtype() — what the
+    fixed-base kernels consume. Built once per PublicParams set (bf16
+    planes are the same memory as the uint32 tables — 96 x 2 B vs
+    48 x 4 B — but need no per-call conversion)."""
     return _to_byte_planes(fixed_base_tables(points))
 
 
@@ -369,7 +383,7 @@ def _fixed_base_select(table_planes: jnp.ndarray,
     (see _select_onehot for why byte-plane selection is exact)."""
     digits = window_digits8(scalars)               # (..., T, 32)
     onehot = jax.nn.one_hot(digits.astype(jnp.int32), 256,
-                            dtype=jnp.bfloat16)    # (..., T, 32, 256)
+                            dtype=plane_dtype())   # (..., T, 32, 256)
     sel = jnp.einsum("...twv,twvc->...twc", onehot, table_planes,
                      preferred_element_type=jnp.float32)
     return _from_byte_planes(sel)

@@ -127,6 +127,11 @@ class PGConnection:
         self._conn = dbapi_conn
         self._driver = driver_module
         self._pks = pks
+        # Uncommitted-DML flag: a SELECT normally ends its implicit read
+        # transaction with a rollback (no idle-in-transaction), but doing
+        # that after uncommitted writes would silently discard them — a
+        # read-modify-write store would pass on sqlite and lose data here.
+        self._dirty = False
 
     def execute(self, sql: str, params=()):
         translated = translate_query(sql, self._pks)
@@ -135,18 +140,24 @@ class PGConnection:
             cur.execute(translated, tuple(params))
         except self._driver.IntegrityError as e:
             self._conn.rollback()
+            self._dirty = False
             raise sqlite3.IntegrityError(str(e)) from e
         except Exception:
             # any other failure would leave a real postgres connection in
             # an aborted transaction, wedging every later statement
             self._conn.rollback()
+            self._dirty = False
             raise
         if translated.lstrip().upper().startswith("SELECT"):
             # end the implicit read transaction (no idle-in-transaction);
-            # rows are prefetched so the caller's fetch still works
+            # rows are prefetched so the caller's fetch still works —
+            # UNLESS uncommitted DML is pending on this connection, in
+            # which case the transaction must stay open until commit()
             rows = cur.fetchall()
-            self._conn.rollback()
+            if not self._dirty:
+                self._conn.rollback()
             return _Prefetched(rows)
+        self._dirty = True
         return _Cursorish(cur)
 
     def executemany(self, sql: str, seq_of_params):
@@ -156,20 +167,31 @@ class PGConnection:
                             [tuple(p) for p in seq_of_params])
         except self._driver.IntegrityError as e:
             self._conn.rollback()
+            self._dirty = False
             raise sqlite3.IntegrityError(str(e)) from e
         except Exception:
             self._conn.rollback()
+            self._dirty = False
             raise
+        self._dirty = True
         return _Cursorish(cur)
 
     def executescript(self, script: str):
         cur = self._conn.cursor()
-        for stmt in translate_schema(script).split(";"):
-            if stmt.strip():
-                cur.execute(stmt)
+        try:
+            for stmt in translate_schema(script).split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+        except Exception:
+            # same aborted-transaction hygiene as execute()
+            self._conn.rollback()
+            self._dirty = False
+            raise
+        self._dirty = True
 
     def commit(self):
         self._conn.commit()
+        self._dirty = False
 
     def close(self):
         self._conn.close()
