@@ -10,6 +10,11 @@ Behavioral mirror of reference cmd/tokengen (main.go:46-51 command set):
   gen fabtoken  — plaintext driver params (--precision).
   pp print      — inspect a serialized public-parameters file.
   update        — bump/refresh params preserving identities.
+  certifier-keygen — certifier key pair for a pp set
+                  (cobra/certfier/keypairgen.go:27-90).
+  artifacts gen — NWO topology artifacts: per-node identities + wired pp
+                  + manifest consumable by harness.nwo.Platform
+                  (cobra/artifactgen/gen + utils.go WriteTopologies).
   version       — print the framework version.
 
 Identities (issuers/auditors) are registered from PEM/DER public-key files
@@ -135,6 +140,102 @@ def _update(args) -> int:
     return 0
 
 
+def _certifier_keygen(args) -> int:
+    """cobra/certfier/keypairgen.go: validate the pp, emit a key pair."""
+    from ..services.identity.x509 import keypair_to_pem, new_signing_identity
+
+    raw = pathlib.Path(args.pppath).read_bytes()
+    ident = json.loads(raw).get("identifier", "")
+    if args.driver == "dlog" and ident != "zkatdlog":
+        print(f"public parameters are [{ident}], not zkatdlog",
+              file=sys.stderr)
+        return 2
+    if args.driver == "fabtoken" and ident != "fabtoken":
+        print(f"public parameters are [{ident}], not fabtoken",
+              file=sys.stderr)
+        return 2
+    kp = new_signing_identity()
+    priv, pub = keypair_to_pem(kp)
+    out = pathlib.Path(args.output)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "certifier_sk.pem").write_bytes(priv)
+    (out / "certifier_pk.pem").write_bytes(pub)
+    print(str(out / "certifier_sk.pem"))
+    print(str(out / "certifier_pk.pem"))
+    return 0
+
+
+def _artifacts_gen(args) -> int:
+    """artifactgen: topology file -> runnable NWO artifacts.
+
+    Topology JSON: {"driver": "fabtoken"|"zkatdlog", "precision": N,
+    "bit_length": N, "nodes": [{"name", "role", "idemix"?}, ...]}.
+    Emits per-node key PEMs, the wired public parameters (issuer/auditor
+    identities registered), and manifest.json for Platform.from_artifacts.
+    """
+    from ..services.identity.x509 import keypair_to_pem, new_signing_identity
+
+    topo = json.loads(pathlib.Path(args.topology).read_text())
+    driver = topo.get("driver", "fabtoken")
+    precision = int(topo.get("precision", 64))
+    bit_length = int(topo.get("bit_length", 16))
+    nodes = topo.get("nodes", [])
+    if not nodes:
+        print("topology has no nodes", file=sys.stderr)
+        return 2
+
+    out = pathlib.Path(args.output)
+    (out / "crypto").mkdir(parents=True, exist_ok=True)
+    identities: dict[str, bytes] = {}
+    for node in nodes:
+        kp = new_signing_identity()
+        priv, pub = keypair_to_pem(kp)
+        ndir = out / "crypto" / node["name"]
+        ndir.mkdir(parents=True, exist_ok=True)
+        (ndir / "sk.pem").write_bytes(priv)
+        (ndir / "pk.pem").write_bytes(pub)
+        identities[node["name"]] = bytes(kp.identity)
+
+    issuers = [n["name"] for n in nodes if n.get("role") == "issuer"]
+    auditors = [n["name"] for n in nodes if n.get("role") == "auditor"]
+    if len(auditors) > 1:
+        # single-auditor pp (same rule Platform._make_pp applies); refuse
+        # rather than silently dropping one
+        print(f"topology declares {len(auditors)} auditors; at most one "
+              "is supported", file=sys.stderr)
+        return 2
+    if driver == "zkatdlog":
+        from ..crypto import setup as dlog_setup
+
+        pp = dlog_setup.setup(bit_length)
+        for name in issuers:
+            pp.add_issuer(identities[name])
+        if auditors:
+            pp.add_auditor(identities[auditors[0]])
+    else:
+        from ..core import fabtoken
+
+        pp = fabtoken.setup(precision)
+        for name in issuers:
+            pp.issuer_ids.append(identities[name])
+        if auditors:
+            pp.auditor = identities[auditors[0]]
+    (out / "pp.json").write_bytes(pp.serialize())
+
+    manifest = {
+        "driver": driver,
+        "precision": precision,
+        "bit_length": bit_length,
+        "nodes": [{"name": n["name"], "role": n.get("role", "owner"),
+                   "idemix": bool(n.get("idemix", False))} for n in nodes],
+        "pp": "pp.json",
+        "crypto_dir": "crypto",
+    }
+    (out / "manifest.json").write_text(json.dumps(manifest, indent=2))
+    print(str(out / "manifest.json"))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tokengen")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -172,6 +273,23 @@ def build_parser() -> argparse.ArgumentParser:
     upd = sub.add_parser("update", help="refresh serialized parameters")
     upd.add_argument("path")
     upd.set_defaults(fn=_update)
+
+    ck = sub.add_parser("certifier-keygen",
+                        help="generate a token certifier key pair")
+    ck.add_argument("--driver", "-d", default="dlog",
+                    choices=["dlog", "fabtoken"])
+    ck.add_argument("--pppath", "-p", required=True,
+                    help="path to the public parameters file")
+    ck.add_argument("--output", "-o", default=".")
+    ck.set_defaults(fn=_certifier_keygen)
+
+    art = sub.add_parser("artifacts", help="NWO artifact generation")
+    artsub = art.add_subparsers(dest="artcmd", required=True)
+    artgen = artsub.add_parser("gen", help="generate topology artifacts")
+    artgen.add_argument("--topology", "-t", required=True,
+                        help="topology JSON file")
+    artgen.add_argument("--output", "-o", default="artifacts")
+    artgen.set_defaults(fn=_artifacts_gen)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=lambda a: print(f"tokengen version {VERSION}") or 0)

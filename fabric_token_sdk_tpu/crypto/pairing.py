@@ -1,0 +1,408 @@
+"""BN254 optimal-ate pairing on the host: Fp2/Fp6/Fp12 tower + G2.
+
+Restores the reference's pairing-based capability surface — the Idemix
+credential chain proves possession of an issuer signature whose verification
+equation is a pairing product (reference token/services/identity/idemix/
+km.go:46-365 via IBM/idemix and mathlib's bn254 pairing). Pairings run
+host-side only, per enrollment / per identity check — never inside the TPU
+batch verification path (SURVEY.md §7 keeps pairings off the hot path).
+
+Tower (standard alt_bn128 construction):
+    Fp2  = Fp[u]  / (u^2 + 1)
+    Fp6  = Fp2[v] / (v^3 - xi),  xi = 9 + u
+    Fp12 = Fp6[w] / (w^2 - v)          => w^6 = xi
+G2 lives on the D-type sextic twist E'(Fp2): y^2 = x^3 + 3/xi, untwisted
+into E(Fp12) by (x, y) -> (x*w^2, y*w^3).
+
+Representation: Fp2 elements are (a0, a1) int tuples; Fp6 three Fp2s; Fp12
+two Fp6s. Pure-Python big-int arithmetic — simple, auditable, and fast
+enough (~100 ms/pairing) for the enrollment-time paths that need it.
+"""
+
+from __future__ import annotations
+
+from .bn254 import P
+from .bn254 import R as _R_ORDER
+
+# BN parameter t: p = 36t^4 + 36t^3 + 24t^2 + 6t + 1.
+BN_T = 4965661367192848881
+ATE_LOOP = 6 * BN_T + 2  # 29793968203157093288 (> 0: no final conjugation)
+
+# ---------------------------------------------------------------------------
+# Fp2 = Fp[u]/(u^2+1)
+# ---------------------------------------------------------------------------
+
+FP2_ZERO = (0, 0)
+FP2_ONE = (1, 0)
+XI = (9, 1)  # the Fp6/Fp12 non-residue 9 + u
+
+
+def fp2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fp2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fp2_neg(a):
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def fp2_mul(a, b):
+    # (a0 + a1 u)(b0 + b1 u) with u^2 = -1 (3-mul Karatsuba)
+    t0 = a[0] * b[0]
+    t1 = a[1] * b[1]
+    t2 = (a[0] + a[1]) * (b[0] + b[1])
+    return ((t0 - t1) % P, (t2 - t0 - t1) % P)
+
+
+def fp2_sqr(a):
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    t = a[0] * a[1]
+    return ((a[0] + a[1]) * (a[0] - a[1]) % P, (t + t) % P)
+
+
+def fp2_scalar(a, k: int):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fp2_conj(a):
+    return (a[0], (-a[1]) % P)
+
+
+def fp2_inv(a):
+    # 1/(a0 + a1 u) = (a0 - a1 u)/(a0^2 + a1^2)
+    den = (a[0] * a[0] + a[1] * a[1]) % P
+    if den == 0:
+        raise ZeroDivisionError("fp2 inverse of zero")
+    inv = pow(den, P - 2, P)
+    return (a[0] * inv % P, (-a[1]) * inv % P)
+
+
+def fp2_pow(a, e: int):
+    out = FP2_ONE
+    while e:
+        if e & 1:
+            out = fp2_mul(out, a)
+        a = fp2_sqr(a)
+        e >>= 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v]/(v^3 - xi)
+# ---------------------------------------------------------------------------
+
+FP6_ZERO = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+def _mul_xi(a):
+    """a * (9 + u) for a in Fp2."""
+    return ((9 * a[0] - a[1]) % P, (9 * a[1] + a[0]) % P)
+
+
+def fp6_add(a, b):
+    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(a, b):
+    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(a):
+    return tuple(fp2_neg(x) for x in a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    # Toom/Karatsuba-style interpolation (v^3 = xi)
+    c0 = fp2_add(t0, _mul_xi(fp2_sub(
+        fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), fp2_add(t1, t2))))
+    c1 = fp2_add(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)),
+                         fp2_add(t0, t1)), _mul_xi(t2))
+    c2 = fp2_add(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)),
+                         fp2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    """a * v: (a0, a1, a2) -> (xi*a2, a0, a1)."""
+    return (_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = fp2_sub(fp2_sqr(a0), _mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    t = fp2_add(_mul_xi(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2))),
+                fp2_mul(a0, c0))
+    tinv = fp2_inv(t)
+    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+FP12_ONE = (FP6_ONE, FP6_ZERO)
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_sub(a, b):
+    return (fp6_sub(a[0], b[0]), fp6_sub(a[1], b[1]))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    """Conjugation over Fp6 (w -> -w) = x^(p^6)."""
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    t = fp6_inv(fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1))))
+    return (fp6_mul(a0, t), fp6_neg(fp6_mul(a1, t)))
+
+
+def fp12_pow(a, e: int):
+    out = FP12_ONE
+    while e:
+        if e & 1:
+            out = fp12_mul(out, a)
+        a = fp12_sqr(a)
+        e >>= 1
+    return out
+
+
+def fp12_scalar_fp(a, k: int):
+    """Multiply an Fp12 element by a scalar in Fp."""
+    return (tuple(fp2_scalar(c, k) for c in a[0]),
+            tuple(fp2_scalar(c, k) for c in a[1]))
+
+
+# Frobenius: coefficient of the v^j w^e basis slot (w-exponent m = 2j+e)
+# picks up xi^(m(p-1)/6) after conjugating the Fp2 coefficient
+# (w^p = w * xi^((p-1)/6) since w^6 = xi and 6 | p-1).
+_FROB_GAMMA = [fp2_pow(XI, m * (P - 1) // 6) for m in range(6)]
+
+
+def fp12_frobenius(a):
+    out0, out1 = [], []
+    for j in range(3):
+        out0.append(fp2_mul(fp2_conj(a[0][j]), _FROB_GAMMA[2 * j]))
+        out1.append(fp2_mul(fp2_conj(a[1][j]), _FROB_GAMMA[2 * j + 1]))
+    return (tuple(out0), tuple(out1))
+
+
+# ---------------------------------------------------------------------------
+# G2: affine points over Fp2 on the twist y^2 = x^3 + 3/xi
+# ---------------------------------------------------------------------------
+
+B2 = fp2_mul((3, 0), fp2_inv(XI))  # twist coefficient b' = 3/(9+u)
+
+G2_GENERATOR = (
+    (10857046999023057135944570762232829481370756359578518086990519993285655852781,
+     11559732032986387107991004021392285783925812861821192530917403151452391805634),
+    (8495653923123431417604973247489272438418190587263600148770280649306958101930,
+     4082367875863433681332203403145435568316851327593401208105741076214120093531),
+)
+
+# G2 identity is None (affine representation, matching bn254.G1's style).
+
+
+def g2_is_on_curve(q) -> bool:
+    if q is None:
+        return True
+    x, y = q
+    return fp2_sqr(y) == fp2_add(fp2_mul(fp2_sqr(x), x), B2)
+
+
+def g2_neg(q):
+    if q is None:
+        return None
+    return (q[0], fp2_neg(q[1]))
+
+
+def g2_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if fp2_add(y1, y2) == FP2_ZERO:
+            return None
+        lam = fp2_mul(fp2_scalar(fp2_sqr(x1), 3),
+                      fp2_inv(fp2_scalar(y1, 2)))
+    else:
+        lam = fp2_mul(fp2_sub(y2, y1), fp2_inv(fp2_sub(x2, x1)))
+    x3 = fp2_sub(fp2_sub(fp2_sqr(lam), x1), x2)
+    y3 = fp2_sub(fp2_mul(lam, fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_double(q):
+    return g2_add(q, q)
+
+
+def g2_mul(q, k: int):
+    if k < 0:
+        return g2_neg(g2_mul(q, -k))
+    out = None
+    add = q
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out
+
+
+def g2_in_subgroup(q) -> bool:
+    """Order-r check (the twist has cofactor > 1, unlike G1)."""
+    return g2_is_on_curve(q) and g2_mul(q, _R_ORDER) is None
+
+
+# ---------------------------------------------------------------------------
+# Optimal ate pairing
+# ---------------------------------------------------------------------------
+
+def _untwist(q):
+    """E'(Fp2) -> E(Fp12): (x, y) -> (x w^2, y w^3).
+
+    w^2 = v, so x w^2 = (0, x, 0) in the Fp6 'even' part; w^3 = v w, so
+    y w^3 = ((0, y, 0)) in the 'odd' part."""
+    x, y = q
+    return (((FP2_ZERO, x, FP2_ZERO), FP6_ZERO),
+            (FP6_ZERO, (FP2_ZERO, y, FP2_ZERO)))
+
+
+def _embed_fp(c: int):
+    """Fp scalar -> Fp12."""
+    return (((c % P, 0), FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+def _pt12_eq(a, b):
+    return a == b
+
+
+def _line(t, q, p_embed):
+    """Evaluate the line through t, q (E(Fp12) affine) at the embedded G1
+    point, returning (value, t+q). Vertical lines evaluate into the Fp6
+    subfield, which the final exponentiation kills — standard even-degree
+    denominator elimination — so they are skipped (value 1)."""
+    xp, yp = p_embed
+    x1, y1 = t
+    x2, y2 = q
+    if x1 == x2 and y1 == y2:
+        num = fp12_scalar_fp(fp12_sqr(x1), 3)
+        lam = fp12_mul(num, fp12_inv(fp12_scalar_fp(y1, 2)))
+    elif x1 == x2:
+        return FP12_ONE, None  # vertical: subfield value, point at infinity
+    else:
+        lam = fp12_mul(fp12_sub(y2, y1), fp12_inv(fp12_sub(x2, x1)))
+    # l(P) = lam*(xp - x1) - (yp - y1)
+    val = fp12_sub(fp12_mul(lam, fp12_sub(xp, x1)), fp12_sub(yp, y1))
+    x3 = fp12_sub(fp12_sub(fp12_sqr(lam), x1), x2)
+    y3 = fp12_sub(fp12_mul(lam, fp12_sub(x1, x3)), y1)
+    return val, (x3, y3)
+
+
+def _pt12_frobenius(q):
+    return (fp12_frobenius(q[0]), fp12_frobenius(q[1]))
+
+
+def _pt12_neg(q):
+    zero = (FP6_ZERO, FP6_ZERO)
+    return (q[0], fp12_sub(zero, q[1]))
+
+
+def miller_loop(p, q) -> tuple:
+    """Miller loop f_{6t+2,Q}(P) * line corrections (optimal ate, BN254).
+
+    p: bn254.G1 (affine host point); q: G2 affine pair over Fp2.
+    Returns an Fp12 element — run final_exponentiation (or accumulate a
+    product of loops first) to land in GT.
+    """
+    if p is None or q is None:
+        return FP12_ONE
+    p_embed = (_embed_fp(p.x), _embed_fp(p.y))
+    q12 = _untwist(q)
+    f = FP12_ONE
+    t = q12
+    for i in range(ATE_LOOP.bit_length() - 2, -1, -1):
+        val, t = _line(t, t, p_embed)
+        f = fp12_mul(fp12_sqr(f), val)
+        if (ATE_LOOP >> i) & 1:
+            val, t = _line(t, q12, p_embed)
+            f = fp12_mul(f, val)
+    # the two optimal-ate correction lines with pi(Q) and -pi^2(Q)
+    q1 = _pt12_frobenius(q12)
+    q2 = _pt12_neg(_pt12_frobenius(q1))
+    val, t = _line(t, q1, p_embed)
+    f = fp12_mul(f, val)
+    val, _ = _line(t, q2, p_embed)
+    f = fp12_mul(f, val)
+    return f
+
+
+# hard-part exponent (p^4 - p^2 + 1) / r of the final exponentiation
+_HARD_EXP = (P ** 4 - P ** 2 + 1) // _R_ORDER
+
+
+def final_exponentiation(f) -> tuple:
+    """f^((p^12-1)/r): easy part via conjugation/Frobenius, hard part by
+    direct square-and-multiply (simple > clever here; ~1000 Fp12 ops)."""
+    # easy: f^(p^6-1) = conj(f) * f^-1, then ^(p^2+1)
+    e = fp12_mul(fp12_conj(f), fp12_inv(f))
+    e = fp12_mul(fp12_frobenius(fp12_frobenius(e)), e)
+    return fp12_pow(e, _HARD_EXP)
+
+
+def pairing(p, q) -> tuple:
+    """e(P, Q) for P in G1 (bn254.G1), Q in G2. Returns an Fp12 element."""
+    return final_exponentiation(miller_loop(p, q))
+
+
+def pairing_product_is_one(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1, with a single shared final exponentiation."""
+    acc = FP12_ONE
+    for p, q in pairs:
+        acc = fp12_mul(acc, miller_loop(p, q))
+    return final_exponentiation(acc) == FP12_ONE
+
+
+def gt_eq(p1, q1, p2, q2) -> bool:
+    """e(P1, Q1) == e(P2, Q2) without computing either final exp twice:
+    product with one side negated must be 1."""
+    from .bn254 import g1_neg
+
+    return pairing_product_is_one([(p1, q1), (g1_neg(p2), q2)])

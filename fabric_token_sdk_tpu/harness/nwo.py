@@ -250,6 +250,8 @@ class NodeSpec:
     name: str
     role: str = "owner"          # "owner" | "issuer" | "auditor"
     idemix: bool = False         # pseudonymous owner wallet
+    key_pem: str = ""            # path to a pre-generated sk.pem (tokengen
+    #                              artifacts); empty -> fresh key at boot
 
 
 def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
@@ -265,7 +267,14 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
     from ..services.ttx import Transaction
 
     spec = NodeSpec(**spec_dict)
-    keys = new_signing_identity()
+    if spec.key_pem:
+        from pathlib import Path
+
+        from ..services.identity.x509 import keypair_from_pem
+
+        keys = keypair_from_pem(Path(spec.key_pem).read_bytes())
+    else:
+        keys = new_signing_identity()
 
     # GENERATE phase: report identity material
     control["out"].put(("identity", spec.name, bytes(keys.identity)))
@@ -362,11 +371,13 @@ class Platform:
     """Boots the topology and drives it (platform.go:112-246 role)."""
 
     def __init__(self, specs: list[NodeSpec], precision: int = 64,
-                 driver: str = "fabtoken", bit_length: int = 16):
+                 driver: str = "fabtoken", bit_length: int = 16,
+                 pp_raw: bytes | None = None):
         self.specs = specs
         self.precision = precision
         self.driver = driver
         self.bit_length = bit_length
+        self._pp_override = pp_raw   # tokengen-artifacts pp, if any
         self._ctx = mp.get_context("spawn")
         self._mgr = self._ctx.Manager()
         self._procs: dict[str, mp.Process] = {}
@@ -440,7 +451,30 @@ class Platform:
                   if self.driver == "fabtoken" else self.bit_length,
                   "auditor": auditor}))
 
+    @classmethod
+    def from_artifacts(cls, artifacts_dir) -> "Platform":
+        """Boot a topology from `tokengen artifacts gen` output: node keys
+        and the pp come from disk instead of being generated at start
+        (the reference flow: artifactgen writes, NWO consumes)."""
+        import json
+        from pathlib import Path
+
+        root = Path(artifacts_dir)
+        manifest = json.loads((root / "manifest.json").read_text())
+        specs = [NodeSpec(name=n["name"], role=n.get("role", "owner"),
+                          idemix=bool(n.get("idemix", False)),
+                          key_pem=str(root / manifest["crypto_dir"]
+                                      / n["name"] / "sk.pem"))
+                 for n in manifest["nodes"]]
+        return cls(specs,
+                   precision=int(manifest.get("precision", 64)),
+                   driver=manifest.get("driver", "fabtoken"),
+                   bit_length=int(manifest.get("bit_length", 16)),
+                   pp_raw=(root / manifest["pp"]).read_bytes())
+
     def _make_pp(self, identities: dict) -> bytes:
+        if self._pp_override is not None:
+            return self._pp_override
         issuers = [identities[s.name] for s in self.specs
                    if s.role == "issuer"]
         auditors = [identities[s.name] for s in self.specs

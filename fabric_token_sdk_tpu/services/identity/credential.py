@@ -1,0 +1,367 @@
+"""Pairing-based anonymous credentials (Idemix-style BBS+ over BN254).
+
+Restores the reference capability the round-2 dlog-pseudonym layer lacked:
+an ISSUER certifies a user's attributes once, and every pseudonymous
+identity carries an unlinkable zero-knowledge proof of possessing that
+credential — so only enrolled users can mint pseudonyms (reference
+token/services/identity/idemix/km.go:46-365, which proves possession of an
+IBM/idemix CL credential; the scheme here is the BBS+ form of the same
+construction over the same curve family).
+
+Scheme (all group work host-side BN254, crypto/pairing.py):
+
+  Issuer key:  x <- Zr,  w = g2^x; generators HSk, HRand, HAttr_i
+               (nothing-up-my-sleeve hash-to-curve).
+  Credential on (sk, attrs):  e, s <- Zr,
+               B = g1 * HSk^sk * HRand^s * prod_i HAttr_i^{m_i}
+               A = B^{1/(e+x)}            — classic BBS+ signature (A, e, s).
+  Presentation bound to a pseudonym Nym = HSk^sk * HRand^{rNym} and a
+  message: randomize A' = A^{r1}, Abar = B^{r1} * A'^{-e},
+  d = B^{r1} * HRand^{-r2}, s' = s - r2*r3 (r3 = 1/r1), then prove in ZK
+      (i)   Abar / d         = A'^{-e} * HRand^{r2}
+      (ii)  g1 * prod_D HAttr_i^{m_i}
+                             = d^{r3} * HRand^{-s'} * HSk^{-sk}
+                               * prod_hidden HAttr_i^{-m_i}
+      (iii) Nym              = HSk^sk * HRand^{rNym}
+  with one shared Fiat-Shamir challenge (sk is bound across (ii) and
+  (iii)). The verifier additionally checks the pairing equation
+      e(A', w) == e(Abar, g2)   and   A' != identity.
+  Two transactions by the same holder are unlinkable: every element the
+  verifier sees is uniformly re-randomized per presentation.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+from ...crypto import bn254, pairing as pr
+from ...crypto import serialization as ser
+from ...crypto.bn254 import (G1, fr_add, fr_inv, fr_mul, fr_neg, fr_rand,
+                             fr_sub, g1_add, g1_mul, g1_neg, hash_to_g1,
+                             hash_to_zr)
+
+R = bn254.R
+
+
+class CredentialError(Exception):
+    pass
+
+
+#: The sk generator, shared with the idemix pseudonym layer (idemix.HSK_GEN
+#: is this same point): credential-mode masters are HSK^sk and the Nym
+#: equation in presentations must use the identical generator.
+H_SK = hash_to_g1(b"fabric_token_sdk_tpu.idemix.cred.hsk")
+
+
+def _g2_to_bytes(q) -> bytes:
+    """Twist point -> 128-byte encoding (x0||x1||y0||y1, 32-byte BE each);
+    identity encodes as all-zero (mirrors the G1 convention)."""
+    if q is None:
+        return bytes(128)
+    (x0, x1), (y0, y1) = q
+    return b"".join(v.to_bytes(32, "big") for v in (x0, x1, y0, y1))
+
+
+def _g2_from_bytes(raw: bytes):
+    if len(raw) != 128:
+        raise CredentialError("bad G2 encoding length")
+    if raw == bytes(128):
+        return None
+    v = [int.from_bytes(raw[i * 32:(i + 1) * 32], "big") for i in range(4)]
+    q = ((v[0], v[1]), (v[2], v[3]))
+    if not pr.g2_in_subgroup(q):
+        raise CredentialError("G2 point not in the r-torsion subgroup")
+    return q
+
+
+def attr_to_zr(value: bytes | str) -> int:
+    """Attribute encoding: hash into the scalar field."""
+    if isinstance(value, str):
+        value = value.encode()
+    return hash_to_zr(b"idemix.cred.attr" + value)
+
+
+# ---------------------------------------------------------------------------
+# issuer key
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IssuerPublicKey:
+    w: object                    # g2^x
+    h_sk: G1
+    h_rand: G1
+    h_attrs: tuple               # one G1 generator per attribute slot
+
+    def digest_bytes(self) -> bytes:
+        return (b"idemix.cred.ipk" + _g2_to_bytes(self.w)
+                + ser.g1_to_bytes(self.h_sk) + ser.g1_to_bytes(self.h_rand)
+                + b"".join(ser.g1_to_bytes(h) for h in self.h_attrs))
+
+
+@dataclass
+class IssuerKey:
+    x: int
+    public: IssuerPublicKey
+
+    @classmethod
+    def generate(cls, n_attrs: int, h_rand: G1 | None = None) -> "IssuerKey":
+        """Fresh issuer key. `h_rand` may be pinned to the pseudonym layer's
+        second generator so Nym audit info stays scheme-agnostic."""
+        x = fr_rand()
+        return cls(
+            x=x,
+            public=IssuerPublicKey(
+                w=pr.g2_mul(pr.G2_GENERATOR, x),
+                h_sk=H_SK,
+                h_rand=h_rand if h_rand is not None
+                else hash_to_g1(b"fabric_token_sdk_tpu.idemix.cred.hrand"),
+                h_attrs=tuple(
+                    hash_to_g1(b"fabric_token_sdk_tpu.idemix.cred.hattr"
+                               + i.to_bytes(4, "big"))
+                    for i in range(n_attrs)),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# issuance (blind in sk: the issuer never learns the user secret key)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CredentialRequest:
+    """User -> issuer: Nu = HSk^sk plus a Schnorr PoK of sk."""
+
+    nu: G1
+    t: G1
+    z: int
+
+    @classmethod
+    def create(cls, ipk: IssuerPublicKey, sk: int,
+               nonce: bytes) -> "CredentialRequest":
+        nu = g1_mul(ipk.h_sk, sk)
+        rho = fr_rand()
+        t = g1_mul(ipk.h_sk, rho)
+        c = hash_to_zr(b"idemix.cred.req" + ipk.digest_bytes()
+                       + ser.g1_to_bytes(nu) + ser.g1_to_bytes(t) + nonce)
+        return cls(nu=nu, t=t, z=fr_add(rho, fr_mul(c, sk)))
+
+    def verify(self, ipk: IssuerPublicKey, nonce: bytes) -> None:
+        c = hash_to_zr(b"idemix.cred.req" + ipk.digest_bytes()
+                       + ser.g1_to_bytes(self.nu) + ser.g1_to_bytes(self.t)
+                       + nonce)
+        if g1_mul(ipk.h_sk, self.z) != g1_add(self.t, g1_mul(self.nu, c)):
+            raise CredentialError("credential request PoK invalid")
+
+
+@dataclass
+class Credential:
+    """BBS+ signature (A, e, s) over (sk, attrs); attrs stored alongside
+    in the clear like the reference credential blob (km.go attributes)."""
+
+    a: G1
+    e: int
+    s: int
+    attrs: tuple                 # Zr-encoded attribute values
+
+    def verify(self, ipk: IssuerPublicKey, sk: int) -> None:
+        """Holder-side validity check: e(A, w * g2^e) == e(B, g2)."""
+        b = _compute_b(ipk, sk, self.s, self.attrs)
+        lhs_q = pr.g2_add(ipk.w, pr.g2_mul(pr.G2_GENERATOR, self.e))
+        if not pr.gt_eq(self.a, lhs_q, b, pr.G2_GENERATOR):
+            raise CredentialError("credential signature invalid")
+
+
+def _compute_b(ipk: IssuerPublicKey, sk: int, s: int, attrs) -> G1:
+    b = g1_add(bn254.G1_GENERATOR, g1_mul(ipk.h_sk, sk))
+    b = g1_add(b, g1_mul(ipk.h_rand, s))
+    for h, m in zip(ipk.h_attrs, attrs):
+        b = g1_add(b, g1_mul(h, m))
+    return b
+
+
+def issue_credential(isk: IssuerKey, req: CredentialRequest, nonce: bytes,
+                     attrs) -> Credential:
+    """Issuer side: verify the request PoK, sign (Nu, attrs)."""
+    ipk = isk.public
+    if len(attrs) != len(ipk.h_attrs):
+        raise CredentialError("attribute count mismatch")
+    req.verify(ipk, nonce)
+    e, s = fr_rand(), fr_rand()
+    b = g1_add(bn254.G1_GENERATOR, req.nu)
+    b = g1_add(b, g1_mul(ipk.h_rand, s))
+    for h, m in zip(ipk.h_attrs, attrs):
+        b = g1_add(b, g1_mul(h, m))
+    a = g1_mul(b, fr_inv(fr_add(e, isk.x)))
+    return Credential(a=a, e=e, s=s, attrs=tuple(attrs))
+
+
+# ---------------------------------------------------------------------------
+# presentation
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Presentation:
+    """Unlinkable proof of credential possession bound to (Nym, message).
+
+    disclosed: {index: attr_value} revealed to the verifier; all other
+    attribute slots stay hidden inside the proof.
+    """
+
+    a_prime: G1
+    a_bar: G1
+    d: G1
+    disclosed: dict = field(default_factory=dict)
+    # Schnorr proof: challenge + responses
+    c: int = 0
+    s_e: int = 0
+    s_r2: int = 0
+    s_r3: int = 0
+    s_sprime: int = 0
+    s_sk: int = 0
+    s_rnym: int = 0
+    s_hidden: dict = field(default_factory=dict)   # index -> response
+
+    def serialize(self) -> bytes:
+        disc = ser.der_sequence(*[
+            ser.der_sequence(ser.der_octet_string(i.to_bytes(4, "big")),
+                             ser.der_octet_string(ser.zr_to_bytes(m)))
+            for i, m in sorted(self.disclosed.items())])
+        hid = ser.der_sequence(*[
+            ser.der_sequence(ser.der_octet_string(i.to_bytes(4, "big")),
+                             ser.der_octet_string(ser.zr_to_bytes(z)))
+            for i, z in sorted(self.s_hidden.items())])
+        return ser.der_sequence(
+            ser.der_octet_string(ser.g1_to_bytes(self.a_prime)),
+            ser.der_octet_string(ser.g1_to_bytes(self.a_bar)),
+            ser.der_octet_string(ser.g1_to_bytes(self.d)),
+            disc, hid,
+            *[ser.der_octet_string(ser.zr_to_bytes(v))
+              for v in (self.c, self.s_e, self.s_r2, self.s_r3,
+                        self.s_sprime, self.s_sk, self.s_rnym)])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "Presentation":
+        try:
+            seq = ser.DerReader(raw).read_sequence()
+            a_prime = ser.g1_from_bytes(seq.read_octet_string())
+            a_bar = ser.g1_from_bytes(seq.read_octet_string())
+            d = ser.g1_from_bytes(seq.read_octet_string())
+            disclosed, hidden = {}, {}
+            disc = seq.read_sequence()
+            while not disc.eof():
+                item = disc.read_sequence()
+                idx = int.from_bytes(item.read_octet_string(), "big")
+                disclosed[idx] = ser.zr_from_bytes(item.read_octet_string())
+            hid = seq.read_sequence()
+            while not hid.eof():
+                item = hid.read_sequence()
+                idx = int.from_bytes(item.read_octet_string(), "big")
+                hidden[idx] = ser.zr_from_bytes(item.read_octet_string())
+            vals = [ser.zr_from_bytes(seq.read_octet_string())
+                    for _ in range(7)]
+        except CredentialError:
+            raise
+        except Exception as exc:
+            raise CredentialError(f"malformed presentation: {exc}") from exc
+        return cls(a_prime=a_prime, a_bar=a_bar, d=d, disclosed=disclosed,
+                   c=vals[0], s_e=vals[1], s_r2=vals[2], s_r3=vals[3],
+                   s_sprime=vals[4], s_sk=vals[5], s_rnym=vals[6],
+                   s_hidden=hidden)
+
+
+def _presentation_challenge(ipk: IssuerPublicKey, a_prime, a_bar, d, nym,
+                            t1, t2, t3, disclosed: dict,
+                            message: bytes) -> int:
+    buf = [b"idemix.cred.present", ipk.digest_bytes()]
+    for p in (a_prime, a_bar, d, nym, t1, t2, t3):
+        buf.append(ser.g1_to_bytes(p))
+    for i, m in sorted(disclosed.items()):
+        buf.append(i.to_bytes(4, "big") + ser.zr_to_bytes(m))
+    buf.append(message)
+    return hash_to_zr(b"".join(buf))
+
+
+def present(ipk: IssuerPublicKey, cred: Credential, sk: int, nym: G1,
+            r_nym: int, disclose: set, message: bytes) -> Presentation:
+    """Build an unlinkable possession proof revealing `disclose` slots."""
+    attrs = cred.attrs
+    hidden_idx = [i for i in range(len(attrs)) if i not in disclose]
+    b = _compute_b(ipk, sk, cred.s, attrs)
+
+    r1 = 1 + secrets.randbelow(R - 1)
+    r2 = fr_rand()
+    r3 = fr_inv(r1)
+    a_prime = g1_mul(cred.a, r1)
+    a_bar = g1_add(g1_mul(b, r1), g1_neg(g1_mul(a_prime, cred.e)))
+    d = g1_add(g1_mul(b, r1), g1_neg(g1_mul(ipk.h_rand, r2)))
+    s_prime = fr_sub(cred.s, fr_mul(r2, r3))
+
+    # Schnorr commitments
+    rho_e, rho_r2, rho_r3 = fr_rand(), fr_rand(), fr_rand()
+    rho_sp, rho_sk, rho_rn = fr_rand(), fr_rand(), fr_rand()
+    rho_hidden = {i: fr_rand() for i in hidden_idx}
+    t1 = g1_add(g1_mul(a_prime, fr_neg(rho_e)), g1_mul(ipk.h_rand, rho_r2))
+    t2 = g1_add(g1_mul(d, rho_r3), g1_neg(g1_mul(ipk.h_rand, rho_sp)))
+    t2 = g1_add(t2, g1_neg(g1_mul(ipk.h_sk, rho_sk)))
+    for i in hidden_idx:
+        t2 = g1_add(t2, g1_neg(g1_mul(ipk.h_attrs[i], rho_hidden[i])))
+    t3 = g1_add(g1_mul(ipk.h_sk, rho_sk), g1_mul(ipk.h_rand, rho_rn))
+
+    disclosed = {i: attrs[i] for i in disclose}
+    c = _presentation_challenge(ipk, a_prime, a_bar, d, nym, t1, t2, t3,
+                                disclosed, message)
+    return Presentation(
+        a_prime=a_prime, a_bar=a_bar, d=d, disclosed=disclosed, c=c,
+        s_e=fr_add(rho_e, fr_mul(c, cred.e)),
+        s_r2=fr_add(rho_r2, fr_mul(c, r2)),
+        s_r3=fr_add(rho_r3, fr_mul(c, r3)),
+        s_sprime=fr_add(rho_sp, fr_mul(c, s_prime)),
+        s_sk=fr_add(rho_sk, fr_mul(c, sk)),
+        s_rnym=fr_add(rho_rn, fr_mul(c, r_nym)),
+        s_hidden={i: fr_add(rho_hidden[i], fr_mul(c, attrs[i]))
+                  for i in hidden_idx},
+    )
+
+
+def verify_presentation(ipk: IssuerPublicKey, pres: Presentation, nym: G1,
+                        message: bytes) -> None:
+    """Verifier side: pairing check + the three Schnorr equations."""
+    if pres.a_prime is None:
+        raise CredentialError("A' is the identity")
+    n_attrs = len(ipk.h_attrs)
+    idx_seen = set(pres.disclosed) | set(pres.s_hidden)
+    if (len(pres.disclosed) + len(pres.s_hidden) != n_attrs
+            or idx_seen != set(range(n_attrs))):
+        raise CredentialError("attribute slots mismatch")
+
+    # pairing: e(A', w) == e(Abar, g2)
+    if not pr.gt_eq(pres.a_prime, ipk.w, pres.a_bar, pr.G2_GENERATOR):
+        raise CredentialError("credential pairing check failed")
+
+    c = pres.c
+    # (i)  A'^{-s_e} HRand^{s_r2} == t1 * (Abar/d)^c
+    lhs = g1_add(g1_mul(pres.a_prime, fr_neg(pres.s_e)),
+                 g1_mul(ipk.h_rand, pres.s_r2))
+    t1 = g1_add(lhs, g1_neg(
+        g1_mul(g1_add(pres.a_bar, g1_neg(pres.d)), c)))
+    # (ii) d^{s_r3} HRand^{-s_s'} HSk^{-s_sk} prod HAttr^{-s_mi}
+    #      == t2 * (g1 * prod_D HAttr^{m_i})^c
+    lhs = g1_add(g1_mul(pres.d, pres.s_r3),
+                 g1_neg(g1_mul(ipk.h_rand, pres.s_sprime)))
+    lhs = g1_add(lhs, g1_neg(g1_mul(ipk.h_sk, pres.s_sk)))
+    for i, z in pres.s_hidden.items():
+        lhs = g1_add(lhs, g1_neg(g1_mul(ipk.h_attrs[i], z)))
+    pub = bn254.G1_GENERATOR
+    for i, m in pres.disclosed.items():
+        pub = g1_add(pub, g1_mul(ipk.h_attrs[i], m))
+    t2 = g1_add(lhs, g1_neg(g1_mul(pub, c)))
+    # (iii) HSk^{s_sk} HRand^{s_rnym} == t3 * Nym^c
+    lhs = g1_add(g1_mul(ipk.h_sk, pres.s_sk),
+                 g1_mul(ipk.h_rand, pres.s_rnym))
+    t3 = g1_add(lhs, g1_neg(g1_mul(nym, c)))
+
+    expect = _presentation_challenge(ipk, pres.a_prime, pres.a_bar, pres.d,
+                                     nym, t1, t2, t3, pres.disclosed,
+                                     message)
+    if expect != c:
+        raise CredentialError("presentation proof invalid")

@@ -5,29 +5,33 @@ Capability mirror of reference token/services/identity/idemix/km.go:46-365
 verification against the pseudonym) and the auditor's identity inspection
 (crypto/audit/auditor.go:265-282 InspectIdentity).
 
-Scheme (documented divergence from IBM/idemix): the reference proves
-possession of a pairing-based CL/BBS+ credential chain; this framework
-implements the dlog pseudonym layer that gives the zkatdlog driver its
-privacy capabilities —
-  - OWNER PSEUDONYMS: Nym = g^sk * h^r with fresh r per transaction; two
-    transfers by the same owner are unlinkable under DDH.
-  - SIGNATURES: two-generator Schnorr proof of knowledge of (sk, r) for
-    Nym, bound to the message — validators verify against the pseudonym
-    alone and learn nothing about the long-term key.
-  - REGISTRATION: an enrollment authority binds eid -> master key U = g^sk
-    with an ECDSA enrollment certificate (the role the idemix issuer's
-    credential plays in the reference).
-  - AUDIT (NymEID matching): the audit info carries (eid, r); the auditor
-    recomputes Nym == U_eid * h^r against its registration directory and
-    verifies the enrollment certificate, recovering WHO transacted without
-    the validators ever learning it.
-The pairing-based credential chain is the one reference capability
-intentionally replaced (SURVEY.md §7 hard-part 4 keeps pairings off the
-hot path); everything downstream — pseudonymous owners, unlinkability,
-auditor-only deanonymization — is preserved and tested.
+Two modes share this surface:
+
+  - DLOG MODE (round-2 scheme, kept for cheap enrollment):
+    Nym = g^sk * h^r per transaction (unlinkable under DDH), two-generator
+    Schnorr signatures against the Nym, ECDSA enrollment certificate
+    binding eid -> U = g^sk, and NymEID audit info (eid, U, r) letting the
+    auditor — and only the auditor — recompute Nym == U * h^r.
+
+  - CREDENTIAL MODE (reference-parity, km.go's actual capability): the
+    enrollment authority is ALSO a pairing-based credential issuer
+    (services/identity/credential.py, BBS+ over BN254). Enrollment issues
+    a credential over the attribute slots (OU, Role, EnrollmentID,
+    RevocationHandle); every pseudonym identity then CARRIES an unlinkable
+    zero-knowledge proof of credential possession bound to the Nym —
+    validators verify "this pseudonym belongs to an enrolled member of
+    OU/Role" without learning who, exactly as the reference's idemix MSP
+    identity validation does. Per-transaction signatures stay the cheap
+    Nym-Schnorr (km.go signs with the nym key too; the credential proof
+    lives in the identity, not in every signature).
+
+Audit (both modes): audit info carries (eid, master, r, enrollment cert);
+the auditor recomputes Nym == master * h^r and verifies the certificate —
+master is U = g^sk in dlog mode and HSk^sk in credential mode; the matcher
+is generator-agnostic.
 
 All group work is host-side BN254 (per-tx, not per-proof — it never touches
-the TPU batch path).
+the TPU batch path); pairings only at enrollment / identity validation.
 """
 
 from __future__ import annotations
@@ -39,6 +43,7 @@ from ...crypto import serialization as ser
 from ...crypto.bn254 import (G1, fr_add, fr_mul, fr_rand, g1_add, g1_mul,
                              g1_neg, hash_to_g1, hash_to_zr)
 from ...driver.identity import Identity
+from . import credential as cred_mod
 from . import typed as typed_mod
 from .x509 import X509KeyPair, X509Verifier, new_signing_identity
 
@@ -47,43 +52,86 @@ IDEMIX_TYPE = "idemix"
 #: Second pseudonym generator, nothing-up-my-sleeve (hash-to-curve).
 H_GEN = hash_to_g1(b"fabric_token_sdk_tpu.idemix.nym.h")
 G_GEN = bn254.G1_GENERATOR
+#: Credential-mode sk generator — the same point credential.IssuerKey
+#: bakes into every issuer public key (single shared constant).
+HSK_GEN = cred_mod.H_SK
+
+#: Attribute slot layout, mirroring the reference idemix credential
+#: (OU, Role, EnrollmentID, RevocationHandle — km.go attribute order).
+ATTR_OU, ATTR_ROLE, ATTR_EID, ATTR_RH = range(4)
+N_ATTRS = 4
+#: Identity-validation discloses OU + Role, hides EID + RH (the reference's
+#: default disclosure mask for transaction identities).
+DEFAULT_DISCLOSE = {ATTR_OU, ATTR_ROLE}
 
 
 class IdemixError(Exception):
     pass
 
 
-def _schnorr_challenge(nym: G1, t: G1, message: bytes) -> int:
+def _schnorr_challenge(nym: G1, t: G1, message: bytes,
+                       gen_sk: G1 = None) -> int:
+    """Fiat-Shamir challenge binding the ACTUAL generator pair in use —
+    dlog-mode (G_GEN) and credential-mode (HSK_GEN) transcripts are
+    domain-separated."""
+    gen_sk = G_GEN if gen_sk is None else gen_sk
     return hash_to_zr(b"idemix.nym.sig"
-                      + ser.g1_to_bytes(G_GEN) + ser.g1_to_bytes(H_GEN)
+                      + ser.g1_to_bytes(gen_sk) + ser.g1_to_bytes(H_GEN)
                       + ser.g1_to_bytes(nym) + ser.g1_to_bytes(t)
                       + message)
 
 
 @dataclass
 class Pseudonym:
-    """One per-transaction identity: Nym = g^sk * h^r."""
+    """One per-transaction identity: Nym = gen_sk^sk * h^r.
+
+    In credential mode the identity bytes additionally carry the
+    possession proof (presentation) bound to this Nym."""
 
     nym: G1
     r: int
+    presentation: bytes | None = None   # credential-mode possession proof
 
     def identity(self) -> Identity:
-        return typed_mod.wrap_with_type(IDEMIX_TYPE, ser.g1_to_bytes(self.nym))
+        if self.presentation is None:
+            return typed_mod.wrap_with_type(IDEMIX_TYPE,
+                                            ser.g1_to_bytes(self.nym))
+        payload = ser.der_sequence(
+            ser.der_octet_string(ser.g1_to_bytes(self.nym)),
+            ser.der_octet_string(self.presentation))
+        return typed_mod.wrap_with_type(IDEMIX_TYPE, payload)
+
+
+def parse_identity(identity_bytes: bytes) -> tuple[G1, bytes | None]:
+    """Idemix identity payload -> (nym, presentation | None).
+
+    Legacy dlog identities are exactly the 64-byte G1 encoding; credential
+    identities are DER [nym, presentation]."""
+    identity_bytes = bytes(identity_bytes)
+    if len(identity_bytes) == 64:
+        return ser.g1_from_bytes(identity_bytes), None
+    seq = ser.DerReader(identity_bytes).read_sequence()
+    nym = ser.g1_from_bytes(seq.read_octet_string())
+    return nym, seq.read_octet_string()
 
 
 class NymVerifier:
     """driver.Verifier for a pseudonym: checks the two-generator Schnorr
-    PoK (km.go signature verification against the Nym)."""
+    PoK (km.go signature verification against the Nym). Credential-mode
+    pseudonyms use HSk as the first generator; the PoK transcript pins
+    which pair was used."""
 
-    def __init__(self, nym: G1):
+    def __init__(self, nym: G1, gen_sk: G1 = G_GEN):
         self.nym = nym
+        self.gen_sk = gen_sk
 
     @classmethod
     def from_typed(cls, identity_bytes: bytes) -> "NymVerifier":
         try:
-            return cls(ser.g1_from_bytes(identity_bytes))
+            nym, presentation = parse_identity(identity_bytes)
         except Exception as e:
             raise IdemixError(f"invalid idemix pseudonym: {e}") from e
+        return cls(nym, HSK_GEN if presentation is not None else G_GEN)
 
     def verify(self, message: bytes, signature: bytes) -> None:
         try:
@@ -93,25 +141,71 @@ class NymVerifier:
             z2 = ser.zr_from_bytes(seq.read_octet_string())
         except Exception as e:
             raise IdemixError(f"malformed idemix signature: {e}") from e
-        c = _schnorr_challenge(self.nym, t, message)
-        # g^z1 h^z2 == t * Nym^c
-        lhs = g1_add(g1_mul(G_GEN, z1), g1_mul(H_GEN, z2))
+        c = _schnorr_challenge(self.nym, t, message, self.gen_sk)
+        # gen_sk^z1 h^z2 == t * Nym^c
+        lhs = g1_add(g1_mul(self.gen_sk, z1), g1_mul(H_GEN, z2))
         rhs = g1_add(t, g1_mul(self.nym, c))
         if lhs != rhs:
             raise IdemixError("invalid idemix signature")
 
 
-class EnrollmentAuthority:
-    """Registration CA: binds enrollment IDs to master keys (the role of
-    the idemix issuer key in km.go; ECDSA instead of a CL credential)."""
+class CredentialIdentityVerifier:
+    """Identity-level validation for credential-mode pseudonyms: the
+    possession proof must verify against the issuer public key and bind
+    the Nym (reference idemix MSP identity validation in km.go /
+    msp/idemix Validate)."""
 
-    def __init__(self):
+    def __init__(self, ipk: cred_mod.IssuerPublicKey):
+        self.ipk = ipk
+
+    def validate(self, identity_bytes: bytes) -> dict:
+        """Returns the disclosed attribute slots on success."""
+        try:
+            nym, presentation = parse_identity(identity_bytes)
+        except Exception as e:
+            raise IdemixError(f"invalid idemix identity: {e}") from e
+        if presentation is None:
+            raise IdemixError("identity carries no credential proof")
+        try:
+            pres = cred_mod.Presentation.deserialize(presentation)
+            cred_mod.verify_presentation(self.ipk, pres, nym,
+                                         b"idemix.identity")
+        except cred_mod.CredentialError as e:
+            raise IdemixError(f"credential possession proof: {e}") from e
+        return dict(pres.disclosed)
+
+
+class EnrollmentAuthority:
+    """Registration CA + (optionally) pairing-based credential issuer.
+
+    Always binds eid -> master key with an ECDSA enrollment certificate
+    (the NymEID audit anchor). With `with_credentials=True` it also holds
+    a BBS+ issuer key and signs attribute credentials at enrollment — the
+    role the idemix issuer plays in the reference (km.go:46-365)."""
+
+    def __init__(self, with_credentials: bool = False):
         self.keys: X509KeyPair = new_signing_identity()
+        self.issuer_key: cred_mod.IssuerKey | None = (
+            cred_mod.IssuerKey.generate(N_ATTRS, h_rand=H_GEN)
+            if with_credentials else None)
 
     def enroll(self, eid: str, master: G1) -> bytes:
         """Enrollment certificate over (eid, U)."""
         return self.keys.sign(b"idemix.enroll" + eid.encode()
                               + ser.g1_to_bytes(master))
+
+    def issue_credential(self, req: cred_mod.CredentialRequest,
+                         nonce: bytes, ou: str, role: str, eid: str,
+                         rh: str) -> cred_mod.Credential:
+        """Credential over the (OU, Role, EID, RH) attribute slots."""
+        if self.issuer_key is None:
+            raise IdemixError("authority has no credential issuer key")
+        attrs = [cred_mod.attr_to_zr(v) for v in (ou, role, eid, rh)]
+        return cred_mod.issue_credential(self.issuer_key, req, nonce, attrs)
+
+    @property
+    def issuer_public_key(self) -> cred_mod.IssuerPublicKey | None:
+        return self.issuer_key.public if self.issuer_key else None
 
     def ca_identity(self) -> Identity:
         return self.keys.identity
@@ -121,21 +215,39 @@ class IdemixKeyManager:
     """User-side key manager (km.go:46-365): long-term sk, fresh pseudonyms,
     per-pseudonym signing, audit info emission."""
 
-    def __init__(self, eid: str, authority: EnrollmentAuthority):
+    def __init__(self, eid: str, authority: EnrollmentAuthority,
+                 ou: str = "org", role: str = "member"):
         self.eid = eid
         self.sk = fr_rand()
-        self.master = g1_mul(G_GEN, self.sk)     # U = g^sk
+        #: credential mode iff the authority holds an issuer key
+        self.ipk = authority.issuer_public_key
+        self._gen_sk = HSK_GEN if self.ipk is not None else G_GEN
+        self.master = g1_mul(self._gen_sk, self.sk)
         self.cert = authority.enroll(eid, self.master)
+        self.credential: cred_mod.Credential | None = None
+        if self.ipk is not None:
+            nonce = fr_rand().to_bytes(32, "big")
+            req = cred_mod.CredentialRequest.create(self.ipk, self.sk, nonce)
+            self.credential = authority.issue_credential(
+                req, nonce, ou, role, eid, rh=f"rh-{eid}")
+            self.credential.verify(self.ipk, self.sk)
         #: nym bytes -> Pseudonym (the wallet registry of own pseudonyms)
         self._mine: dict[bytes, Pseudonym] = {}
 
     # ------------------------------------------------------------ identity
     def fresh_pseudonym(self) -> Pseudonym:
         """New unlinkable identity for one transaction (km.go pseudonym
-        generation)."""
+        generation); in credential mode the identity carries a fresh
+        possession proof bound to the new Nym."""
         r = fr_rand()
         nym = g1_add(self.master, g1_mul(H_GEN, r))
-        p = Pseudonym(nym=nym, r=r)
+        presentation = None
+        if self.credential is not None:
+            pres = cred_mod.present(self.ipk, self.credential, self.sk,
+                                    nym, r, DEFAULT_DISCLOSE,
+                                    b"idemix.identity")
+            presentation = pres.serialize()
+        p = Pseudonym(nym=nym, r=r, presentation=presentation)
         self._mine[bytes(p.identity())] = p
         return p
 
@@ -149,8 +261,8 @@ class IdemixKeyManager:
         if p is None:
             raise IdemixError("unknown pseudonym: cannot sign")
         a, b = fr_rand(), fr_rand()
-        t = g1_add(g1_mul(G_GEN, a), g1_mul(H_GEN, b))
-        c = _schnorr_challenge(p.nym, t, message)
+        t = g1_add(g1_mul(self._gen_sk, a), g1_mul(H_GEN, b))
+        c = _schnorr_challenge(p.nym, t, message, self._gen_sk)
         z1 = fr_add(a, fr_mul(c, self.sk))
         z2 = fr_add(b, fr_mul(c, p.r))
         return ser.der_sequence(
@@ -190,7 +302,10 @@ class IdemixInfoMatcher:
             raise IdemixError(f"not a typed identity: {e}") from e
         if ti.type != IDEMIX_TYPE:
             raise IdemixError(f"not an idemix identity [{ti.type}]")
-        nym = ser.g1_from_bytes(ti.identity)
+        try:
+            nym, _ = parse_identity(ti.identity)
+        except Exception as e:
+            raise IdemixError(f"invalid idemix identity: {e}") from e
         try:
             seq = ser.DerReader(audit_info).read_sequence()
             eid = seq.read_octet_string().decode()

@@ -66,3 +66,25 @@ def new_signing_identity() -> X509KeyPair:
         serialization.Encoding.DER,
         serialization.PublicFormat.SubjectPublicKeyInfo)
     return X509KeyPair(key, Identity(pub))
+
+
+def keypair_to_pem(kp: X509KeyPair) -> tuple[bytes, bytes]:
+    """(private PEM, public PEM) for on-disk artifacts (tokengen)."""
+    priv = kp.private_key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    pub = kp.private_key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return priv, pub
+
+
+def keypair_from_pem(private_pem: bytes) -> X509KeyPair:
+    key = serialization.load_pem_private_key(private_pem, password=None)
+    if not isinstance(key, ec.EllipticCurvePrivateKey):
+        raise SignatureError("PEM is not an EC private key")
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return X509KeyPair(key, Identity(pub))

@@ -1,0 +1,117 @@
+"""External (remote) wallet signing for the ttx flow.
+
+Behavioral mirror of reference token/services/ttx/external.go:19-210: a
+node that keeps its keys in an external wallet does not sign locally —
+the ttx endorsement step runs a SIGNER SERVER that streams sign requests
+to the remote wallet process, which answers with signatures until the
+server sends Done.
+
+Wire protocol (matching the reference message set):
+    SigRequest    {party, message}       server -> client
+    SignResponse  {sigma}                client -> server
+    Done          {}                     server -> client
+Messages are JSON objects {"type": int, "raw": {...}} with bytes fields
+hex-encoded; any duplex byte/obj stream works — the harness' IPC pipes
+(harness/nwo.py) or the in-process QueuePairStream below.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+
+
+class ExternalWalletError(Exception):
+    pass
+
+
+# message types (external.go:21-27)
+SIG_REQUEST = 1
+SIGN_RESPONSE = 2
+DONE = 3
+
+
+def _encode(type_: int, raw: dict | None) -> str:
+    return json.dumps({"type": type_, "raw": raw or {}})
+
+
+def _decode(data: str) -> tuple[int, dict]:
+    obj = json.loads(data)
+    return int(obj["type"]), obj.get("raw") or {}
+
+
+class QueuePairStream:
+    """In-process duplex stream: a pair of queues. `pair()` returns the
+    two connected endpoints (server side, client side)."""
+
+    def __init__(self, inbox: queue.Queue, outbox: queue.Queue):
+        self._in = inbox
+        self._out = outbox
+
+    @classmethod
+    def pair(cls) -> tuple["QueuePairStream", "QueuePairStream"]:
+        a, b = queue.Queue(), queue.Queue()
+        return cls(a, b), cls(b, a)
+
+    def send(self, data: str) -> None:
+        self._out.put(data)
+
+    def recv(self, timeout: float = 30.0) -> str:
+        try:
+            return self._in.get(timeout=timeout)
+        except queue.Empty as exc:
+            raise ExternalWalletError("stream receive timed out") from exc
+
+
+class StreamExternalWalletSignerServer:
+    """ttx-side endpoint: forwards sign requests to the remote wallet
+    (external.go:61-107). Drop-in for a local signer in the endorsement
+    step: `sign(party, message) -> sigma`."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def sign(self, party: bytes, message: bytes) -> bytes:
+        self.stream.send(_encode(SIG_REQUEST, {
+            "party": bytes(party).hex(), "message": bytes(message).hex()}))
+        type_, raw = _decode(self.stream.recv())
+        if type_ != SIGN_RESPONSE:
+            raise ExternalWalletError(
+                f"expected sign response msg, got [{type_}]")
+        return bytes.fromhex(raw["sigma"])
+
+    def done(self) -> None:
+        self.stream.send(_encode(DONE, None))
+
+
+class StreamExternalWalletSignerClient:
+    """Remote-wallet-side endpoint (external.go:114-210): answers sign
+    requests with the wallet's own signers until Done arrives.
+
+    signer_provider(party: bytes) -> signer with .sign(message) -> bytes
+    """
+
+    def __init__(self, signer_provider, stream):
+        self.signer_provider = signer_provider
+        self.stream = stream
+
+    def respond(self) -> int:
+        """Serve sign requests until Done; returns how many were signed."""
+        served = 0
+        while True:
+            type_, raw = _decode(self.stream.recv())
+            if type_ == DONE:
+                return served
+            if type_ != SIG_REQUEST:
+                raise ExternalWalletError(
+                    f"msg type [{type_}] not recognized")
+            party = bytes.fromhex(raw["party"])
+            message = bytes.fromhex(raw["message"])
+            signer = self.signer_provider(party)
+            if signer is None:
+                raise ExternalWalletError(
+                    f"no signer for party [{party.hex()[:16]}]")
+            sigma = signer.sign(message)
+            self.stream.send(_encode(SIGN_RESPONSE,
+                                     {"sigma": bytes(sigma).hex()}))
+            served += 1

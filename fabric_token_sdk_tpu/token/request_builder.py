@@ -78,6 +78,39 @@ class Request:
         self._input_owner_ids.extend(bytes(r.owner) for r in input_rows)
         return action
 
+    def upgrade(self, input_rows, receiver: bytes, wallet=None,
+                sender_audit_info=None, receiver_tag=None,
+                receiver_audit_info: bytes = b"") -> object:
+        """request.go:389 Upgrade: convert old-format ledger tokens into
+        tokens under the CURRENT public parameters, crediting the full
+        value to `receiver`.
+
+        The reference routes upgrades through the issue service with a
+        TokensUpgradeRequest; this framework's equivalent mechanism is the
+        transfer path — old-format inputs automatically acquire upgrade
+        witnesses binding the fresh commitments to the ledger bytes
+        (core/zkatdlog/driver.py assemble_transfer, validated by the
+        validator's upgrade-witness step). The verb surface is the same:
+        one call, old tokens in, new-format tokens out.
+        """
+        rows = list(input_rows)
+        if not rows:
+            raise RequestBuilderError("tokens is empty")
+        from ..core.fabtoken.driver import OutputSpec
+        from ..token.quantity import sum_quantities
+
+        precision = getattr(self.driver, "precision", None)
+        if precision is None:
+            # zkatdlog: value range is the range-proof bit length
+            precision = self.driver.pp.range_proof_params.bit_length
+        total = sum_quantities([r.quantity for r in rows], precision)
+        spec = OutputSpec(owner=bytes(receiver), token_type=rows[0].type,
+                          value=total.value, audit_info=receiver_audit_info)
+        return self.transfer(rows, [spec], wallet=wallet,
+                             sender_audit_info=sender_audit_info,
+                             receivers=[receiver_tag] if receiver_tag
+                             else None)
+
     def _plan_outputs(self, kind, action_pos, md, outputs, receivers) -> None:
         for i, spec in enumerate(outputs):
             opening = None

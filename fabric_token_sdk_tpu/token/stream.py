@@ -1,0 +1,179 @@
+"""Input/Output streams: the app- and audit-facing token filter API.
+
+Behavioral mirror of reference token/stream.go:1-354 — applications and
+the auditor walk a request's inputs/outputs through typed filter chains
+(ByRecipient / ByType / ByEnrollmentID, Sum, Count, EnrollmentIDs, ...)
+instead of poking at raw actions. Streams are immutable: every filter
+returns a new stream over the surviving rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .model import ID
+from .quantity import to_quantity
+
+
+@dataclass
+class Output:
+    """One output of a token action (stream.go:23-49)."""
+
+    owner: bytes = b""
+    type: str = ""
+    quantity: str = "0x0"         # hex string, like token.Token.Quantity
+    action_index: int = 0
+    index: int = 0                # absolute position in the request
+    owner_audit_info: bytes = b""
+    enrollment_id: str = ""
+    revocation_handler: str = ""
+    ledger_output: bytes = b""
+    ledger_output_format: str = ""
+    ledger_output_metadata: bytes = b""
+    issuer: bytes = b""
+
+    def id(self, tx_id: str) -> ID:
+        return ID(tx_id=tx_id, index=self.index)
+
+
+@dataclass
+class Input:
+    """One input of a token action (stream.go:175-184)."""
+
+    action_index: int = 0
+    id: ID | None = None
+    owner: bytes = b""
+    owner_audit_info: bytes = b""
+    enrollment_id: str = ""
+    revocation_handler: str = ""
+    type: str = ""
+    quantity: str = "0x0"
+
+
+def _dedup(values):
+    seen, out = set(), []
+    for v in values:
+        if v and v not in seen:
+            seen.add(v)
+            out.append(v)
+    return out
+
+
+class OutputStream:
+    """Filterable view over a request's outputs (stream.go:56-172)."""
+
+    def __init__(self, outputs: list[Output], precision: int = 64):
+        self._outputs = list(outputs)
+        self.precision = precision
+
+    def filter(self, pred) -> "OutputStream":
+        return OutputStream([o for o in self._outputs if pred(o)],
+                            self.precision)
+
+    def by_recipient(self, identity: bytes) -> "OutputStream":
+        identity = bytes(identity)
+        return self.filter(lambda o: bytes(o.owner) == identity)
+
+    def by_type(self, token_type: str) -> "OutputStream":
+        return self.filter(lambda o: o.type == token_type)
+
+    def by_enrollment_id(self, eid: str) -> "OutputStream":
+        return self.filter(lambda o: o.enrollment_id == eid)
+
+    def outputs(self) -> list[Output]:
+        return list(self._outputs)
+
+    def count(self) -> int:
+        return len(self._outputs)
+
+    def at(self, i: int) -> Output:
+        return self._outputs[i]
+
+    def sum(self) -> int:
+        total = 0
+        for o in self._outputs:
+            total += to_quantity(o.quantity, self.precision).value
+        return total
+
+    def enrollment_ids(self) -> list[str]:
+        return _dedup(o.enrollment_id for o in self._outputs)
+
+    def token_types(self) -> list[str]:
+        return _dedup(o.type for o in self._outputs)
+
+    def revocation_handles(self) -> list[str]:
+        return _dedup(o.revocation_handler for o in self._outputs)
+
+    def __iter__(self):
+        return iter(self._outputs)
+
+
+class InputStream:
+    """Filterable view over a request's inputs (stream.go:186-345).
+
+    `query_service` needs one method: is_mine(token_id) -> bool."""
+
+    def __init__(self, query_service, inputs: list[Input],
+                 precision: int = 64):
+        self._qs = query_service
+        self._inputs = list(inputs)
+        self.precision = precision
+
+    def filter(self, pred) -> "InputStream":
+        return InputStream(self._qs, [i for i in self._inputs if pred(i)],
+                           self.precision)
+
+    def by_enrollment_id(self, eid: str) -> "InputStream":
+        return self.filter(lambda i: i.enrollment_id == eid)
+
+    def by_type(self, token_type: str) -> "InputStream":
+        return self.filter(lambda i: i.type == token_type)
+
+    def count(self) -> int:
+        return len(self._inputs)
+
+    def at(self, i: int) -> Input:
+        return self._inputs[i]
+
+    def inputs(self) -> list[Input]:
+        return list(self._inputs)
+
+    def ids(self) -> list[ID]:
+        return [i.id for i in self._inputs]
+
+    def owners(self) -> "OwnerStream":
+        return OwnerStream([bytes(i.owner) for i in self._inputs])
+
+    def is_any_mine(self) -> bool:
+        return any(self._qs.is_mine(i.id) for i in self._inputs)
+
+    def enrollment_ids(self) -> list[str]:
+        return _dedup(i.enrollment_id for i in self._inputs)
+
+    def revocation_handles(self) -> list[str]:
+        return _dedup(i.revocation_handler for i in self._inputs)
+
+    def token_types(self) -> list[str]:
+        return _dedup(i.type for i in self._inputs)
+
+    def sum(self) -> int:
+        total = 0
+        for i in self._inputs:
+            total += to_quantity(i.quantity, self.precision).value
+        return total
+
+    def __iter__(self):
+        return iter(self._inputs)
+
+
+class OwnerStream:
+    """Deduplicated owner set (stream.go:347-354)."""
+
+    def __init__(self, owners: list[bytes]):
+        self._owners = _dedup(bytes(o) for o in owners)
+
+    def count(self) -> int:
+        return len(self._owners)
+
+    def owners(self) -> list[bytes]:
+        return list(self._owners)

@@ -44,6 +44,35 @@ def test_multiprocess_issue_transfer_redeem(platform):
     assert p.balance("bob", "USD") == 200
 
 
+def test_platform_boots_from_tokengen_artifacts(tmp_path):
+    """tokengen artifacts gen -> Platform.from_artifacts: the CLI's
+    topology artifacts drive the NWO harness exactly like the reference's
+    artifactgen + nwo pairing (cmd/tokengen/main.go:50)."""
+    import json
+
+    from fabric_token_sdk_tpu.cmd.tokengen import main
+
+    topo = {"driver": "fabtoken", "precision": 64,
+            "nodes": [{"name": "issuer", "role": "issuer"},
+                      {"name": "auditor", "role": "auditor"},
+                      {"name": "alice"}, {"name": "bob"}]}
+    tf = tmp_path / "topology.json"
+    tf.write_text(json.dumps(topo))
+    out = tmp_path / "artifacts"
+    assert main(["artifacts", "gen", "--topology", str(tf),
+                 "--output", str(out)]) == 0
+
+    p = Platform.from_artifacts(out)
+    p.start()
+    try:
+        tx = p.issue(via="alice", issuer="issuer", to="alice",
+                     token_type="USD", amount=42)
+        assert p.wait_tx("alice", tx) == "Confirmed"
+        assert p.balance("alice", "USD") == 42
+    finally:
+        p.stop()
+
+
 def test_multiprocess_double_spend_rejected(platform):
     p = platform
     tx1 = p.issue(via="alice", issuer="issuer", to="alice",

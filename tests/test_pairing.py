@@ -1,0 +1,237 @@
+"""BN254 pairing + Idemix credential-chain tests.
+
+Covers the capability the reference exercises through IBM/idemix
+(token/services/identity/idemix/km.go:46-365): issuer-certified attributes,
+unlinkable possession proofs, and the auditor's NymEID inspection on top.
+"""
+
+import copy
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.crypto import pairing as pr
+from fabric_token_sdk_tpu.crypto.bn254 import (fr_rand, g1_add, g1_mul,
+                                               g1_neg)
+from fabric_token_sdk_tpu.services.identity import credential as cr
+from fabric_token_sdk_tpu.services.identity import idemix as ix
+
+
+# ---------------------------------------------------------------------------
+# pairing layer
+# ---------------------------------------------------------------------------
+
+class TestPairing:
+    def test_g2_generator_on_twist_and_in_subgroup(self):
+        assert pr.g2_is_on_curve(pr.G2_GENERATOR)
+        assert pr.g2_in_subgroup(pr.G2_GENERATOR)
+
+    def test_g2_group_laws(self):
+        q = pr.G2_GENERATOR
+        assert pr.g2_add(q, None) == q
+        assert pr.g2_add(None, q) == q
+        assert pr.g2_add(q, pr.g2_neg(q)) is None
+        assert pr.g2_mul(q, 5) == pr.g2_add(
+            pr.g2_mul(q, 2), pr.g2_mul(q, 3))
+        assert pr.g2_mul(q, bn254.R) is None
+
+    def test_bilinearity(self):
+        p1, q = bn254.G1_GENERATOR, pr.G2_GENERATOR
+        e = pr.pairing(p1, q)
+        assert e != pr.FP12_ONE                      # non-degenerate
+        assert pr.pairing(g1_mul(p1, 2), q) == pr.fp12_mul(e, e)
+        assert pr.pairing(p1, pr.g2_mul(q, 2)) == pr.fp12_mul(e, e)
+        assert pr.pairing(g1_mul(p1, 3), pr.g2_mul(q, 5)) \
+            == pr.fp12_pow(e, 15)
+
+    def test_gt_has_order_r(self):
+        e = pr.pairing(bn254.G1_GENERATOR, pr.G2_GENERATOR)
+        assert pr.fp12_pow(e, bn254.R) == pr.FP12_ONE
+
+    def test_pairing_product_and_identity_inputs(self):
+        p1, q = bn254.G1_GENERATOR, pr.G2_GENERATOR
+        assert pr.pairing_product_is_one([(p1, q), (g1_neg(p1), q)])
+        assert not pr.pairing_product_is_one([(p1, q), (p1, q)])
+        assert pr.pairing(None, q) == pr.FP12_ONE
+        assert pr.pairing(p1, None) == pr.FP12_ONE
+
+    def test_g2_serialization_round_trip(self):
+        q = pr.g2_mul(pr.G2_GENERATOR, 123456789)
+        raw = cr._g2_to_bytes(q)
+        assert cr._g2_from_bytes(raw) == q
+        assert cr._g2_from_bytes(bytes(128)) is None
+        # off-subgroup point must be rejected: a point on the twist with
+        # cofactor component (generate by using a curve point not in E'[r])
+        bad = raw[:-1] + bytes([raw[-1] ^ 1])
+        with pytest.raises(cr.CredentialError):
+            cr._g2_from_bytes(bad)
+
+
+# ---------------------------------------------------------------------------
+# credential scheme
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def issuer():
+    return cr.IssuerKey.generate(4)
+
+
+@pytest.fixture(scope="module")
+def holder(issuer):
+    sk = fr_rand()
+    nonce = b"n-0"
+    req = cr.CredentialRequest.create(issuer.public, sk, nonce)
+    attrs = [cr.attr_to_zr(v)
+             for v in ("org1", "member", "alice@org1", "rh-1")]
+    cred = cr.issue_credential(issuer, req, nonce, attrs)
+    return sk, cred
+
+
+class TestCredential:
+    def test_issue_and_holder_verify(self, issuer, holder):
+        sk, cred = holder
+        cred.verify(issuer.public, sk)
+        with pytest.raises(cr.CredentialError):
+            cred.verify(issuer.public, fr_rand())   # wrong sk
+
+    def test_request_pok_rejects_replay_nonce(self, issuer):
+        sk = fr_rand()
+        req = cr.CredentialRequest.create(issuer.public, sk, b"n-1")
+        with pytest.raises(cr.CredentialError):
+            req.verify(issuer.public, b"n-2")
+
+    def test_presentation_round_trip(self, issuer, holder):
+        sk, cred = holder
+        ipk = issuer.public
+        r_nym = fr_rand()
+        nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+        pres = cr.present(ipk, cred, sk, nym, r_nym, {0, 1}, b"m")
+        cr.verify_presentation(ipk, pres, nym, b"m")
+        # serialization is stable and verifies after a round trip
+        raw = pres.serialize()
+        again = cr.Presentation.deserialize(raw)
+        cr.verify_presentation(ipk, again, nym, b"m")
+        assert again.serialize() == raw
+
+    def test_presentation_discloses_only_requested(self, issuer, holder):
+        sk, cred = holder
+        ipk = issuer.public
+        r_nym = fr_rand()
+        nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+        pres = cr.present(ipk, cred, sk, nym, r_nym, {0}, b"m")
+        assert set(pres.disclosed) == {0}
+        assert set(pres.s_hidden) == {1, 2, 3}
+        cr.verify_presentation(ipk, pres, nym, b"m")
+
+    def test_presentation_rejections(self, issuer, holder):
+        sk, cred = holder
+        ipk = issuer.public
+        r_nym = fr_rand()
+        nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+        pres = cr.present(ipk, cred, sk, nym, r_nym, {0, 1}, b"m")
+
+        with pytest.raises(cr.CredentialError):     # wrong message
+            cr.verify_presentation(ipk, pres, nym, b"other")
+        with pytest.raises(cr.CredentialError):     # wrong nym
+            other = g1_add(g1_mul(ipk.h_sk, fr_rand()),
+                           g1_mul(ipk.h_rand, r_nym))
+            cr.verify_presentation(ipk, other and pres, other, b"m")
+        mutated = copy.deepcopy(pres)               # tampered attribute
+        mutated.disclosed[0] = cr.attr_to_zr("org2")
+        with pytest.raises(cr.CredentialError):
+            cr.verify_presentation(ipk, mutated, nym, b"m")
+        mutated = copy.deepcopy(pres)               # missing hidden slot
+        del mutated.s_hidden[2]
+        with pytest.raises(cr.CredentialError):
+            cr.verify_presentation(ipk, mutated, nym, b"m")
+
+    def test_wrong_issuer_credential_fails_pairing(self, issuer):
+        rogue = cr.IssuerKey.generate(4)
+        sk = fr_rand()
+        req = cr.CredentialRequest.create(rogue.public, sk, b"n")
+        attrs = [cr.attr_to_zr(v) for v in ("a", "b", "c", "d")]
+        forged = cr.issue_credential(rogue, req, b"n", attrs)
+        ipk = issuer.public
+        r_nym = fr_rand()
+        nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+        pres = cr.present(ipk, forged, sk, nym, r_nym, {0}, b"m")
+        with pytest.raises(cr.CredentialError,
+                           match="pairing|proof"):
+            cr.verify_presentation(ipk, pres, nym, b"m")
+
+    def test_unlinkability_shape(self, issuer, holder):
+        """Two presentations share no group element (re-randomized)."""
+        sk, cred = holder
+        ipk = issuer.public
+        outs = []
+        for _ in range(2):
+            r_nym = fr_rand()
+            nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+            pres = cr.present(ipk, cred, sk, nym, r_nym, {0, 1}, b"m")
+            outs.append((pres.a_prime, pres.a_bar, pres.d, nym))
+        for a, b in zip(*outs):
+            assert a != b
+
+
+# ---------------------------------------------------------------------------
+# idemix integration (credential mode)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def authority():
+    return ix.EnrollmentAuthority(with_credentials=True)
+
+
+@pytest.fixture(scope="module")
+def km(authority):
+    return ix.IdemixKeyManager("alice@org1", authority,
+                               ou="org1", role="member")
+
+
+class TestIdemixCredentialMode:
+    def test_pseudonym_carries_valid_possession_proof(self, authority, km):
+        p = km.fresh_pseudonym()
+        ident = bytes(p.identity())
+        from fabric_token_sdk_tpu.services.identity import typed as t
+        ti = t.unmarshal_typed_identity(ident)
+        verifier = ix.CredentialIdentityVerifier(
+            authority.issuer_public_key)
+        disclosed = verifier.validate(ti.identity)
+        assert disclosed[ix.ATTR_OU] == cr.attr_to_zr("org1")
+        assert disclosed[ix.ATTR_ROLE] == cr.attr_to_zr("member")
+        assert ix.ATTR_EID not in disclosed          # EID stays hidden
+
+    def test_nym_signature_in_credential_mode(self, km):
+        p = km.fresh_pseudonym()
+        ident = bytes(p.identity())
+        sig = km.sign(ident, b"tx-payload")
+        from fabric_token_sdk_tpu.services.identity import typed as t
+        ti = t.unmarshal_typed_identity(ident)
+        ix.NymVerifier.from_typed(ti.identity).verify(b"tx-payload", sig)
+        with pytest.raises(ix.IdemixError):
+            ix.NymVerifier.from_typed(ti.identity).verify(b"other", sig)
+
+    def test_uncredentialed_identity_rejected(self, authority):
+        """A dlog-only pseudonym fails credential-mode validation: the
+        'any enrolled key can self-issue pseudonyms' hole is closed."""
+        plain_authority = ix.EnrollmentAuthority()
+        outsider = ix.IdemixKeyManager("mallory", plain_authority)
+        p = outsider.fresh_pseudonym()
+        from fabric_token_sdk_tpu.services.identity import typed as t
+        ti = t.unmarshal_typed_identity(bytes(p.identity()))
+        verifier = ix.CredentialIdentityVerifier(
+            authority.issuer_public_key)
+        with pytest.raises(ix.IdemixError, match="no credential proof"):
+            verifier.validate(ti.identity)
+
+    def test_audit_matcher_still_works(self, authority, km):
+        p = km.fresh_pseudonym()
+        ident = bytes(p.identity())
+        info = km.audit_info(ident)
+        matcher = ix.IdemixInfoMatcher(authority.ca_identity())
+        matcher.match_identity(ident, info)
+        assert matcher.enrollment_id(info) == "alice@org1"
+        # audit info from a different pseudonym must not match
+        other = km.fresh_pseudonym()
+        with pytest.raises(ix.IdemixError):
+            matcher.match_identity(bytes(other.identity()), info)

@@ -1,0 +1,229 @@
+"""Input/Output streams, external-wallet signing, Request.upgrade verb.
+
+Covers reference token/stream.go:1-354 (filter chains the apps and the
+auditor use), token/services/ttx/external.go:19-210 (remote-wallet signing
+protocol), and token/request.go:389 (the Upgrade verb).
+"""
+
+import threading
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.core.zkatdlog.driver import ZkDlogDriverService
+from fabric_token_sdk_tpu.crypto import setup as zk_setup
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import (MemoryLedger,
+                                                       TokenChaincode)
+from fabric_token_sdk_tpu.services import ttx_external as ext
+from fabric_token_sdk_tpu.token.model import ID, UnspentToken
+from fabric_token_sdk_tpu.token.request_builder import (Request,
+                                                        RequestBuilderError)
+from fabric_token_sdk_tpu.token.stream import (Input, InputStream, Output,
+                                               OutputStream, OwnerStream)
+
+
+# ---------------------------------------------------------------------------
+# streams
+# ---------------------------------------------------------------------------
+
+def _outputs():
+    return [
+        Output(owner=b"alice", type="USD", quantity="0x10", index=0,
+               enrollment_id="alice@org1", revocation_handler="rh-a"),
+        Output(owner=b"bob", type="USD", quantity="0x20", index=1,
+               enrollment_id="bob@org1", revocation_handler="rh-b"),
+        Output(owner=b"alice", type="EUR", quantity="0x30", index=2,
+               enrollment_id="alice@org1", revocation_handler="rh-a"),
+        Output(owner=b"", type="USD", quantity="0x5", index=3),  # redeem
+    ]
+
+
+class TestOutputStream:
+    def test_filters_and_sum(self):
+        s = OutputStream(_outputs())
+        assert s.count() == 4
+        assert s.by_recipient(b"alice").count() == 2
+        assert s.by_type("USD").count() == 3
+        assert s.by_type("USD").by_recipient(b"bob").sum() == 0x20
+        assert s.sum() == 0x10 + 0x20 + 0x30 + 0x5
+        # original stream untouched by filtering
+        assert s.count() == 4
+
+    def test_dedup_projections(self):
+        s = OutputStream(_outputs())
+        assert s.enrollment_ids() == ["alice@org1", "bob@org1"]
+        assert s.token_types() == ["USD", "EUR"]
+        assert s.revocation_handles() == ["rh-a", "rh-b"]
+
+    def test_at_and_id(self):
+        s = OutputStream(_outputs())
+        assert s.at(1).owner == b"bob"
+        tid = s.at(1).id("tx-9")
+        assert (tid.tx_id, tid.index) == ("tx-9", 1)
+
+    def test_by_enrollment_id(self):
+        s = OutputStream(_outputs())
+        assert s.by_enrollment_id("alice@org1").sum() == 0x40
+
+
+class _QS:
+    def __init__(self, mine):
+        self.mine = mine
+
+    def is_mine(self, token_id):
+        return token_id in self.mine
+
+
+class TestInputStream:
+    def _inputs(self):
+        return [
+            Input(id=ID("t1", 0), owner=b"alice", type="USD",
+                  quantity="0x10", enrollment_id="alice@org1"),
+            Input(id=ID("t2", 1), owner=b"bob", type="EUR",
+                  quantity="0x20", enrollment_id="bob@org1"),
+            Input(id=ID("t3", 0), owner=b"alice", type="USD",
+                  quantity="0x1", enrollment_id="alice@org1"),
+        ]
+
+    def test_filters_ids_sum(self):
+        s = InputStream(_QS(set()), self._inputs())
+        assert s.count() == 3
+        assert [t.tx_id for t in s.ids()] == ["t1", "t2", "t3"]
+        assert s.by_type("USD").sum() == 0x11
+        assert s.by_enrollment_id("bob@org1").count() == 1
+        assert s.enrollment_ids() == ["alice@org1", "bob@org1"]
+        assert s.token_types() == ["USD", "EUR"]
+
+    def test_owner_stream_dedups(self):
+        s = InputStream(_QS(set()), self._inputs())
+        owners = s.owners()
+        assert isinstance(owners, OwnerStream)
+        assert owners.count() == 2
+        assert owners.owners() == [b"alice", b"bob"]
+
+    def test_is_any_mine(self):
+        inputs = self._inputs()
+        assert InputStream(_QS({ID("t2", 1)}), inputs).is_any_mine()
+        assert not InputStream(_QS(set()), inputs).is_any_mine()
+
+
+# ---------------------------------------------------------------------------
+# external wallet signing
+# ---------------------------------------------------------------------------
+
+class TestExternalWalletSigner:
+    def test_sign_round_trip_and_done(self):
+        server_stream, client_stream = ext.QueuePairStream.pair()
+        keys = new_signing_identity()
+
+        def provider(party):
+            return keys if bytes(party) == bytes(keys.identity) else None
+
+        client = ext.StreamExternalWalletSignerClient(provider, client_stream)
+        worker = threading.Thread(target=client.respond, daemon=True)
+        worker.start()
+
+        server = ext.StreamExternalWalletSignerServer(server_stream)
+        sigma = server.sign(bytes(keys.identity), b"endorse-me")
+        server.done()
+        worker.join(timeout=10)
+        assert not worker.is_alive()
+        keys.verifier().verify(b"endorse-me", sigma)
+
+    def test_client_rejects_unknown_party(self):
+        server_stream, client_stream = ext.QueuePairStream.pair()
+        client = ext.StreamExternalWalletSignerClient(
+            lambda party: None, client_stream)
+        server = ext.StreamExternalWalletSignerServer(server_stream)
+        errs = []
+
+        def run():
+            try:
+                client.respond()
+            except ext.ExternalWalletError as e:
+                errs.append(e)
+
+        worker = threading.Thread(target=run, daemon=True)
+        worker.start()
+        server.stream.send(ext._encode(ext.SIG_REQUEST, {
+            "party": b"ghost".hex(), "message": b"m".hex()}))
+        worker.join(timeout=10)
+        assert errs and "no signer" in str(errs[0])
+
+    def test_server_rejects_wrong_response_type(self):
+        server_stream, client_stream = ext.QueuePairStream.pair()
+        server = ext.StreamExternalWalletSignerServer(server_stream)
+        client_stream.send(ext._encode(ext.DONE, None))
+        with pytest.raises(ext.ExternalWalletError, match="expected sign"):
+            server.sign(b"p", b"m")
+
+
+# ---------------------------------------------------------------------------
+# Request.upgrade verb
+# ---------------------------------------------------------------------------
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def upgrade_world():
+    """Old-format plaintext token on the ledger; zkatdlog pp now active."""
+    issuer, auditor = new_signing_identity(), new_signing_identity()
+    alice, bob = new_signing_identity(), new_signing_identity()
+
+    fab_pp = fabtoken.setup(BIT_LENGTH)
+    fab_pp.issuer_ids = [issuer.identity]
+    fab_pp.auditor = bytes(auditor.identity)
+    ledger = MemoryLedger()
+    fab_cc = TokenChaincode(fabtoken.new_validator(fab_pp, Deserializer()),
+                            ledger, fab_pp.serialize())
+    issue = fabtoken.IssueAction(
+        issuer=issuer.identity,
+        outputs=[fabtoken.Output(bytes(alice.identity), "USD", "0x4d")])
+    req = TokenRequest(issues=[issue.serialize()])
+    msg = req.message_to_sign(b"old1")
+    req.auditor_signatures = [auditor.sign(msg)]
+    req.signatures = [issuer.sign(msg)]
+    assert fab_cc.process_request("old1", req.to_bytes()).status == "VALID"
+
+    zk_pp = zk_setup.setup(BIT_LENGTH)
+    zk_pp.issuer_ids = [issuer.identity]
+    zk_pp.auditor = bytes(auditor.identity)
+    from fabric_token_sdk_tpu.core import zkatdlog
+
+    zk_cc = TokenChaincode(
+        zkatdlog.new_validator(zk_pp, Deserializer(), device=False),
+        ledger, zk_pp.serialize())
+    return dict(zk_pp=zk_pp, zk_cc=zk_cc, issuer=issuer, auditor=auditor,
+                alice=alice, bob=bob, fab_raw=issue.outputs[0].serialize())
+
+
+class TestRequestUpgrade:
+    def test_upgrade_verb_end_to_end(self, upgrade_world):
+        w = upgrade_world
+        driver = ZkDlogDriverService(w["zk_pp"], device=False)
+        rows = [UnspentToken(id=ID("old1", 0),
+                             owner=bytes(w["alice"].identity),
+                             type="USD", quantity="0x4d")]
+        req = Request("up1", driver)
+        action = req.upgrade(rows, bytes(w["bob"].identity),
+                             wallet=lambda tid: (w["fab_raw"], None))
+        # the assembled transfer carries an upgrade witness for the input
+        assert action.inputs[0].upgrade_witness is not None
+        assert action.inputs[0].upgrade_witness.quantity == "0x4d"
+
+        wire = req.token_request()
+        msg = req.marshal_to_sign()
+        wire.auditor_signatures = [w["auditor"].sign(msg)]
+        wire.signatures = [w["alice"].sign(msg)]
+        res = w["zk_cc"].process_request("up1", wire.to_bytes())
+        assert res.status == "VALID", res.message
+
+    def test_upgrade_empty_tokens_rejected(self, upgrade_world):
+        driver = ZkDlogDriverService(upgrade_world["zk_pp"], device=False)
+        req = Request("up2", driver)
+        with pytest.raises(RequestBuilderError, match="empty"):
+            req.upgrade([], b"bob")

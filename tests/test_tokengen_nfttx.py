@@ -89,6 +89,61 @@ def test_tokengen_rejects_unsupported_bits(tmp_path):
                  "--output", str(tmp_path)]) == 2
 
 
+def test_tokengen_certifier_keygen(tmp_path):
+    """cmd/tokengen certifier-keygen (cobra/certfier/keypairgen.go)."""
+    assert main(["gen", "dlog", "--bits", "16",
+                 "--output", str(tmp_path)]) == 0
+    rc = main(["certifier-keygen", "--driver", "dlog",
+               "--pppath", str(tmp_path / "zkatdlog_pp.json"),
+               "--output", str(tmp_path / "cert")])
+    assert rc == 0
+    from fabric_token_sdk_tpu.services.identity.x509 import (
+        keypair_from_pem)
+
+    kp = keypair_from_pem((tmp_path / "cert" / "certifier_sk.pem")
+                          .read_bytes())
+    sig = kp.sign(b"certify")
+    kp.verifier().verify(b"certify", sig)
+    # driver/pp mismatch is rejected
+    assert main(["certifier-keygen", "--driver", "fabtoken",
+                 "--pppath", str(tmp_path / "zkatdlog_pp.json"),
+                 "--output", str(tmp_path)]) == 2
+
+
+def test_tokengen_artifacts_gen(tmp_path):
+    """cmd/tokengen artifacts gen (cobra/artifactgen): topology ->
+    identities + wired pp + manifest."""
+    import json
+
+    topo = {"driver": "fabtoken", "precision": 32,
+            "nodes": [{"name": "issuer", "role": "issuer"},
+                      {"name": "aud", "role": "auditor"},
+                      {"name": "alice"}, {"name": "bob"}]}
+    tf = tmp_path / "topology.json"
+    tf.write_text(json.dumps(topo))
+    out = tmp_path / "artifacts"
+    assert main(["artifacts", "gen", "--topology", str(tf),
+                 "--output", str(out)]) == 0
+
+    manifest = json.loads((out / "manifest.json").read_text())
+    assert [n["name"] for n in manifest["nodes"]] == \
+        ["issuer", "aud", "alice", "bob"]
+    # pp is wired with the generated issuer/auditor identities
+    from fabric_token_sdk_tpu.services.identity.x509 import keypair_from_pem
+
+    pp = fabtoken.PublicParams.deserialize((out / "pp.json").read_bytes())
+    issuer_kp = keypair_from_pem(
+        (out / "crypto" / "issuer" / "sk.pem").read_bytes())
+    aud_kp = keypair_from_pem((out / "crypto" / "aud" / "sk.pem")
+                              .read_bytes())
+    assert [bytes(i) for i in pp.issuer_ids] == [bytes(issuer_kp.identity)]
+    assert bytes(pp.auditor) == bytes(aud_kp.identity)
+    # empty topology is rejected
+    tf.write_text(json.dumps({"nodes": []}))
+    assert main(["artifacts", "gen", "--topology", str(tf),
+                 "--output", str(out)]) == 2
+
+
 def test_tokengen_update_preserves_material(tmp_path):
     from fabric_token_sdk_tpu.crypto.setup import PublicParams
 
