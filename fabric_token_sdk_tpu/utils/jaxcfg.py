@@ -31,6 +31,53 @@ def raise_stack_limit() -> None:
         pass  # best effort: platform without rlimits or no privilege
 
 
+def ensure_main_thread_stack() -> None:
+    """Give the MAIN thread a big stack by raising RLIMIT_STACK and
+    RE-EXECING the interpreter.
+
+    raise_stack_limit() covers threads created afterwards, but the main
+    thread's usable stack is fixed at exec time: the kernel computes
+    mmap_base from the THEN-current soft limit, so raising it later
+    leaves only the original ~8 MiB of growable space. jaxlib's native
+    serialize/deserialize of the big MSM executables recurses past that
+    ON THE MAIN THREAD — the persistent-cache read/write SIGSEGVs seen
+    at jax/_src/compilation_cache.py put/get_executable_and_time.
+    Re-exec with the raised limit makes the new process image lay out a
+    large main stack; children inherit the raised limit and need no
+    re-exec. Must be called BEFORE importing jax."""
+    import sys
+
+    if os.environ.get("FTS_STACK_REEXEC"):
+        return
+    os.environ["FTS_STACK_REEXEC"] = "1"
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        want = 512 * 1024 * 1024
+        if soft == resource.RLIM_INFINITY or soft >= want:
+            return  # exec-time limit already big: main stack is fine
+        new_soft = want if hard == resource.RLIM_INFINITY \
+            else min(want, hard)
+        resource.setrlimit(resource.RLIMIT_STACK, (new_soft, hard))
+    except (ImportError, ValueError, OSError):
+        return  # cannot raise: re-exec would not help
+    if "jax" in sys.modules:
+        return  # too late: re-exec would replay the caller's side effects
+    argv = list(getattr(sys, "orig_argv", []) or [])
+    if len(argv) < 2 or not sys.executable:
+        return  # interactive session: nothing replayable
+    if "-" in argv[1:]:
+        return  # program text came from stdin: exec cannot replay it
+    sys.stdout.flush()
+    sys.stderr.flush()
+    try:
+        # execv does not search PATH; orig_argv[0] may be a bare "python"
+        os.execv(sys.executable, [sys.executable] + argv[1:])
+    except OSError:
+        pass
+
+
 def _host_tag() -> str:
     """Fingerprint of the host CPU feature set.
 
@@ -53,10 +100,61 @@ def _host_tag() -> str:
     return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
 
 
+def install_cache_size_guard(max_hlo_bytes: int | None = None) -> None:
+    """Skip persistent-caching of oversized XLA:CPU executables.
+
+    jaxlib's native executable serialize/deserialize SEGFAULTS on the
+    biggest MSM kernels (reproduced at compilation_cache.py:265 write and
+    :238 read, with unlimited stack — a size-dependent jaxlib bug, not
+    resource exhaustion). Entries above the threshold are never written,
+    so the poisonous reads can never happen either; those kernels simply
+    recompile per process. Threshold is on the HLO-module proto size — a
+    cheap, serialize-free proxy measured BEFORE the crashing call.
+    """
+    import jax  # noqa: F401
+    from jax._src import compilation_cache as cc
+
+    if getattr(cc, "_fts_size_guard", False):
+        return
+    if max_hlo_bytes is None:
+        # calibrated: the MSM-class kernels lower to ~55-70 MB HLO /
+        # 300-400 MB serialized executables — the size class whose
+        # serialize/deserialize crashes; everything smaller has cached
+        # reliably across hundreds of runs
+        max_hlo_bytes = int(os.environ.get("FTS_CACHE_MAX_HLO_BYTES",
+                                           str(30 * 1024 * 1024)))
+    orig_put = cc.put_executable_and_time
+
+    def guarded_put(cache_key, module_name, executable, backend,
+                    compile_time):
+        if backend.platform == "cpu":
+            try:
+                size = sum(
+                    len(m.as_serialized_hlo_module_proto())
+                    for m in executable.hlo_modules())
+            except Exception:
+                size = 0
+            if size > max_hlo_bytes:
+                import logging
+
+                logging.getLogger("fabric_token_sdk_tpu.jaxcfg").info(
+                    "not caching %s: hlo %d bytes > %d (serialize-crash "
+                    "guard)", module_name, size, max_hlo_bytes)
+                return
+        return orig_put(cache_key, module_name, executable, backend,
+                        compile_time)
+
+    cc.put_executable_and_time = guarded_put
+    cc._fts_size_guard = True
+
+
 def configure_jax_cache() -> None:
+    ensure_main_thread_stack()  # re-execs if jax is not yet imported
+
     import jax
 
     raise_stack_limit()
+    install_cache_size_guard()
     base = os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache")
     # Segment by backend platform AND host CPU: the axon (remote-TPU)
     # client writes XLA:CPU AOT artifacts compiled on the REMOTE host into

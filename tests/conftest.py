@@ -14,9 +14,13 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-# stack limit must rise BEFORE jax spawns compilation threads
-from fabric_token_sdk_tpu.utils.jaxcfg import raise_stack_limit  # noqa: E402
+# stack limits must rise BEFORE jax exists: worker-thread stacks via
+# setrlimit, the MAIN thread via re-exec (serialize/deserialize of the big
+# cached executables runs natively on the main thread)
+from fabric_token_sdk_tpu.utils.jaxcfg import (ensure_main_thread_stack,
+                                               raise_stack_limit)  # noqa: E402
 
+ensure_main_thread_stack()
 raise_stack_limit()
 
 flags = os.environ.get("XLA_FLAGS", "")
@@ -39,3 +43,164 @@ jax.config.update("jax_platforms", "cpu")
 from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache  # noqa: E402
 
 configure_jax_cache()
+
+
+# ---------------------------------------------------------------------------
+# Heavy-kernel module isolation
+#
+# Full-suite runs (pytest tests/ -q) accumulate hundreds of live XLA:CPU
+# executables; with that state, DESERIALIZING the biggest cached kernels
+# (the combined RLC MSM) segfaults inside jaxlib's compilation-cache read
+# (jax/_src/compilation_cache.py get_executable_and_time — reproduced at
+# the same site across rounds; the identical read succeeds in a fresh
+# process every time). Modules that compile those kernels therefore run in
+# their OWN pytest subprocess during multi-module sessions: each gets the
+# empirically-green solo configuration, the parent session never loads the
+# big executables, and per-test results are re-reported transparently.
+# ---------------------------------------------------------------------------
+
+_HEAVY_MODULES = {
+    "test_range_verifier.py",
+    "test_range_verifier_multibit.py",
+    "test_range_verifier_sharded.py",
+    "test_zkatdlog_e2e.py",
+    "test_zk_audit.py",
+    "test_ops_windowed.py",
+    "test_parallel.py",
+    "test_sigma_device.py",
+}
+#: Modules whose parametrized variants each load their OWN big kernel set
+#: (multibit: 16/32/64-bit tables+executables) — one process per TEST,
+#: or the in-process accumulation crosses the crash threshold again.
+_HEAVY_PER_TEST = {"test_range_verifier_multibit.py"}
+_ISOLATE_ENV = "FTS_ISOLATED_SUBPROCESS"
+_SUBPROC_RESULTS: dict = {}
+_GROUP_NODEIDS: dict = {}
+
+
+def _session_module_names(session):
+    return {Path(str(item.fspath)).name for item in session.items}
+
+
+def _group_key(item):
+    name = Path(str(item.fspath)).name
+    if name in _HEAVY_PER_TEST:
+        return (name, item.nodeid)
+    return (name, "")
+
+
+def pytest_collection_modifyitems(session, config, items):
+    if os.environ.get(_ISOLATE_ENV):
+        return  # inside an isolation subprocess: run normally
+    if len(_session_module_names(session)) < 2:
+        return  # single-module invocation: solo config already; no need
+    for item in items:
+        if Path(str(item.fspath)).name in _HEAVY_MODULES:
+            item._fts_isolate = True
+            _GROUP_NODEIDS.setdefault(_group_key(item), []).append(
+                item.nodeid)
+
+
+def _run_group_subprocess(nodeids: list) -> dict:
+    """Run one isolation group in a fresh pytest process; id -> outcome."""
+    import subprocess
+    import tempfile
+    import xml.etree.ElementTree as ET
+
+    with tempfile.NamedTemporaryFile(suffix=".xml", delete=False) as fh:
+        xml_path = fh.name
+    env = dict(os.environ)
+    env[_ISOLATE_ENV] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", *nodeids, "-q", "--tb=line",
+             f"--junitxml={xml_path}"],
+            cwd=str(Path(__file__).resolve().parent.parent),
+            env=env, capture_output=True, text=True, timeout=5400)
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.unlink(xml_path)
+        except OSError:
+            pass
+        return {"__error__": (
+            "failed", f"isolated subprocess timed out: {exc}")}
+    results: dict = {}
+    try:
+        root = ET.parse(xml_path).getroot()
+        for case in root.iter("testcase"):
+            cls = case.attrib.get("classname", "")
+            name = case.attrib.get("name", "")
+            # junit classname tests.test_mod.TestCls -> nodeid pieces
+            parts = cls.split(".")
+            mod_idx = next((i for i, p in enumerate(parts)
+                            if p.startswith("test_")), len(parts) - 1)
+            nodeparts = parts[mod_idx + 1:] + [name]
+            key = "::".join(nodeparts)
+            if case.find("failure") is not None \
+                    or case.find("error") is not None:
+                node = case.find("failure")
+                if node is None:
+                    node = case.find("error")
+                results[key] = ("failed",
+                                (node.attrib.get("message", "") or "")
+                                + "\n" + (node.text or ""))
+            elif case.find("skipped") is not None:
+                node = case.find("skipped")
+                results[key] = ("skipped",
+                                node.attrib.get("message", "") or "skipped")
+            else:
+                results[key] = ("passed", "")
+    except Exception as exc:  # subprocess crashed before writing results
+        results["__error__"] = (
+            "failed",
+            f"isolated subprocess failed (rc={proc.returncode}): {exc}\n"
+            + proc.stdout[-2000:] + proc.stderr[-2000:])
+    finally:
+        try:
+            os.unlink(xml_path)
+        except OSError:
+            pass
+    if proc.returncode not in (0, 1) and "__error__" not in results:
+        results["__crash__"] = (
+            "failed",
+            f"isolated subprocess died rc={proc.returncode}\n"
+            + proc.stdout[-2000:] + proc.stderr[-2000:])
+    return results
+
+
+def pytest_runtest_protocol(item, nextitem):
+    if not getattr(item, "_fts_isolate", False):
+        return None
+    from _pytest.reports import TestReport
+
+    path = Path(str(item.fspath))
+    key = _group_key(item)
+    if key not in _SUBPROC_RESULTS:
+        _SUBPROC_RESULTS[key] = _run_group_subprocess(
+            _GROUP_NODEIDS.get(key, [item.nodeid]))
+    results = _SUBPROC_RESULTS[key]
+
+    # nodeid within the module: "TestCls::test_name" or "test_name[param]"
+    local = item.nodeid.split("::", 1)[1] if "::" in item.nodeid else \
+        item.nodeid
+    outcome, detail = results.get(
+        local, results.get("__error__",
+                           results.get("__crash__",
+                                       ("failed",
+                                        "no result from subprocess"))))
+
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    if outcome == "passed":
+        rep = TestReport(item.nodeid, item.location, {}, "passed", None,
+                         "call", [], 0.0)
+    elif outcome == "skipped":
+        rep = TestReport(item.nodeid, item.location, {}, "skipped",
+                         (str(path), 0, detail), "call", [], 0.0)
+    else:
+        rep = TestReport(item.nodeid, item.location, {}, "failed",
+                         f"[isolated subprocess] {detail}", "call", [], 0.0)
+    item.ihook.pytest_runtest_logreport(report=rep)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True
