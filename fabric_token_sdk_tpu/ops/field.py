@@ -146,14 +146,26 @@ def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray
 _DIAG_MATS: dict = {}
 
 
-def _diag_mats(na: int, nb: int, out_cols: int):
-    """0/1 f32 matrices mapping flattened partial products to columns.
+def _reduction_dtype() -> jnp.dtype:
+    """Element type for the column-reduction matmuls.
 
-    M_lo[(i*nb+j), k] = 1 iff i+j == k; M_hi shifts by one limb. Column sums
-    are < 2^22, exactly representable in f32 — so the whole diagonal-sum
-    reduction is one f32 matmul (MXU-eligible on TPU).
+    TPU: bf16 byte planes — every operand is an exact small integer
+    (plane values <= 255, 0/1 diagonal matrix) and the MXU accumulates in
+    f32, so four SINGLE-pass bf16 matmuls replace two SIX-pass
+    Precision.HIGHEST f32 matmuls (the innermost cost of every mont_mul;
+    3x less MXU work). CPU: f32 — XLA:CPU cannot run bf16 dots, and a
+    single f32 pass is already exact there."""
+    return jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+
+def _diag_mats(na: int, nb: int, out_cols: int):
+    """0/1 matrices mapping flattened partial products to columns.
+
+    M_lo[(i*nb+j), k] = 1 iff i+j == k; M_hi shifts by one limb. Column
+    sums over <= 2^8 terms of <= 2^16 values stay < 2^24: exact in f32
+    accumulation (both the bf16-planes TPU path and the f32 CPU path).
     """
-    key = (na, nb, out_cols)
+    key = (na, nb, out_cols, str(_reduction_dtype()))
     if key not in _DIAG_MATS:
         lo = np.zeros((na * nb, out_cols), dtype=np.float32)
         hi = np.zeros((na * nb, out_cols), dtype=np.float32)
@@ -163,7 +175,8 @@ def _diag_mats(na: int, nb: int, out_cols: int):
                     lo[i * nb + j, i + j] = 1.0
                 if i + j + 1 < out_cols:
                     hi[i * nb + j, i + j + 1] = 1.0
-        _DIAG_MATS[key] = (lo, hi)  # numpy: safe to cache across traces
+        dt = _reduction_dtype()
+        _DIAG_MATS[key] = (lo.astype(dt), hi.astype(dt))
     m_lo, m_hi = _DIAG_MATS[key]
     return jnp.asarray(m_lo), jnp.asarray(m_hi)
 
@@ -173,20 +186,83 @@ def _shift_add_product(a: jnp.ndarray, b: jnp.ndarray, nb: int,
     """Lazy column sums of the product a * b.
 
     a: (..., na) canonical limbs; b: (nb,) constant or (..., nb) limbs.
-    Returns (..., out_cols) lazy columns (each < 2^22). Partial products are
-    split lo/hi 16-bit halves and reduced along anti-diagonals with two f32
-    matmuls — exact (sums < 2^22 < 2^24) and compile-friendly.
+    Returns (..., out_cols) lazy columns (each < 2^24). Partial products
+    are reduced along anti-diagonals with exact matmuls: on TPU the 32-bit
+    products split into four bf16 byte planes (values <= 255, single MXU
+    pass each, f32 accumulation); on CPU into two f32 16-bit halves.
     """
     na = a.shape[-1]
     p = a[..., :, None] * jnp.broadcast_to(b, a.shape[:-1] + (nb,))[..., None, :]
-    lo = (p & MASK).astype(jnp.float32).reshape(*a.shape[:-1], na * nb)
-    hi = (p >> BITS).astype(jnp.float32).reshape(*a.shape[:-1], na * nb)
+    flat = a.shape[:-1] + (na * nb,)
     m_lo, m_hi = _diag_mats(na, nb, out_cols)
-    # Precision.HIGHEST: TPU matmuls default to bf16 passes, which would
-    # corrupt the exact integer sums; HIGHEST gives true-f32 accumulation.
-    cols = (jnp.matmul(lo, m_lo, precision=jax.lax.Precision.HIGHEST)
-            + jnp.matmul(hi, m_hi, precision=jax.lax.Precision.HIGHEST))
+    if _reduction_dtype() == jnp.bfloat16:
+        b0 = (p & 0xFF).astype(jnp.bfloat16).reshape(flat)
+        b1 = ((p >> 8) & 0xFF).astype(jnp.bfloat16).reshape(flat)
+        b2 = ((p >> 16) & 0xFF).astype(jnp.bfloat16).reshape(flat)
+        b3 = (p >> 24).astype(jnp.bfloat16).reshape(flat)
+        f32 = jnp.float32
+        lo_cols = (
+            jnp.matmul(b0, m_lo, preferred_element_type=f32)
+            + jnp.matmul(b1, m_lo, preferred_element_type=f32) * 256.0)
+        hi_cols = (
+            jnp.matmul(b2, m_hi, preferred_element_type=f32)
+            + jnp.matmul(b3, m_hi, preferred_element_type=f32) * 256.0)
+        cols = lo_cols + hi_cols          # both < 2^24: exact f32 sum
+    else:
+        lo = (p & MASK).astype(jnp.float32).reshape(flat)
+        hi = (p >> BITS).astype(jnp.float32).reshape(flat)
+        # single f32 pass is exact on CPU (sums < 2^24)
+        cols = (jnp.matmul(lo, m_lo, precision=jax.lax.Precision.HIGHEST)
+                + jnp.matmul(hi, m_hi, precision=jax.lax.Precision.HIGHEST))
     return cols.astype(jnp.uint32)
+
+
+_NIBBLE_MATS: dict = {}
+
+
+def _nibble_toeplitz(const_limbs: tuple, out_cols: int) -> np.ndarray:
+    """(64, out_cols*4) int8 Toeplitz matrix: nibble convolution with a
+    CONSTANT multiplicand.
+
+    Row i holds const nibble (k-i) at output-nibble column k, so
+    nibbles(a) @ W = nibble column sums of a*const — values <= 64 terms x
+    15*15 = 14400, well inside the int8-MXU's int32 accumulator."""
+    key = (const_limbs, out_cols)
+    if key not in _NIBBLE_MATS:
+        c = []
+        for limb in const_limbs:
+            for shift in (0, 4, 8, 12):
+                c.append((int(limb) >> shift) & 0xF)
+        out_n = out_cols * 4
+        w = np.zeros((64, out_n), dtype=np.int8)
+        for i in range(64):
+            for j in range(len(c)):
+                if i + j < out_n:
+                    w[i, i + j] = c[j]
+        _NIBBLE_MATS[key] = w
+    return _NIBBLE_MATS[key]
+
+
+def _const_product_cols(a: jnp.ndarray, const_limbs: tuple,
+                        out_cols: int) -> jnp.ndarray:
+    """Lazy column sums of a * CONSTANT via one int8 MXU dot (TPU path).
+
+    a: (..., 16) canonical limbs. Splits a into 64 int8 nibbles, contracts
+    with the precomputed Toeplitz matrix (int8 x int8 -> int32, native MXU
+    at 2x bf16 rate), then folds nibble columns (weights 1,16,256,4096)
+    back to 16-bit limb columns: lazy cols < 2^26, exact throughout.
+    Replaces 256 VPU multiplies + two matmuls per constant product."""
+    l = a.astype(jnp.int32)
+    nib = jnp.stack([l & 0xF, (l >> 4) & 0xF, (l >> 8) & 0xF,
+                     (l >> 12) & 0xF], axis=-1).astype(jnp.int8)
+    nib = nib.reshape(*a.shape[:-1], 64)
+    w = jnp.asarray(_nibble_toeplitz(const_limbs, out_cols))
+    cols_n = jax.lax.dot_general(
+        nib, w, (((nib.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    cn = cols_n.reshape(*cols_n.shape[:-1], out_cols, 4).astype(jnp.uint32)
+    return (cn[..., 0] + (cn[..., 1] << 4) + (cn[..., 2] << 8)
+            + (cn[..., 3] << 12))
 
 
 def _cond_sub_mod(res: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
@@ -239,10 +315,15 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     t_cols = _shift_add_product(a, b, N, 2 * N)
     T = _carry_propagate(t_cols, 2 * N + 1)
 
-    m_cols = _shift_add_product(T[..., :N], spec.nprime_arr, N, N)
-    m = _carry_propagate(m_cols, N)
-
-    u_cols = _shift_add_product(m, spec.mod_arr, N, 2 * N)
+    if jax.default_backend() != "cpu":
+        # constant-operand products ride the int8 MXU (nibble Toeplitz)
+        m_cols = _const_product_cols(T[..., :N], spec.nprime, N)
+        m = _carry_propagate(m_cols, N)
+        u_cols = _const_product_cols(m, spec.mod, 2 * N)
+    else:
+        m_cols = _shift_add_product(T[..., :N], spec.nprime_arr, N, N)
+        m = _carry_propagate(m_cols, N)
+        u_cols = _shift_add_product(m, spec.mod_arr, N, 2 * N)
     s = _carry_propagate(T + jnp.pad(u_cols, [(0, 0)] * (T.ndim - 1) + [(0, 1)]),
                          2 * N + 1)
     res = s[..., N:]  # (..., N+1); low N limbs are zero by construction

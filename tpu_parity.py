@@ -1,0 +1,123 @@
+"""Device-vs-host parity spot check for the field/EC kernel layer.
+
+Run ON THE REAL CHIP after touching ops/field.py or ops/ec.py (the MXU
+truncation class of bug passes on CPU and fails only on TPU — see
+.claude/skills/verify/SKILL.md). Checks mont_mul (incl. the byte-plane
+reduction + int8 nibble constant products), complete adds, windowed MSM
+and fixed-base gather against the pure-Python host oracle on random
+inputs. Exits non-zero on any mismatch.
+"""
+
+import sys
+
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+configure_jax_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fabric_token_sdk_tpu.crypto import bn254  # noqa: E402
+from fabric_token_sdk_tpu.ops import ec, field as F, limbs as L  # noqa: E402
+
+rng = np.random.default_rng(0xF1E1D)
+FAILS = 0
+
+
+def check(name, got, want):
+    global FAILS
+    ok = (np.asarray(got) == np.asarray(want)).all()
+    print(f"  {name}: {'ok' if ok else 'MISMATCH'}")
+    if not ok:
+        FAILS += 1
+
+
+def rand_fp(n):
+    return [int.from_bytes(rng.bytes(31), "little") % bn254.P
+            for _ in range(n)]
+
+
+def host_affine_limbs(p):
+    """Host G1 -> canonical affine limbs (2, 16); identity -> zeros."""
+    if p is None:
+        return np.zeros((2, L.NLIMBS), dtype=np.uint32)
+    return np.stack([L.int_to_limbs(p.x), L.int_to_limbs(p.y)])
+
+
+def main():
+    print(f"backend={jax.devices()[0].platform}")
+    B = 64
+
+    # ---- mont_mul vs host
+    a_int, b_int = rand_fp(B), rand_fp(B)
+    R = 1 << 256
+    a = jnp.asarray(np.stack([L.int_to_limbs(v) for v in a_int]))
+    b = jnp.asarray(np.stack([L.int_to_limbs(v) for v in b_int]))
+    mm = jax.jit(lambda x, y: F.mont_mul(x, y, F.FP))
+    got = np.asarray(mm(a, b))
+    want = np.stack([
+        L.int_to_limbs(av * bv * pow(R, -1, bn254.P) % bn254.P)
+        for av, bv in zip(a_int, b_int)])
+    check("mont_mul(fp)", got, want)
+
+    mmr = jax.jit(lambda x, y: F.mont_mul(x, y, F.FR))
+    ar = [v % bn254.R for v in a_int]
+    br = [v % bn254.R for v in b_int]
+    a2 = jnp.asarray(np.stack([L.int_to_limbs(v) for v in ar]))
+    b2 = jnp.asarray(np.stack([L.int_to_limbs(v) for v in br]))
+    got = np.asarray(mmr(a2, b2))
+    want = np.stack([
+        L.int_to_limbs(av * bv * pow(R, -1, bn254.R) % bn254.R)
+        for av, bv in zip(ar, br)])
+    check("mont_mul(fr)", got, want)
+
+    # ---- complete add vs host
+    ks = [int.from_bytes(rng.bytes(31), "little") % bn254.R for _ in range(B)]
+    pts = [bn254.g1_mul(bn254.G1_GENERATOR, k) for k in ks]
+    qts = [bn254.g1_mul(bn254.G1_GENERATOR, k + 7) for k in ks]
+    pd = jnp.asarray(L.points_to_projective_limbs(pts))
+    qd = jnp.asarray(L.points_to_projective_limbs(qts))
+    s = jax.jit(ec.add)(pd, qd)
+    aff = np.asarray(jax.jit(ec.to_affine)(s))
+    want_aff = np.stack([
+        host_affine_limbs(bn254.g1_add(p, q))
+        for p, q in zip(pts, qts)])
+    check("ec.add + to_affine", aff, want_aff)
+
+    # ---- windowed MSM vs host
+    T = 8
+    msm_pts = [[bn254.g1_mul(bn254.G1_GENERATOR, 3 + i * T + t)
+                for t in range(T)] for i in range(4)]
+    msm_sc = [[int.from_bytes(rng.bytes(31), "little") % bn254.R
+               for _ in range(T)] for _ in range(4)]
+    dpts = jnp.asarray(np.stack(
+        [L.points_to_projective_limbs(row) for row in msm_pts]))
+    dsc = jnp.asarray(np.stack(
+        [L.scalars_to_limbs(row) for row in msm_sc]))
+    out = np.asarray(jax.jit(ec.to_affine)(jax.jit(ec.msm_windowed)(dpts, dsc)))
+    want = np.stack([
+        host_affine_limbs(bn254.msm(prow, srow))
+        for prow, srow in zip(msm_pts, msm_sc)])
+    check("msm_windowed", out, want)
+
+    # ---- fixed-base gather vs host
+    gens = [bn254.g1_mul(bn254.G1_GENERATOR, 11 + t) for t in range(4)]
+    tables = jax.jit(ec.fixed_base_planes)(
+        jnp.asarray(L.points_to_projective_limbs(gens)))
+    sc = [[int.from_bytes(rng.bytes(31), "little") % bn254.R
+           for _ in range(4)] for _ in range(3)]
+    dsc = jnp.asarray(np.stack([L.scalars_to_limbs(row) for row in sc]))
+    got = np.asarray(jax.jit(ec.to_affine)(
+        jax.jit(ec.fixed_base_gather)(tables, dsc)))
+    want = np.stack([
+        np.stack([host_affine_limbs(bn254.g1_mul(g, s))
+                  for g, s in zip(gens, row)]) for row in sc])
+    check("fixed_base_gather", got, want)
+
+    print("PARITY PASS" if FAILS == 0 else f"PARITY FAIL ({FAILS})")
+    return 1 if FAILS else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
