@@ -60,6 +60,43 @@ def _regen():
     print(f"wrote {N_PROOFS} proofs to {BENCH_DIR}", file=sys.stderr)
 
 
+def _regen_block():
+    """Mixed Issue+Transfer corpus for BASELINE config 3 (actions with
+    full Σ+range proofs, 2 outputs each -> 2 range proofs per action)."""
+    import pickle
+
+    from fabric_token_sdk_tpu.crypto import bn254, setup, token_commit
+    from fabric_token_sdk_tpu.crypto import issue_proof as ipf
+    from fabric_token_sdk_tpu.crypto import transfer_proof as tpf
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    ped = pp.pedersen_generators
+    transfers, issues = [], []
+    for i in range(2):
+        in_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+        out_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+        v = 1000 + i
+        inputs = [token_commit.commit_token("USD", v, bf, ped)
+                  for bf in in_bfs]
+        outputs = [token_commit.commit_token("USD", v, bf, ped)
+                   for bf in out_bfs]
+        raw = tpf.transfer_prove([("USD", v, bf) for bf in in_bfs],
+                                 [("USD", v, bf) for bf in out_bfs],
+                                 inputs, outputs, pp)
+        transfers.append((raw, inputs, outputs))
+        print(f"block corpus: transfer {i} done", file=sys.stderr)
+    for i in range(2):
+        bfs = [bn254.fr_rand(), bn254.fr_rand()]
+        v = 500 + i
+        toks = [token_commit.commit_token("EUR", v, bf, ped) for bf in bfs]
+        raw = ipf.issue_prove([("EUR", v, bf) for bf in bfs], toks, pp)
+        issues.append((raw, toks))
+        print(f"block corpus: issue {i} done", file=sys.stderr)
+    (BENCH_DIR / f"block_{BIT_LENGTH}.pkl").write_bytes(
+        pickle.dumps({"transfers": transfers, "issues": issues}))
+    print(f"wrote mixed block corpus to {BENCH_DIR}", file=sys.stderr)
+
+
 def _load():
     from fabric_token_sdk_tpu.crypto import rp, setup
     from fabric_token_sdk_tpu.crypto import serialization as ser
@@ -89,14 +126,104 @@ def _replay(verifier, proofs, coms, total: int):
     return done / elapsed
 
 
+def _bench_config1():
+    """BASELINE config 1: single-tx 2-in/2-out transfer validate on ONE
+    host CPU core (the Go-validator-equivalent reference number) at the
+    reference's 16-bit range config. No device; pure host oracle."""
+    import statistics
+
+    from fabric_token_sdk_tpu.crypto import bn254, setup, token_commit
+    from fabric_token_sdk_tpu.crypto import transfer_proof as tpf
+
+    pp = setup.setup(16)
+    ped = pp.pedersen_generators
+    in_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+    out_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+    inputs = [token_commit.commit_token("USD", 30, bf, ped) for bf in in_bfs]
+    outputs = [token_commit.commit_token("USD", 30, bf, ped)
+               for bf in out_bfs]
+    raw = tpf.transfer_prove([("USD", 30, bf) for bf in in_bfs],
+                             [("USD", 30, bf) for bf in out_bfs],
+                             inputs, outputs, pp)
+    lat = []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        tpf.transfer_verify(raw, inputs, outputs, pp)
+        lat.append(time.perf_counter() - t0)
+    p50 = statistics.median(lat)
+    # 2 outputs -> 2 range proofs per validate
+    print(json.dumps({
+        "metric": "config1_single_tx_transfer_validate_p50_16bit",
+        "value": round(p50 * 1e3, 2),
+        "unit": "ms (host single-core; 2 range proofs/tx -> "
+                f"{round(2 / p50, 1)} proofs/s)",
+        "vs_baseline": round((2 / p50) / TARGET_BASELINE, 6),
+    }))
+
+
+def _bench_block(total_actions: int):
+    """BASELINE config 3: mixed Issue+Transfer block through the auditor's
+    batch re-verify (ZKVerifier.verify_block; all Σ checks in one device
+    pass per slice, all range proofs in one batched range pass)."""
+    import pickle
+
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.crypto import setup
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    blob = pickle.loads(
+        (BENCH_DIR / f"block_{BIT_LENGTH}.pkl").read_bytes())
+    base_t, base_i = blob["transfers"], blob["issues"]
+    # tile the corpus to BATCH actions per slice (half transfers/issues);
+    # each action carries 2 range proofs
+    slice_t = (base_t * (BATCH // 2 // len(base_t) + 1))[:BATCH // 2]
+    slice_i = (base_i * (BATCH // 2 // len(base_i) + 1))[:BATCH // 2]
+    zk = ZKVerifier(pp, device=True)
+    print("block bench: warm-up slice", file=sys.stderr)
+    t0 = time.perf_counter()
+    t_ok, i_ok = zk.verify_block(slice_t, slice_i)
+    assert t_ok.all() and i_ok.all(), "block corpus failed"
+    print(f"block bench: warm-up in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    done = 0
+    t0 = time.perf_counter()
+    while done < total_actions:
+        t_ok, i_ok = zk.verify_block(slice_t, slice_i)
+        assert t_ok.all() and i_ok.all()
+        done += len(slice_t) + len(slice_i)
+    elapsed = time.perf_counter() - t0
+    proofs = done * 2  # 2 range proofs per action
+    print(json.dumps({
+        "metric": f"config3_mixed_block_proofs_per_sec_{BIT_LENGTH}bit",
+        "value": round(proofs / elapsed, 2),
+        "unit": f"proofs/s ({round(done / elapsed, 1)} actions/s, "
+                f"{done} actions)",
+        "vs_baseline": round(proofs / elapsed / TARGET_BASELINE, 4),
+    }))
+
+
 def main():
     if "--regen" in sys.argv:
         _regen()
         return
+    if "--regen-block" in sys.argv:
+        _regen_block()
+        return
     if not (BENCH_DIR / f"proofs_{BIT_LENGTH}.bin").exists():
         _regen()
 
+    mode = os.environ.get("BENCH_MODE", "")
+    if mode == "config1":
+        _bench_config1()
+        return
+
     _configure_jax_cache()
+
+    if mode == "block":
+        if not (BENCH_DIR / f"block_{BIT_LENGTH}.pkl").exists():
+            _regen_block()
+        _bench_block(int(os.environ.get("BENCH_BLOCK", "10000")))
+        return
 
     from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
 

@@ -31,10 +31,13 @@ class ZKVerifier:
     def __init__(self, pp, device: bool = True):
         self.pp = pp
         self._range = None
+        self._sigma = None
         if device:
             from ...models.range_verifier import BatchRangeVerifier
+            from ...models.sigma import BatchSigmaVerifier
 
             self._range = BatchRangeVerifier(pp)
+            self._sigma = BatchSigmaVerifier(pp)
 
     # ------------------------------------------------------------ transfer
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
@@ -83,6 +86,110 @@ class ZKVerifier:
             self._verify_range_batch(proof.range_correctness, coms)
         except ProofError as e:
             raise ProofError(f"invalid issue proof: {e}") from e
+
+    # ---------------------------------------------------------------- block
+    def verify_block(self, transfers: list, issues: list) -> "tuple":
+        """Whole-block verification (BASELINE config 3: the auditor's batch
+        re-verify of a mixed Issue+Transfer block).
+
+        transfers: (proof_raw, inputs, outputs) per transfer action;
+        issues: (proof_raw, commitments) per issue action. Returns
+        (transfer_accepts, issue_accepts) bool vectors. ALL Σ-protocol
+        checks ride one device pass (models/sigma.py) and ALL range proofs
+        across every action ride one batched range pass — per-action host
+        verification only happens on rejects (exact error reproduction is
+        the per-action APIs' job; this is the throughput path).
+        """
+        import numpy as np
+
+        t_ok = np.zeros(len(transfers), dtype=bool)
+        i_ok = np.zeros(len(issues), dtype=bool)
+        if self._range is None or self._sigma is None:
+            for k, (raw, ins, outs) in enumerate(transfers):
+                try:
+                    self.verify_transfer(raw, ins, outs)
+                    t_ok[k] = True
+                except ProofError:
+                    pass
+            for k, (raw, coms) in enumerate(issues):
+                try:
+                    self.verify_issue(raw, coms)
+                    i_ok[k] = True
+                except ProofError:
+                    pass
+            return t_ok, i_ok
+
+        # 1. deserialize; structural failures stay rejected
+        t_proofs: dict[int, object] = {}
+        i_proofs: dict[int, object] = {}
+        for k, (raw, ins, outs) in enumerate(transfers):
+            try:
+                p = transfer_proof.TransferProof.deserialize(raw)
+                if p.type_and_sum is not None:
+                    t_proofs[k] = p
+            except (ValueError, ProofError):
+                pass
+        for k, (raw, coms) in enumerate(issues):
+            try:
+                i_proofs[k] = issue_proof.IssueProof.deserialize(raw)
+            except (ValueError, ProofError):
+                pass
+
+        # 2. Σ batch on device
+        ts_items = [(t_proofs[k].type_and_sum, transfers[k][1],
+                     transfers[k][2]) for k in sorted(t_proofs)]
+        st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
+        ts_acc = self._sigma.verify_type_and_sum(ts_items)
+        st_acc = self._sigma.verify_same_type(st_items)
+        sigma_ok_t = {k: bool(ts_acc[j])
+                      for j, k in enumerate(sorted(t_proofs))}
+        sigma_ok_i = {k: bool(st_acc[j])
+                      for j, k in enumerate(sorted(i_proofs))}
+
+        # 3. cross-action range batch (one device call for the whole block)
+        range_proofs, range_coms, owners = [], [], []
+        for k in sorted(t_proofs):
+            p, (_, ins, outs) = t_proofs[k], transfers[k]
+            if not sigma_ok_t[k]:
+                continue
+            if len(ins) == 1 and len(outs) == 1:
+                continue  # ownership transfer: no range part
+            if p.range_correctness is None \
+                    or len(p.range_correctness.proofs) != len(outs):
+                sigma_ok_t[k] = False
+                continue
+            ctt = p.type_and_sum.commitment_to_type
+            for o, rp_proof in zip(outs, p.range_correctness.proofs):
+                range_proofs.append(rp_proof)
+                range_coms.append(g1_add(o, g1_neg(ctt)))
+                owners.append(("t", k))
+        for k in sorted(i_proofs):
+            p, (_, coms) = i_proofs[k], issues[k]
+            if not sigma_ok_i[k]:
+                continue
+            if p.range_correctness is None \
+                    or len(p.range_correctness.proofs) != len(coms):
+                sigma_ok_i[k] = False
+                continue
+            ctt = p.same_type.commitment_to_type
+            for c, rp_proof in zip(coms, p.range_correctness.proofs):
+                range_proofs.append(rp_proof)
+                range_coms.append(g1_add(c, g1_neg(ctt)))
+                owners.append(("i", k))
+        if range_proofs:
+            accepts = self._range.verify(range_proofs, range_coms)
+            for acc, (kind, k) in zip(accepts, owners):
+                if not acc:
+                    if kind == "t":
+                        sigma_ok_t[k] = False
+                    else:
+                        sigma_ok_i[k] = False
+
+        for k, v in sigma_ok_t.items():
+            t_ok[k] = v
+        for k, v in sigma_ok_i.items():
+            i_ok[k] = v
+        return t_ok, i_ok
 
     # ------------------------------------------------------------- helpers
     def _verify_range_batch(self, rc: rp.RangeCorrectness,

@@ -1,0 +1,175 @@
+"""Batched device verification of the zkatdlog Σ-protocols.
+
+Replaces the reference's per-action host loops for the two Schnorr-style
+proofs with one device pass per batch (SURVEY.md §2.2 marks both
+batchable):
+
+  - type-and-sum (reference crypto/transfer/typeandsum.go:230-277): per
+    input the verifier recomputes in_com_i = g^{v_i} h^{b_i} A_i^{-c},
+    plus sum_com = h^{eq} S^{-c} and type_com = q^{t} h^{tbf} T^{-c},
+    then re-derives the Fiat-Shamir challenge from their bytes.
+  - same-type (reference crypto/issue/sametype.go:167-183): one
+    com = q^{t} h^{bf} C_T^{-c} per issue action.
+
+Every recomputed point is the SAME shape: a fixed-base part over the
+three Pedersen generators (q=ped[0], g=ped[1], h=ped[2]) plus ONE
+variable-point windowed multiplication — so a whole batch flattens into
+one (rows, 3)-scalar fixed-base MSM + one (rows, 1)-term windowed MSM +
+a single batched affine conversion (one Fermat inversion for all rows).
+Challenge re-derivation (SHA) stays on host; adjusted points A_i, S are
+host point ADDS only (no scalar muls — those all ride the device).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bn254
+from ..crypto import serialization as ser
+from ..crypto.bn254 import fr_neg, g1_add, g1_neg, hash_to_zr
+from ..ops import ec, limbs
+from .batching import bucket_rows as _bucket_rows
+from .range_verifier import affine_batch_to_bytes
+
+
+@jax.jit
+def _sigma_tables_kernel(gens):
+    return ec.fixed_base_planes(gens)
+
+
+@jax.jit
+def _sigma_rows_kernel(tables, fixed_sc, var_pts, var_sc):
+    """Per row: fixed-base MSM over the 3 Pedersen generators plus one
+    windowed variable-point mul; returns canonical affine (R, 2, 16).
+
+    tables: (3, 32, 256, 96); fixed_sc: (R, 3, 16); var_pts: (R, 3, 16);
+    var_sc: (R, 16)."""
+    fixed = ec.fixed_base_msm(tables, fixed_sc)              # (R, 3, 16)
+    var = ec.msm_windowed(var_pts[:, None], var_sc[:, None])  # (R, 3, 16)
+    total = ec.add(fixed, var)
+    # one batched inversion across every row (leading singleton batch)
+    return ec.to_affine_batch(total[None])[0]                # (R, 2, 16)
+
+
+@dataclass(frozen=True)
+class _Row:
+    """One recomputed commitment: fixed scalars + var point + var scalar."""
+
+    fixed: tuple          # (s_q, s_g, s_h) ints
+    var_point: object     # host G1
+    var_scalar: int
+
+
+class BatchSigmaVerifier:
+    """Device-batched type-and-sum / same-type verification for one pp."""
+
+    def __init__(self, pp):
+        self.pp = pp
+        gens = limbs.points_to_projective_limbs(
+            list(pp.pedersen_generators[:3]))
+        self.tables = _sigma_tables_kernel(jnp.asarray(gens))
+
+    # ------------------------------------------------------------ device
+    def _run_rows(self, rows: list[_Row]) -> np.ndarray:
+        """(R, 64)-byte affine encodings for every row, device-computed."""
+        r_bucket = _bucket_rows(max(1, len(rows)))
+        fixed = np.zeros((r_bucket, 3, limbs.NLIMBS), dtype=np.uint32)
+        var_sc = np.zeros((r_bucket, limbs.NLIMBS), dtype=np.uint32)
+        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+        var_pts = np.broadcast_to(
+            id_pt, (r_bucket,) + id_pt.shape).copy()
+        for i, row in enumerate(rows):
+            fixed[i] = limbs.scalars_to_limbs(list(row.fixed))
+            var_pts[i] = limbs.point_to_projective_limbs(row.var_point)
+            var_sc[i] = limbs.scalars_to_limbs([row.var_scalar])[0]
+        aff = _sigma_rows_kernel(self.tables, jnp.asarray(fixed),
+                                 jnp.asarray(var_pts), jnp.asarray(var_sc))
+        return affine_batch_to_bytes(np.asarray(aff)[:len(rows)])
+
+    # ------------------------------------------------------- same-type
+    def verify_same_type(self, proofs: list) -> np.ndarray:
+        """Batch of issue SameTypeProof -> bool accept vector."""
+        B = len(proofs)
+        ok = np.zeros(B, dtype=bool)
+        rows, live = [], []
+        for i, p in enumerate(proofs):
+            if (p is None or p.type_ is None or p.blinding_factor is None
+                    or p.challenge is None or p.commitment_to_type is None):
+                continue
+            live.append(i)
+            rows.append(_Row(fixed=(p.type_, 0, p.blinding_factor),
+                             var_point=p.commitment_to_type,
+                             var_scalar=fr_neg(p.challenge)))
+        if not live:
+            return ok
+        enc = self._run_rows(rows)
+        for row_i, i in enumerate(live):
+            p = proofs[i]
+            com_hex = bytes(enc[row_i]).hex().encode("ascii")
+            transcript = ser.SEPARATOR.join(
+                [ser.g1_to_bytes(p.commitment_to_type).hex().encode("ascii"),
+                 com_hex])
+            ok[i] = hash_to_zr(transcript) == p.challenge
+        return ok
+
+    # --------------------------------------------------- type-and-sum
+    def verify_type_and_sum(self, items: list) -> np.ndarray:
+        """items: (TypeAndSumProof, inputs, outputs) triples -> accepts."""
+        B = len(items)
+        ok = np.zeros(B, dtype=bool)
+        rows: list[_Row] = []
+        meta = []  # (item idx, n_in, adj_inputs, adj_outputs, sum_)
+        for i, (p, inputs, outputs) in enumerate(items):
+            if (p is None or p.type_blinding_factor is None
+                    or p.type_ is None or p.commitment_to_type is None
+                    or p.equality_of_sum is None or p.challenge is None):
+                continue
+            if (len(p.input_values) < len(inputs)
+                    or len(p.input_blinding_factors) < len(inputs)
+                    or any(v is None for v in p.input_values[:len(inputs)])):
+                continue
+            neg_c = fr_neg(p.challenge)
+            adj_in, adj_out = [], []
+            sum_ = bn254.G1_IDENTITY
+            for pt in inputs:
+                a = g1_add(pt, g1_neg(p.commitment_to_type))
+                adj_in.append(a)
+                sum_ = g1_add(sum_, a)
+            for pt in outputs:
+                a = g1_add(pt, g1_neg(p.commitment_to_type))
+                adj_out.append(a)
+                sum_ = g1_add(sum_, g1_neg(a))
+            for j in range(len(inputs)):
+                rows.append(_Row(
+                    fixed=(0, p.input_values[j],
+                           p.input_blinding_factors[j]),
+                    var_point=adj_in[j], var_scalar=neg_c))
+            rows.append(_Row(fixed=(0, 0, p.equality_of_sum),
+                             var_point=sum_, var_scalar=neg_c))
+            rows.append(_Row(fixed=(p.type_, 0, p.type_blinding_factor),
+                             var_point=p.commitment_to_type,
+                             var_scalar=neg_c))
+            meta.append((i, len(inputs), adj_in, adj_out, sum_))
+        if not meta:
+            return ok
+        enc = self._run_rows(rows)
+        cursor = 0
+        for i, n_in, adj_in, adj_out, sum_ in meta:
+            p = items[i][0]
+            in_hex = [bytes(enc[cursor + j]).hex().encode("ascii")
+                      for j in range(n_in)]
+            sum_hex = bytes(enc[cursor + n_in]).hex().encode("ascii")
+            type_hex = bytes(enc[cursor + n_in + 1]).hex().encode("ascii")
+            cursor += n_in + 2
+            # transcript order per typeandsum.go:214,267
+            transcript = ser.SEPARATOR.join(
+                in_hex + [type_hex, sum_hex]
+                + [ser.g1_to_bytes(q).hex().encode("ascii")
+                   for q in (adj_in + adj_out
+                             + [p.commitment_to_type, sum_])])
+            ok[i] = hash_to_zr(transcript) == p.challenge
+        return ok

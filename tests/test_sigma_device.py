@@ -1,0 +1,194 @@
+"""Device-batched Σ-protocol verification vs the host oracle.
+
+Covers models/sigma.py against crypto/transfer_proof.type_and_sum_verify
+and crypto/issue_proof.same_type_verify (reference typeandsum.go:230-277,
+sametype.go:167-183): same accept/reject on valid proofs, tampered
+responses, wrong challenges, and mixed batches.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254, setup
+from fabric_token_sdk_tpu.crypto import issue_proof as ip
+from fabric_token_sdk_tpu.crypto import transfer_proof as tp
+from fabric_token_sdk_tpu.crypto.bn254 import (fr_rand, fr_sub, g1_add,
+                                               g1_mul, hash_to_zr)
+from fabric_token_sdk_tpu.models.sigma import BatchSigmaVerifier
+
+BIT = 16
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(BIT)
+
+
+@pytest.fixture(scope="module")
+def sigma(pp):
+    return BatchSigmaVerifier(pp)
+
+
+def _make_transfer(pp, n_in=2, n_out=2, value=20):
+    ped = pp.pedersen_generators
+    token_type = "USD"
+    type_zr = hash_to_zr(token_type.encode())
+    type_bf = fr_rand()
+    ctt = g1_add(g1_mul(ped[0], type_zr), g1_mul(ped[2], type_bf))
+    in_vals = [value] * n_in
+    out_vals = [value * n_in // n_out] * n_out
+    in_bfs = [fr_rand() for _ in range(n_in)]
+    out_bfs = [fr_rand() for _ in range(n_out)]
+    from fabric_token_sdk_tpu.crypto import token_commit
+
+    inputs = [token_commit.commit_token(token_type, v, bf, ped)
+              for v, bf in zip(in_vals, in_bfs)]
+    outputs = [token_commit.commit_token(token_type, v, bf, ped)
+               for v, bf in zip(out_vals, out_bfs)]
+    proof = tp.type_and_sum_prove(ped, inputs, outputs, ctt, in_vals,
+                                  in_bfs, out_bfs, type_zr, type_bf)
+    return proof, inputs, outputs
+
+
+def _make_same_type(pp):
+    ped = pp.pedersen_generators
+    type_bf = fr_rand()
+    type_zr = hash_to_zr(b"USD")
+    ctt = g1_add(g1_mul(ped[0], type_zr), g1_mul(ped[2], type_bf))
+    return ip.same_type_prove("USD", type_bf, ctt, ped)
+
+
+class TestTypeAndSumDevice:
+    def test_valid_batch_accepts(self, pp, sigma):
+        items = [_make_transfer(pp, n_in=1 + (i % 3), n_out=2)
+                 for i in range(5)]
+        accepts = sigma.verify_type_and_sum(items)
+        assert accepts.all()
+        # host oracle agrees item by item
+        for proof, inputs, outputs in items:
+            tp.type_and_sum_verify(proof, pp.pedersen_generators, inputs,
+                                   outputs)
+
+    def test_tampered_entries_rejected_only(self, pp, sigma):
+        items = [_make_transfer(pp) for _ in range(4)]
+        # tamper item 1's response and item 3's challenge
+        items[1][0].equality_of_sum = fr_sub(items[1][0].equality_of_sum, 1)
+        items[3][0].challenge = fr_sub(items[3][0].challenge, 1)
+        accepts = sigma.verify_type_and_sum(items)
+        assert list(accepts) == [True, False, True, False]
+        for i in (1, 3):
+            with pytest.raises(tp.ProofError):
+                tp.type_and_sum_verify(items[i][0], pp.pedersen_generators,
+                                       items[i][1], items[i][2])
+
+    def test_wrong_value_response_rejected(self, pp, sigma):
+        proof, inputs, outputs = _make_transfer(pp)
+        proof.input_values[0] = fr_sub(proof.input_values[0], 1)
+        accepts = sigma.verify_type_and_sum([(proof, inputs, outputs)])
+        assert not accepts[0]
+
+    def test_structural_nils_rejected(self, pp, sigma):
+        proof, inputs, outputs = _make_transfer(pp)
+        proof.type_ = None
+        accepts = sigma.verify_type_and_sum([(proof, inputs, outputs)])
+        assert not accepts[0]
+        accepts = sigma.verify_type_and_sum([(None, inputs, outputs)])
+        assert not accepts[0]
+
+    def test_short_response_vectors_rejected(self, pp, sigma):
+        proof, inputs, outputs = _make_transfer(pp, n_in=2)
+        proof.input_values = proof.input_values[:1]
+        accepts = sigma.verify_type_and_sum([(proof, inputs, outputs)])
+        assert not accepts[0]
+
+
+class TestVerifyBlock:
+    """ZKVerifier.verify_block: mixed Issue+Transfer block, one device
+    pass for all Σ checks + one for all range proofs (config 3 shape)."""
+
+    @pytest.fixture(scope="class")
+    def zk(self, pp):
+        from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+
+        return ZKVerifier(pp, device=True)
+
+    def _transfer_raw(self, pp, tamper=None):
+        from fabric_token_sdk_tpu.crypto import token_commit
+
+        ped = pp.pedersen_generators
+        in_bfs = [fr_rand(), fr_rand()]
+        out_bfs = [fr_rand(), fr_rand()]
+        inputs = [token_commit.commit_token("USD", 10, bf, ped)
+                  for bf in in_bfs]
+        outputs = [token_commit.commit_token("USD", 10, bf, ped)
+                   for bf in out_bfs]
+        raw = tp.transfer_prove(
+            [("USD", 10, bf) for bf in in_bfs],
+            [("USD", 10, bf) for bf in out_bfs], inputs, outputs, pp)
+        if tamper == "sigma":
+            p = tp.TransferProof.deserialize(raw)
+            p.type_and_sum.equality_of_sum = fr_sub(
+                p.type_and_sum.equality_of_sum, 1)
+            raw = p.serialize()
+        elif tamper == "range":
+            p = tp.TransferProof.deserialize(raw)
+            p.range_correctness.proofs[0].data.tau = fr_sub(
+                p.range_correctness.proofs[0].data.tau, 1)
+            raw = p.serialize()
+        return raw, inputs, outputs
+
+    def _issue_raw(self, pp):
+        from fabric_token_sdk_tpu.crypto import token_commit
+
+        ped = pp.pedersen_generators
+        bfs = [fr_rand(), fr_rand()]
+        toks = [token_commit.commit_token("EUR", 7, bf, ped) for bf in bfs]
+        raw = ip.issue_prove([("EUR", 7, bf) for bf in bfs], toks, pp)
+        return raw, toks
+
+    def test_mixed_block_accepts_and_isolates_rejects(self, pp, zk):
+        transfers = [self._transfer_raw(pp),
+                     self._transfer_raw(pp, tamper="sigma"),
+                     self._transfer_raw(pp, tamper="range")]
+        issues = [self._issue_raw(pp), (b"garbage", [])]
+        t_ok, i_ok = zk.verify_block(transfers, issues)
+        assert list(t_ok) == [True, False, False]
+        assert list(i_ok) == [True, False]
+        # per-action APIs agree on the rejects (exact-error path)
+        from fabric_token_sdk_tpu.crypto.rp import ProofError
+
+        zk.verify_transfer(*transfers[0])
+        with pytest.raises(ProofError):
+            zk.verify_transfer(*transfers[1])
+        with pytest.raises(ProofError):
+            zk.verify_transfer(*transfers[2])
+
+    def test_block_host_fallback_matches(self, pp):
+        from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+
+        host = ZKVerifier(pp, device=False)
+        transfers = [self._transfer_raw(pp),
+                     self._transfer_raw(pp, tamper="sigma")]
+        t_ok, i_ok = host.verify_block(transfers, [])
+        assert list(t_ok) == [True, False]
+        assert i_ok.shape == (0,)
+
+
+class TestSameTypeDevice:
+    def test_valid_and_tampered_mixed(self, pp, sigma):
+        proofs = [_make_same_type(pp) for _ in range(4)]
+        proofs[2].blinding_factor = fr_sub(proofs[2].blinding_factor, 1)
+        accepts = sigma.verify_same_type(proofs)
+        assert list(accepts) == [True, True, False, True]
+        with pytest.raises(ip.ProofError):
+            ip.same_type_verify(proofs[2], pp.pedersen_generators)
+        ip.same_type_verify(proofs[0], pp.pedersen_generators)
+
+    def test_nil_fields_rejected(self, pp, sigma):
+        p = _make_same_type(pp)
+        p.challenge = None
+        accepts = sigma.verify_same_type([p, None])
+        assert not accepts.any()
+
+    def test_empty_batch(self, sigma):
+        assert sigma.verify_same_type([]).shape == (0,)
+        assert sigma.verify_type_and_sum([]).shape == (0,)
