@@ -345,14 +345,21 @@ def _pt12_neg(q):
     return (q[0], fp12_sub(zero, q[1]))
 
 
+def _g1_is_identity(p) -> bool:
+    """bn254.G1 spells the identity as G1(0, 0, inf=True) — never None —
+    but accept both spellings defensively."""
+    return p is None or getattr(p, "inf", False)
+
+
 def miller_loop(p, q) -> tuple:
     """Miller loop f_{6t+2,Q}(P) * line corrections (optimal ate, BN254).
 
     p: bn254.G1 (affine host point); q: G2 affine pair over Fp2.
     Returns an Fp12 element — run final_exponentiation (or accumulate a
-    product of loops first) to land in GT.
+    product of loops first) to land in GT. Identity inputs contribute the
+    neutral element (e(O, Q) = e(P, O) = 1).
     """
-    if p is None or q is None:
+    if _g1_is_identity(p) or q is None:
         return FP12_ONE
     p_embed = (_embed_fp(p.x), _embed_fp(p.y))
     q12 = _untwist(q)
@@ -405,4 +412,5 @@ def gt_eq(p1, q1, p2, q2) -> bool:
     product with one side negated must be 1."""
     from .bn254 import g1_neg
 
-    return pairing_product_is_one([(p1, q1), (g1_neg(p2), q2)])
+    neg_p2 = None if _g1_is_identity(p2) else g1_neg(p2)
+    return pairing_product_is_one([(p1, q1), (neg_p2, q2)])

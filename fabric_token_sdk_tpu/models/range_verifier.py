@@ -441,16 +441,92 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     return _ProofEquations(fixed=fixed, var=var)
 
 
-class BatchRangeVerifier:
-    """Vectorized range-proof verification for one public-parameter set."""
+def _make_sharded_combined(mesh):
+    """Sharded RLC pass: var-MSM terms sharded over EVERY mesh device;
+    each device runs the windowed MSM on its term shard, partial points
+    are all-gathered (96 uint32/device riding ICI) and folded locally —
+    point addition is not a psum-able ring op, so gather+fold is the
+    TPU-native collective for it (SURVEY.md §2.5)."""
+    from jax.sharding import PartitionSpec as P
 
-    def __init__(self, pp):
+    axes = tuple(mesh.axis_names)
+
+    def body(fixed_pt, pts, sc):
+        partial = ec.msm_windowed(pts, sc)            # local term shard
+        gathered = jax.lax.all_gather(partial, axes)  # (ndev, 3, 16)
+        total = ec._tree_sum_shrink(gathered)
+        return ec.is_identity(ec.add(fixed_pt, total))
+
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(axes, None, None), P(axes, None)),
+        out_specs=P(),
+        check_vma=False,  # identity-point constants are unvarying
+    )
+
+    @jax.jit
+    def run(tables, fixed_sc, var_pts, var_sc):
+        fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
+        return sharded(fixed_pt, var_pts, var_sc)
+
+    return run
+
+
+class BatchRangeVerifier:
+    """Vectorized range-proof verification for one public-parameter set.
+
+    With `mesh` (a (dp, tp) jax.sharding.Mesh) the production kernels run
+    SPMD: pass-1 rows are batch-sharded over every device (pure data
+    parallel, no communication) and the combined RLC MSM shards its term
+    axis with one tiny all-gather point-fold — BASELINE config 5's shape.
+    """
+
+    def __init__(self, pp, mesh=None):
         self.params = _params_for(pp)
+        self.mesh = mesh
+        self._n_shard = int(mesh.devices.size) if mesh is not None else 1
+        self._combined_sharded = (_make_sharded_combined(mesh)
+                                  if mesh is not None else None)
         #: which pass-2 strategy the last verify() used ("combined",
         #: "exact", or "structure-only"); exposed for tests/metrics.
         self.last_path: str | None = None
 
+    def _put_rows(self, arr: np.ndarray) -> jnp.ndarray:
+        """Upload with the batch axis sharded over the whole mesh (or
+        plain device_put single-chip)."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(tuple(self.mesh.axis_names),
+                 *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
     # ------------------------------------------------------------------
+    def prewarm(self, batch_sizes=(1,)) -> float:
+        """Compile every device kernel for the row buckets covering
+        `batch_sizes`, at pp-install time rather than first-verify time.
+
+        Drives one full verify (combined pass rejects the synthetic batch,
+        so the exact pass compiles too) per bucket with a structurally
+        valid all-generators proof. Returns elapsed seconds. The warm-up
+        story for validators: call once after table build; first REAL
+        verify then runs at steady-state latency (VERDICT r2 weak #7).
+        """
+        import time as _time
+
+        t0 = _time.perf_counter()
+        params = self.params
+        g = bn254.G1_GENERATOR
+        fake = rp.RangeProof(
+            data=rp.RangeProofData(T1=g, T2=g, C=g, D=g, inner_product=1,
+                                   tau=1, delta=1),
+            ipa=rp.IPA(left=1, right=1,
+                       L=[g] * params.rounds, R=[g] * params.rounds))
+        for b in batch_sizes:
+            self.verify([fake] * b, [g] * b)
+        return _time.perf_counter() - t0
+
     def verify(self, proofs: list[rp.RangeProof], commitments: list,
                exact: bool = False) -> np.ndarray:
         """Returns a bool accept vector, one entry per (proof, commitment).
@@ -477,6 +553,10 @@ class BatchRangeVerifier:
 
         # ---- pass 1: K + right_gen' via fixed-base tables
         b_bucket = _bucket_rows(len(live))
+        if self._n_shard > 1:
+            # batch rows must divide evenly over the mesh
+            b_bucket = max(b_bucket, self._n_shard)
+            b_bucket += (-b_bucket) % self._n_shard
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
         id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
 
@@ -494,16 +574,16 @@ class BatchRangeVerifier:
             k_fixed_np = np.stack(
                 [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
                  for i in live])
-        yinv = jnp.asarray(_pad_rows(yinv_np, b_bucket, zero_sc))
-        k_fixed = jnp.asarray(_pad_rows(k_fixed_np, b_bucket, zero_sc))
+        yinv = self._put_rows(_pad_rows(yinv_np, b_bucket, zero_sc))
+        k_fixed = self._put_rows(_pad_rows(k_fixed_np, b_bucket, zero_sc))
         dc_pts_np = np.stack(
             [limbs.points_to_projective_limbs(
                 [proofs[i].data.D, proofs[i].data.C]) for i in live])
-        dc_pts = jnp.asarray(_pad_rows(dc_pts_np, b_bucket, id_pt))
+        dc_pts = self._put_rows(_pad_rows(dc_pts_np, b_bucket, id_pt))
         dc_sc_np = np.stack(
             [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
              for i in live])
-        dc_sc = jnp.asarray(_pad_rows(dc_sc_np, b_bucket, zero_sc))
+        dc_sc = self._put_rows(_pad_rows(dc_sc_np, b_bucket, zero_sc))
 
         rgp_aff = _affine_rows_kernel(
             _rgp_gather_kernel(params.tables, params.rgp_idx, yinv))
@@ -605,11 +685,18 @@ class BatchRangeVerifier:
         v = len(var_pts)
         p = _next_pow2(max(128, v))
         v_target = (3 * p // 4) if v <= 3 * p // 4 else p
+        if self._n_shard > 1:
+            v_target += (-v_target) % self._n_shard
         pts_np = limbs.points_to_projective_limbs(
             var_pts + [bn254.G1_IDENTITY] * (v_target - v))
         sc_np = var_scalar_limbs(v_target - v)
-        ok = _combined_kernel(params.tables, jnp.asarray(fixed_np),
-                              jnp.asarray(pts_np), jnp.asarray(sc_np))
+        if self._combined_sharded is not None:
+            ok = self._combined_sharded(
+                params.tables, jnp.asarray(fixed_np),
+                self._put_rows(pts_np), self._put_rows(sc_np))
+        else:
+            ok = _combined_kernel(params.tables, jnp.asarray(fixed_np),
+                                  jnp.asarray(pts_np), jnp.asarray(sc_np))
         return bool(ok)
 
     # ------------------------------------------------------------------

@@ -326,7 +326,7 @@ def present(ipk: IssuerPublicKey, cred: Credential, sk: int, nym: G1,
 def verify_presentation(ipk: IssuerPublicKey, pres: Presentation, nym: G1,
                         message: bytes) -> None:
     """Verifier side: pairing check + the three Schnorr equations."""
-    if pres.a_prime is None:
+    if pres.a_prime is None or pres.a_prime.is_identity():
         raise CredentialError("A' is the identity")
     n_attrs = len(ipk.h_attrs)
     idx_seen = set(pres.disclosed) | set(pres.s_hidden)

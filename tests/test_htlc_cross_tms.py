@@ -1,0 +1,261 @@
+"""Cross-TMS HTLC atomic swap (BASELINE config 4).
+
+Two INDEPENDENT token management services — TMS-A runs the fabtoken driver
+with USD, TMS-B runs the zkatdlog (ZK privacy) driver with EUR — complete
+an atomic swap through hash-locked scripts sharing one preimage, exactly
+the reference's interop flow (integration/token/interop/ suites; htlc
+script semantics from token/services/interop/htlc):
+
+  1. alice locks 100 USD on A  (script: alice -> bob,   hash H, deadline T_A)
+  2. bob   locks  77 EUR on B  (script: bob -> alice,   hash H, T_B < T_A)
+  3. alice claims the EUR on B, REVEALING the preimage on B's ledger
+  4. bob reads the preimage from B's ledger state and claims the USD on A
+
+Also covers the abort path: bob never locks, alice reclaims after her
+deadline, and nothing moves on B.
+"""
+
+import hashlib
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken, zkatdlog
+from fabric_token_sdk_tpu.core.fabtoken.actions import (IssueAction, Output,
+                                                        TransferAction)
+from fabric_token_sdk_tpu.core.zkatdlog.actions import (ActionInput,
+                                                        IssueAction as ZkIssue,
+                                                        Token,
+                                                        TransferAction as ZkTransfer)
+from fabric_token_sdk_tpu.crypto import setup as zk_setup
+from fabric_token_sdk_tpu.crypto import issue_proof, token_commit, transfer_proof
+from fabric_token_sdk_tpu.driver import TokenRequest
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import (X509Verifier,
+                                                         new_signing_identity)
+from fabric_token_sdk_tpu.services.interop.htlc import (ClaimSignature,
+                                                        HashInfo, Script,
+                                                        claim_key, lock_key,
+                                                        lock_value)
+from fabric_token_sdk_tpu.services.interop.htlc import (
+    script_verifier_resolver)
+from fabric_token_sdk_tpu.services.network.rws import KeyTranslator
+from fabric_token_sdk_tpu.services.network.tcc import (MemoryLedger,
+                                                       TokenChaincode)
+from fabric_token_sdk_tpu.token.model import ID
+
+BIT_LENGTH = 16
+
+
+def _deserializer():
+    return Deserializer(extra_owner_resolvers=[
+        script_verifier_resolver(
+            lambda ident: X509Verifier.from_identity(ident))])
+
+
+@pytest.fixture
+def swap_world():
+    """Two TMSes + the four parties. alice/bob exist on BOTH networks."""
+    issuer_a, auditor_a = new_signing_identity(), new_signing_identity()
+    issuer_b, auditor_b = new_signing_identity(), new_signing_identity()
+    alice, bob = new_signing_identity(), new_signing_identity()
+
+    pp_a = fabtoken.setup(64)
+    pp_a.issuer_ids = [issuer_a.identity]
+    pp_a.auditor = bytes(auditor_a.identity)
+    ledger_a = MemoryLedger()
+    cc_a = TokenChaincode(fabtoken.new_validator(pp_a, _deserializer()),
+                          ledger_a, pp_a.serialize())
+
+    pp_b = zk_setup.setup(BIT_LENGTH)
+    pp_b.issuer_ids = [issuer_b.identity]
+    pp_b.auditor = bytes(auditor_b.identity)
+    ledger_b = MemoryLedger()
+    cc_b = TokenChaincode(
+        zkatdlog.new_validator(pp_b, _deserializer(), device=False),
+        ledger_b, pp_b.serialize())
+
+    return dict(pp_a=pp_a, cc_a=cc_a, ledger_a=ledger_a, issuer_a=issuer_a,
+                auditor_a=auditor_a, pp_b=pp_b, cc_b=cc_b,
+                ledger_b=ledger_b, issuer_b=issuer_b, auditor_b=auditor_b,
+                alice=alice, bob=bob)
+
+
+def _submit_a(w, tx_id, issues=(), transfers=(), sigs=()):
+    req = TokenRequest(issues=[a.serialize() for a in issues],
+                       transfers=[a.serialize() for a in transfers])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [w["auditor_a"].sign(msg)]
+    req.signatures = [s(msg) if callable(s) else s for s in sigs]
+    return w["cc_a"].process_request(tx_id, req.to_bytes()), msg
+
+
+def _submit_b(w, tx_id, issues=(), transfers=(), sigs=(), raw_sigs=None):
+    req = TokenRequest(issues=[a.serialize() for a in issues],
+                       transfers=[a.serialize() for a in transfers])
+    msg = req.message_to_sign(tx_id.encode())
+    req.auditor_signatures = [w["auditor_b"].sign(msg)]
+    if raw_sigs is not None:
+        req.signatures = raw_sigs(msg)
+    else:
+        req.signatures = [s(msg) if callable(s) else s for s in sigs]
+    return w["cc_b"].process_request(tx_id, req.to_bytes()), msg
+
+
+def _issue_usd_to_alice(w):
+    issue = IssueAction(issuer=w["issuer_a"].identity,
+                        outputs=[Output(bytes(w["alice"].identity), "USD",
+                                        "0x64")])
+    ev, _ = _submit_a(w, "a-issue", issues=[issue],
+                      sigs=[w["issuer_a"].sign])
+    assert ev.status == "VALID", ev.message
+    return issue
+
+
+def _issue_eur_to_bob(w):
+    coms, wits = token_commit.get_tokens_with_witness(
+        [77], "EUR", w["pp_b"].pedersen_generators)
+    proof = issue_proof.issue_prove([x.as_tuple() for x in wits], coms,
+                                   w["pp_b"])
+    issue = ZkIssue(issuer=w["issuer_b"].identity,
+                    outputs=[Token(bytes(w["bob"].identity), coms[0])],
+                    proof=proof)
+    ev, _ = _submit_b(w, "b-issue", issues=[issue],
+                      sigs=[w["issuer_b"].sign])
+    assert ev.status == "VALID", ev.message
+    return issue, wits
+
+
+def _swap_scripts(w, preimage: bytes):
+    image = hashlib.sha256(preimage).digest().hex().encode()
+    now = time.time()
+    # alice's lock on A expires LAST: bob must have time to claim with the
+    # preimage alice reveals on B
+    script_a = Script(sender=bytes(w["alice"].identity),
+                      recipient=bytes(w["bob"].identity),
+                      deadline=now + 7200, hash_info=HashInfo(hash=image))
+    script_b = Script(sender=bytes(w["bob"].identity),
+                      recipient=bytes(w["alice"].identity),
+                      deadline=now + 3600, hash_info=HashInfo(hash=image))
+    return image, script_a, script_b
+
+
+def test_cross_tms_atomic_swap(swap_world):
+    w = swap_world
+    alice, bob = w["alice"], w["bob"]
+    preimage = b"cross-tms-swap-secret"
+    image, script_a, script_b = _swap_scripts(w, preimage)
+
+    usd_issue = _issue_usd_to_alice(w)
+    eur_issue, eur_wits = _issue_eur_to_bob(w)
+
+    # 1. alice locks 100 USD on TMS-A under script_a
+    lock_a = TransferAction(
+        inputs=[ID("a-issue", 0)],
+        input_tokens=[usd_issue.outputs[0]],
+        outputs=[Output(bytes(script_a.to_owner()), "USD", "0x64")],
+        metadata={lock_key(image): lock_value(image)})
+    ev, _ = _submit_a(w, "a-lock", transfers=[lock_a], sigs=[alice.sign])
+    assert ev.status == "VALID", ev.message
+
+    # 2. bob sees the lock on A and locks 77 EUR on TMS-B under script_b
+    out_coms, out_wits = token_commit.get_tokens_with_witness(
+        [77], "EUR", w["pp_b"].pedersen_generators)
+    tproof = transfer_proof.transfer_prove(
+        [x.as_tuple() for x in eur_wits], [x.as_tuple() for x in out_wits],
+        [eur_issue.outputs[0].data], out_coms, w["pp_b"])
+    lock_b = ZkTransfer(
+        inputs=[ActionInput(id=ID("b-issue", 0),
+                            token=eur_issue.outputs[0])],
+        outputs=[Token(bytes(script_b.to_owner()), out_coms[0])],
+        proof=tproof,
+        metadata={lock_key(image): lock_value(image)})
+    ev, _ = _submit_b(w, "b-lock", transfers=[lock_b], sigs=[bob.sign])
+    assert ev.status == "VALID", ev.message
+
+    # 3. alice claims the EUR on TMS-B, revealing the preimage
+    new_coms, new_wits = token_commit.get_tokens_with_witness(
+        [77], "EUR", w["pp_b"].pedersen_generators)
+    claim_proof = transfer_proof.transfer_prove(
+        [x.as_tuple() for x in out_wits], [x.as_tuple() for x in new_wits],
+        out_coms, new_coms, w["pp_b"])
+    claim_b = ZkTransfer(
+        inputs=[ActionInput(id=ID("b-lock", 0), token=lock_b.outputs[0])],
+        outputs=[Token(bytes(alice.identity), new_coms[0])],
+        proof=claim_proof,
+        metadata={claim_key(image): preimage})
+    ev, _ = _submit_b(
+        w, "b-claim", transfers=[claim_b],
+        raw_sigs=lambda msg: [ClaimSignature(
+            recipient_signature=alice.sign(msg),
+            preimage=preimage).to_json()])
+    assert ev.status == "VALID", ev.message
+
+    # 4. bob learns the preimage FROM B'S LEDGER (the claim wrote it) ...
+    keys = KeyTranslator()
+    revealed = w["ledger_b"].get_state(
+        keys.transfer_metadata_key(claim_key(image).decode()
+                                   if isinstance(claim_key(image), bytes)
+                                   else claim_key(image)))
+    assert revealed == preimage, "preimage must be on B's ledger"
+
+    # ... and claims the USD on TMS-A with it
+    claim_a = TransferAction(
+        inputs=[ID("a-lock", 0)],
+        input_tokens=[lock_a.outputs[0]],
+        outputs=[Output(bytes(bob.identity), "USD", "0x64")],
+        metadata={claim_key(image): revealed})
+    req = TokenRequest(transfers=[claim_a.serialize()])
+    msg = req.message_to_sign(b"a-claim")
+    req.auditor_signatures = [w["auditor_a"].sign(msg)]
+    req.signatures = [ClaimSignature(recipient_signature=bob.sign(msg),
+                                     preimage=revealed).to_json()]
+    ev = w["cc_a"].process_request("a-claim", req.to_bytes())
+    assert ev.status == "VALID", ev.message
+
+    # final state: bob owns the USD output on A; alice owns the EUR on B
+    out_a = w["ledger_a"].get_state(keys.output_key("a-claim", 0))
+    assert out_a is not None
+    from fabric_token_sdk_tpu.core.fabtoken.actions import Output as FabOut
+
+    final = FabOut.deserialize(out_a)
+    assert bytes(final.owner) == bytes(bob.identity)
+    assert final.quantity == "0x64"
+    out_b = w["ledger_b"].get_state(keys.output_key("b-claim", 0))
+    assert out_b is not None
+    final_b = Token.deserialize(out_b)
+    assert bytes(final_b.owner) == bytes(alice.identity)
+
+
+def test_cross_tms_abort_reclaims_after_deadline(swap_world):
+    """bob never locks on B: alice reclaims on A after her deadline and
+    TMS-B's ledger never changes."""
+    w = swap_world
+    alice = w["alice"]
+    preimage = b"aborted-swap-secret"
+    image = hashlib.sha256(preimage).digest().hex().encode()
+    script_a = Script(sender=bytes(alice.identity),
+                      recipient=bytes(w["bob"].identity),
+                      deadline=time.time() + 1.0,  # expires shortly
+                      hash_info=HashInfo(hash=image))
+
+    usd_issue = _issue_usd_to_alice(w)
+    lock_a = TransferAction(
+        inputs=[ID("a-issue", 0)],
+        input_tokens=[usd_issue.outputs[0]],
+        outputs=[Output(bytes(script_a.to_owner()), "USD", "0x64")],
+        metadata={lock_key(image): lock_value(image)})
+    ev, _ = _submit_a(w, "a-lock2", transfers=[lock_a], sigs=[alice.sign])
+    assert ev.status == "VALID", ev.message
+    time.sleep(1.1)  # bob never locked on B; alice's deadline passes
+
+    state_b_before = dict(w["ledger_b"].state)
+    reclaim = TransferAction(
+        inputs=[ID("a-lock2", 0)],
+        input_tokens=[lock_a.outputs[0]],
+        outputs=[Output(bytes(alice.identity), "USD", "0x64")],
+        metadata={})
+    ev, _ = _submit_a(w, "a-reclaim", transfers=[reclaim],
+                      sigs=[alice.sign])
+    assert ev.status == "VALID", ev.message
+    assert w["ledger_b"].state == state_b_before

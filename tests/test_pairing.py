@@ -145,6 +145,29 @@ class TestCredential:
         with pytest.raises(cr.CredentialError):
             cr.verify_presentation(ipk, mutated, nym, b"m")
 
+    def test_identity_aprime_forgery_rejected(self, issuer, holder):
+        """The classic BBS+ forgery A' = Abar = O makes the pairing check
+        trivially true; the verifier must reject identity A' outright —
+        and bn254 spells the identity as G1(0,0,inf), not None."""
+        sk, cred = holder
+        ipk = issuer.public
+        r_nym = fr_rand()
+        nym = g1_add(g1_mul(ipk.h_sk, sk), g1_mul(ipk.h_rand, r_nym))
+        pres = cr.present(ipk, cred, sk, nym, r_nym, {0}, b"m")
+        forged = copy.deepcopy(pres)
+        forged.a_prime = bn254.G1_IDENTITY
+        forged.a_bar = bn254.G1_IDENTITY
+        with pytest.raises(cr.CredentialError, match="identity"):
+            cr.verify_presentation(ipk, forged, nym, b"m")
+
+    def test_pairing_identity_inputs_are_neutral(self):
+        """e(O, Q) = 1 for BOTH identity spellings (None and inf=True)."""
+        q = pr.G2_GENERATOR
+        assert pr.pairing(bn254.G1_IDENTITY, q) == pr.FP12_ONE
+        assert pr.pairing(None, q) == pr.FP12_ONE
+        zero_sum = g1_add(bn254.G1_GENERATOR, g1_neg(bn254.G1_GENERATOR))
+        assert pr.pairing_product_is_one([(zero_sum, q)])
+
     def test_wrong_issuer_credential_fails_pairing(self, issuer):
         rogue = cr.IssuerKey.generate(4)
         sk = fr_rand()

@@ -1,0 +1,74 @@
+"""Production range verifier through the (dp, tp) mesh on the virtual
+8-device CPU backend: sharded results must match both the single-device
+device path and the host oracle (SURVEY.md §2.5; BASELINE config 5
+shape — pass-1 rows dp-sharded, combined RLC terms sharded with the
+all-gather point-fold)."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
+from fabric_token_sdk_tpu.parallel import make_mesh
+
+rng = random.Random(0x5AAD)
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(BIT_LENGTH)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU backend")
+    return make_mesh(8, dp=4, tp=2)
+
+
+def _prove_one(pp, value):
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    bf = bn254.fr_rand()
+    com = bn254.g1_add(bn254.g1_mul(cg[0], value), bn254.g1_mul(cg[1], bf))
+    proof = rp.range_prove(com, value, cg, bf, rpp.left_generators,
+                           rpp.right_generators, rpp.P, rpp.Q,
+                           rpp.number_of_rounds, rpp.bit_length)
+    return proof, com
+
+
+def test_sharded_matches_single_device_and_oracle(pp, mesh):
+    proofs, coms = [], []
+    for v in [0, 5, (1 << BIT_LENGTH) - 1, rng.randrange(1 << BIT_LENGTH)]:
+        pf, com = _prove_one(pp, v)
+        proofs.append(pf)
+        coms.append(com)
+    # two tampered rows exercise the sharded exact fallback
+    bad0, cb0 = _prove_one(pp, 77)
+    bad0.data.tau = bn254.fr_add(bad0.data.tau, 1)
+    proofs.append(bad0); coms.append(cb0)
+    bad1, cb1 = _prove_one(pp, 78)
+    bad1.ipa.left = bn254.fr_add(bad1.ipa.left, 1)
+    proofs.append(bad1); coms.append(cb1)
+
+    sharded = BatchRangeVerifier(pp, mesh=mesh).verify(proofs, coms)
+    single = BatchRangeVerifier(pp).verify(proofs, coms)
+    assert (sharded == single).all(), f"{sharded} != {single}"
+    assert list(sharded) == [True, True, True, True, False, False]
+
+
+def test_sharded_all_valid_takes_combined_path(pp, mesh):
+    proofs, coms = [], []
+    for v in [11, 22, 33]:
+        pf, com = _prove_one(pp, v)
+        proofs.append(pf)
+        coms.append(com)
+    v = BatchRangeVerifier(pp, mesh=mesh)
+    accepts = v.verify(proofs, coms)
+    assert accepts.all()
+    assert v.last_path == "combined"
