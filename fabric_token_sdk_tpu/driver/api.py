@@ -84,3 +84,166 @@ class IssueAction(Protocol):
     def get_metadata(self) -> dict[str, bytes]: ...
 
     def serialize(self) -> bytes: ...
+
+
+# --------------------------------------------------------------------------
+# TokenManagerService SPI (reference token/driver/tms.go:31-46)
+#
+# The reference's plugin architecture: a driver is anything that can build
+# the services below for one PublicParams set; everything above the SPI
+# (token API, services tier) talks only to these contracts. The two shipped
+# drivers (core/fabtoken, core/zkatdlog) are declared against them in
+# tests/test_registry_tms.py::TestDriverSPIConformance.
+# --------------------------------------------------------------------------
+
+
+@runtime_checkable
+class PublicParameters(Protocol):
+    """What the registry/TMS require of a driver's pp object
+    (driver/publicparams.go: Identifier/Precision/Validate/Serialize)."""
+
+    def serialize(self) -> bytes: ...
+
+    def validate(self) -> None: ...
+
+
+@runtime_checkable
+class PublicParamsManager(Protocol):
+    """driver/publicparams.go PublicParamsManager + token/ppm.go facade."""
+
+    def public_parameters(self) -> PublicParameters: ...
+
+    def serialize(self) -> bytes: ...
+
+    def validate(self) -> None: ...
+
+    def precision(self) -> int: ...
+
+    def auditors(self) -> list[bytes]: ...
+
+    def issuers(self) -> list[bytes]: ...
+
+
+@runtime_checkable
+class IssueService(Protocol):
+    """driver/issue.go:36-50 — builds an IssueAction + per-output
+    metadata (this build: crypto proof generation inside assemble_issue)."""
+
+    def assemble_issue(self, issuer_identity: bytes,
+                       outputs: list) -> tuple: ...
+
+
+@runtime_checkable
+class TransferService(Protocol):
+    """driver/transfer.go:24-37 — builds a TransferAction + metadata from
+    loaded input rows (openings) and output specs."""
+
+    def assemble_transfer(self, input_rows, outputs: list,
+                          wallet=None, sender_audit_info=None) -> tuple: ...
+
+
+@runtime_checkable
+class TokensService(Protocol):
+    """driver/tokens.go:34-50 — Deobfuscate equivalents: recover clear
+    tokens from committed outputs + openings at ingestion time."""
+
+    def extract_outputs(self, action, openings=None) -> list: ...
+
+    def parse_ledger_output(self, raw: bytes, opening: bytes | None = None): ...
+
+
+@runtime_checkable
+class AuditorService(Protocol):
+    """driver/auditor.go:12-15 — request well-formedness check against
+    audit metadata (zkatdlog: commitment re-opening + NymEID match)."""
+
+    def audit_check(self, request, metadata, input_tokens,
+                    tx_id: str) -> None: ...
+
+
+@runtime_checkable
+class DriverService(IssueService, TransferService, TokensService,
+                    AuditorService, Protocol):
+    """The consolidated per-driver service bundle member: one object
+    providing the reference's Issue/Transfer/Tokens/Auditor services
+    (tms.go:32-36 accessors). `label` identifies the driver and doubles
+    as the ledger token format it writes (token.Format)."""
+
+    label: str
+
+
+@runtime_checkable
+class WalletService(Protocol):
+    """driver/wallet.go:157-203 — role-scoped wallet directory."""
+
+    def owner_wallet(self, lookup=None): ...
+
+    def issuer_wallet(self, lookup=None): ...
+
+    def auditor_wallet(self, lookup=None): ...
+
+    def certifier_wallet(self, lookup=None): ...
+
+    def wallet_ids(self, role: str) -> list[str]: ...
+
+
+@runtime_checkable
+class Wallet(Protocol):
+    """driver/wallet.go:36-49 — one wallet's signing surface."""
+
+    def recipient_identity(self) -> tuple[bytes, bytes]: ...
+
+    def owns(self, owner_raw: bytes) -> bool: ...
+
+    def sign(self, owner_raw: bytes, message: bytes) -> bytes: ...
+
+
+@runtime_checkable
+class Authorization(Protocol):
+    """driver/wallet.go:138-155 — is an owner identity recognized, and
+    which local wallets may spend it (TMS + HTLC script + multisig escrow
+    multiplexer in the reference, core/common/authrorization.go:123)."""
+
+    def is_mine(self, tok) -> tuple[list[str], bool]: ...
+
+    def am_i_an_auditor(self) -> bool: ...
+
+
+@runtime_checkable
+class Configuration(Protocol):
+    """driver/config.go:10-25 — typed access to one TMS's config tree."""
+
+    def id(self): ...
+
+    def is_set(self, key: str) -> bool: ...
+
+    def get_string(self, key: str) -> str: ...
+
+    def get_bool(self, key: str) -> bool: ...
+
+
+@runtime_checkable
+class TokenManagerService(Protocol):
+    """driver/tms.go:31-46 — the SPI entry point: access to every driver
+    service for one TMS. Satisfied by token/tms.py TokenManagementService
+    (services/validator/deserializer accessors) once node-scoped components
+    are bound."""
+
+    def public_parameters_manager(self) -> PublicParamsManager: ...
+
+    def validator(self) -> Validator: ...
+
+    def deserializer(self) -> Deserializer: ...
+
+    def driver_services(self) -> DriverService: ...
+
+    def wallet_manager(self) -> WalletService: ...
+
+
+@runtime_checkable
+class Driver(Protocol):
+    """driver/driver.go:16 — a named factory turning serialized public
+    parameters into a full service bundle (label + services + validator +
+    deserializer). Register with core.registry.DriverRegistry."""
+
+    def __call__(self, pp_raw: bytes): ...

@@ -61,6 +61,23 @@ class TokenNode:
 
         self.wallets = WalletService.for_node(
             name, keys, self.identitydb, owner_wallet=self.owner_wallet)
+        # driver-composable ownership chain + vault token loader
+        # (core/common/plumbing.py, reference authrorization.go:123,
+        # loaders.go:209-231): identity helpers are injected so the core
+        # layer never imports the services tier
+        from ..core.common.plumbing import (AuthorizationMultiplexer,
+                                            EscrowOwnership,
+                                            VaultTokenLoader,
+                                            WalletOwnership)
+        from .identity.multisig import unwrap
+        from .identity.typed import unmarshal_typed_identity
+
+        self.auth = AuthorizationMultiplexer(
+            WalletOwnership(name, self.owner_wallet,
+                            auditor=(auditor_name == name)),
+            EscrowOwnership(name, self.owner_wallet, unwrap),
+            unmarshal_typed=unmarshal_typed_identity)
+        self.token_loader = VaultTokenLoader(self.tokendb)
         self.selector = SherdLockSelector(self.tokendb, self.lockdb,
                                           precision=precision)
         self.tokens = Tokens(self.tokendb, self._ownership,
@@ -120,18 +137,13 @@ class TokenNode:
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:
-        """tokens.go:64-129 ownership resolution: personal tokens under the
-        node name; multisig co-owned (escrow) tokens under a separate
-        '<name>.ms' wallet so the ordinary selector never spends them
-        (ttx/multisig/wallet.go separation)."""
-        if self.owner_wallet.owns(owner_raw):
-            return [self.name]
-        from .identity.multisig import unwrap
-
-        is_ms, ids = unwrap(owner_raw)
-        if is_ms and any(self.owner_wallet.owns(i) for i in ids):
-            return [f"{self.name}.ms"]
-        return []
+        """tokens.go:64-129 ownership resolution via the composable
+        authorization chain (core/common/plumbing.py): personal tokens
+        under the node name; multisig co-owned (escrow) tokens under a
+        separate '<name>.ms' wallet so the ordinary selector never spends
+        them (ttx/multisig/wallet.go separation)."""
+        ids, _ = self.auth.is_mine(owner_raw)
+        return ids
 
     def identity(self) -> bytes:
         return bytes(self.keys.identity)
@@ -231,9 +243,15 @@ class TokenNode:
         return tx
 
     def transfer(self, token_type: str, amount_hex: str, to_node: str,
-                 redeem: bool = False) -> Transaction:
+                 redeem: bool = False,
+                 recipient: tuple[bytes, bytes] | None = None) -> Transaction:
         """Assemble a transfer spending this node's tokens
-        (token/request.go:287 prepareTransfer + driver Transfer)."""
+        (token/request.go:287 prepareTransfer + driver Transfer).
+
+        `recipient` carries (identity, audit_info) already exchanged via
+        the recipient-exchange view (ttx_views.request_recipient_identity,
+        recipients.go:82-180); without it the exchange collapses to a
+        direct responder call."""
         from ..token.request_builder import Request
 
         tx_id = Transaction.new_anchor()
@@ -242,7 +260,7 @@ class TokenNode:
         target = q.to_quantity(amount_hex, self.precision).value
         change = selection.sum - target
         recipient_owner, recipient_ai = (b"", b"") if redeem else \
-            self.bus.node(to_node).recipient_identity()
+            (recipient or self.bus.node(to_node).recipient_identity())
         specs = [OutputSpec(owner=recipient_owner, token_type=token_type,
                             value=target, audit_info=recipient_ai)]
         receivers = [None if redeem else to_node]
@@ -255,7 +273,7 @@ class TokenNode:
         req = Request(tx_id, self.driver)
         try:
             req.transfer(selection.tokens, specs,
-                         wallet=self.tokendb.get_ledger_token,
+                         wallet=self.token_loader,
                          sender_audit_info=self.owner_wallet.audit_info_for,
                          receivers=receivers)
         except Exception:
@@ -304,7 +322,7 @@ class TokenNode:
         req = Request(tx_id, self.driver)
         try:
             req.transfer(selection.tokens, specs,
-                         wallet=self.tokendb.get_ledger_token,
+                         wallet=self.token_loader,
                          sender_audit_info=self.owner_wallet.audit_info_for,
                          receivers=receivers)
         except Exception:
@@ -372,7 +390,7 @@ class TokenNode:
                          [OutputSpec(owner=recipient_owner,
                                      token_type=token_type, value=total,
                                      audit_info=recipient_ai)],
-                         wallet=self.tokendb.get_ledger_token,
+                         wallet=self.token_loader,
                          sender_audit_info=lambda raw: bytes(raw),
                          receivers=[to_node])
         except Exception:

@@ -1,0 +1,77 @@
+"""Standalone common-driver plumbing (core/common/plumbing.py):
+token loaders + ownership multiplexer (reference
+token/core/common/loaders.go:47-231, authrorization.go:18-141)."""
+
+import pytest
+
+from fabric_token_sdk_tpu.core.common.plumbing import (
+    AuthorizationMultiplexer, EscrowOwnership, TokenLoadError,
+    VaultTokenLoader, WalletOwnership)
+from fabric_token_sdk_tpu.services.db.sqldb import TokenDB
+from fabric_token_sdk_tpu.services.identity.multisig import unwrap, \
+    wrap_identities
+from fabric_token_sdk_tpu.services.identity.typed import \
+    unmarshal_typed_identity
+from fabric_token_sdk_tpu.services.identity.wallet import X509OwnerWallet
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.token.model import ID
+
+
+@pytest.fixture
+def wallet():
+    return X509OwnerWallet(new_signing_identity())
+
+
+def test_vault_loader_loads_and_fails_like_reference():
+    db = TokenDB(":memory:")
+    tid = ID("tx1", 0)
+    db.store_token(tid, b"owner", "USD", "0x10", ["alice"],
+                   ledger_format="fabtoken", ledger_token=b"tok",
+                   ledger_metadata=b"md")
+    loader = VaultTokenLoader(db)
+    assert loader(tid) == (b"tok", b"md")
+    assert loader.load_tokens([tid]) == [(b"tok", b"md")]
+    with pytest.raises(TokenLoadError, match="does not exist"):
+        loader(ID("tx-unknown", 9))
+    db.delete_token(tid, spent_by="tx2")
+    with pytest.raises(TokenLoadError, match="spent or never committed"):
+        loader.load_tokens([tid])
+
+
+def test_ownership_mux_wallet_then_escrow(wallet):
+    other = X509OwnerWallet(new_signing_identity())
+    mine, _ = wallet.recipient_identity()
+    theirs, _ = other.recipient_identity()
+    mux = AuthorizationMultiplexer(
+        WalletOwnership("alice", wallet),
+        EscrowOwnership("alice", wallet, unwrap))
+
+    assert mux.is_mine(mine) == (["alice"], True)
+    assert mux.is_mine(theirs) == ([], False)
+    # co-owned escrow identity lands in the .ms wallet
+    escrow = wrap_identities(mine, theirs)
+    assert mux.is_mine(escrow) == (["alice.ms"], True)
+    # escrow I am not part of is not mine
+    foreign = wrap_identities(theirs, theirs)
+    assert mux.is_mine(foreign) == ([], False)
+
+
+def test_mux_auditor_flag_and_owner_type(wallet):
+    mine, _ = wallet.recipient_identity()
+    aud = AuthorizationMultiplexer(
+        WalletOwnership("a", wallet, auditor=True),
+        unmarshal_typed=unmarshal_typed_identity)
+    not_aud = AuthorizationMultiplexer(WalletOwnership("a", wallet))
+    assert aud.am_i_an_auditor() and not not_aud.am_i_an_auditor()
+
+    theirs, _ = X509OwnerWallet(new_signing_identity()).recipient_identity()
+    t, _ = aud.owner_type(wrap_identities(mine, theirs))
+    assert t == "ms"
+    assert aud.owner_type(mine)[0] in ("plain", "x509")
+
+
+def test_mux_satisfies_spi_contract(wallet):
+    from fabric_token_sdk_tpu.driver import api
+
+    mux = AuthorizationMultiplexer(WalletOwnership("a", wallet))
+    assert isinstance(mux, api.Authorization)

@@ -134,3 +134,90 @@ def test_request_builder_zkatdlog_with_audit(zk_pp_raw):
     md.issues[0].outputs[0].output_metadata = opening.serialize()
     with pytest.raises(Exception, match="opening"):
         req.audit_check(input_tokens=[])
+
+
+class TestDriverSPIConformance:
+    """Both shipped drivers satisfy the stated SPI contracts
+    (driver/api.py vs reference token/driver/tms.go:31-46): a third
+    driver can be written against the protocols alone."""
+
+    def test_bundles_satisfy_service_contracts(self, fab_pp_raw, zk_pp_raw):
+        from fabric_token_sdk_tpu.driver import api
+
+        reg = default_registry()
+        for raw in (fab_pp_raw, zk_pp_raw):
+            b = reg.new_bundle(raw)
+            svc = b.services
+            assert isinstance(svc, api.IssueService)
+            assert isinstance(svc, api.TransferService)
+            assert isinstance(svc, api.TokensService)
+            assert isinstance(svc, api.AuditorService)
+            assert isinstance(svc, api.DriverService)
+            assert isinstance(b.validator, api.Validator)
+            assert isinstance(b.deserializer, api.Deserializer)
+            assert isinstance(b.public_params, api.PublicParameters)
+
+    def test_tms_satisfies_entrypoint_contract(self, zk_pp_raw):
+        from fabric_token_sdk_tpu.driver import api
+        from fabric_token_sdk_tpu.services.identity.registry import \
+            WalletService as ConcreteWalletService
+
+        prov = TMSProvider(default_registry())
+        tms = prov.get_management_service(TMSID("net"), pp_raw=zk_pp_raw)
+        assert isinstance(tms, api.TokenManagerService)
+        assert isinstance(tms.public_parameters_manager(),
+                          api.PublicParamsManager)
+        assert isinstance(ConcreteWalletService({}), api.WalletService)
+
+    def test_third_driver_registrable_against_spi_alone(self, fab_pp_raw):
+        """A minimal driver written only against driver/api.py protocols
+        registers and resolves through the registry."""
+        import json
+
+        from fabric_token_sdk_tpu.core.registry import DriverBundle
+        from fabric_token_sdk_tpu.driver import api
+
+        class MiniPP:
+            def serialize(self) -> bytes:
+                return b'{"identifier": "mini"}'
+
+            def validate(self) -> None:
+                pass
+
+        class MiniService:
+            label = "mini"
+
+            def assemble_issue(self, issuer_identity, outputs):
+                return None, None
+
+            def assemble_transfer(self, input_rows, outputs, wallet=None,
+                                  sender_audit_info=None):
+                return None, None
+
+            def extract_outputs(self, action, openings=None):
+                return []
+
+            def parse_ledger_output(self, raw, opening=None):
+                return None
+
+            def audit_check(self, request, metadata, input_tokens, tx_id):
+                pass
+
+        class MiniValidator:
+            def unmarshal_actions(self, raw):
+                return []
+
+            def verify_token_request_from_raw(self, get_state, anchor, raw):
+                return [], {}
+
+        svc = MiniService()
+        assert isinstance(svc, api.DriverService)
+        assert isinstance(MiniValidator(), api.Validator)
+
+        reg = default_registry()
+        reg.register("mini", lambda raw: DriverBundle(
+            label="mini", public_params=MiniPP(), services=svc,
+            validator=MiniValidator(), deserializer=None))
+        b = reg.new_bundle(b'{"identifier": "mini"}')
+        assert b.label == "mini"
+        assert json.loads(b.public_params.serialize())["identifier"] == "mini"

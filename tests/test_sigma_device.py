@@ -173,6 +173,95 @@ class TestVerifyBlock:
         assert i_ok.shape == (0,)
 
 
+class TestPerRequestSigmaOnDevice:
+    """VERDICT r3 #4: the per-request validator path (verify_transfer /
+    verify_issue) runs its Σ scalar-muls on device; the host oracle is
+    reached only to reproduce reject error messages."""
+
+    @pytest.fixture(scope="class")
+    def zk(self, pp):
+        from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+
+        return ZKVerifier(pp, device=True)
+
+    def _transfer_raw(self, pp, tamper=None):
+        from fabric_token_sdk_tpu.crypto import token_commit
+
+        ped = pp.pedersen_generators
+        in_bfs, out_bfs = [fr_rand(), fr_rand()], [fr_rand(), fr_rand()]
+        inputs = [token_commit.commit_token("USD", 10, bf, ped)
+                  for bf in in_bfs]
+        outputs = [token_commit.commit_token("USD", 10, bf, ped)
+                   for bf in out_bfs]
+        raw = tp.transfer_prove(
+            [("USD", 10, bf) for bf in in_bfs],
+            [("USD", 10, bf) for bf in out_bfs], inputs, outputs, pp)
+        if tamper == "sigma":
+            p = tp.TransferProof.deserialize(raw)
+            p.type_and_sum.equality_of_sum = fr_sub(
+                p.type_and_sum.equality_of_sum, 1)
+            raw = p.serialize()
+        return raw, inputs, outputs
+
+    def test_accept_path_never_calls_host_sigma(self, pp, zk, monkeypatch):
+        raw, inputs, outputs = self._transfer_raw(pp)
+
+        def boom(*a, **k):
+            raise AssertionError("host Σ oracle reached on the accept path")
+
+        monkeypatch.setattr(tp, "type_and_sum_verify", boom)
+        zk.verify_transfer(raw, inputs, outputs)  # must not raise
+
+    def test_issue_accept_path_never_calls_host_sigma(self, pp, zk,
+                                                      monkeypatch):
+        from fabric_token_sdk_tpu.crypto import token_commit
+
+        ped = pp.pedersen_generators
+        bfs = [fr_rand(), fr_rand()]
+        toks = [token_commit.commit_token("EUR", 7, bf, ped) for bf in bfs]
+        raw = ip.issue_prove([("EUR", 7, bf) for bf in bfs], toks, pp)
+
+        def boom(*a, **k):
+            raise AssertionError("host Σ oracle reached on the accept path")
+
+        monkeypatch.setattr(ip, "same_type_verify", boom)
+        zk.verify_issue(raw, toks)  # must not raise
+
+    def test_sigma_reject_reproduces_host_error(self, pp, zk):
+        from fabric_token_sdk_tpu.crypto.rp import ProofError
+
+        raw, inputs, outputs = self._transfer_raw(pp, tamper="sigma")
+        with pytest.raises(ProofError, match="invalid transfer proof"):
+            zk.verify_transfer(raw, inputs, outputs)
+
+    def test_range_reverify_touches_only_rejected_rows(self, pp, zk,
+                                                       monkeypatch):
+        """VERDICT r3 #5: the host re-verify tail is O(#invalid), not
+        O(tail-from-first-bad)."""
+        from fabric_token_sdk_tpu.crypto import rp as rp_mod
+        from fabric_token_sdk_tpu.crypto.rp import ProofError
+
+        raw, inputs, outputs = self._transfer_raw(pp)
+        p = tp.TransferProof.deserialize(raw)
+        # tamper output 0's range proof only; output 1's stays valid
+        p.range_correctness.proofs[0].data.tau = fr_sub(
+            p.range_correctness.proofs[0].data.tau, 1)
+        raw = p.serialize()
+
+        calls = []
+        host_verify = rp_mod.range_verify
+
+        def counting(proof, com, *a, **k):
+            calls.append(proof)
+            return host_verify(proof, com, *a, **k)
+
+        monkeypatch.setattr(rp_mod, "range_verify", counting)
+        with pytest.raises(ProofError, match="invalid range proof at index 0"):
+            zk.verify_transfer(raw, inputs, outputs)
+        # exactly the one rejected row re-verified on host, not the tail
+        assert len(calls) == 1
+
+
 class TestSameTypeDevice:
     def test_valid_and_tampered_mixed(self, pp, sigma):
         proofs = [_make_same_type(pp) for _ in range(4)]

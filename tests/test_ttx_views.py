@@ -1,0 +1,185 @@
+"""Per-step ttx view choreography (services/ttx_views.py) — the protocol
+surface of reference token/services/ttx/{recipients,withdrawal,accept,
+status}.go over real message sessions.
+
+Covers: recipient exchange feeding a transfer, the full withdrawal
+round-trip (request -> issuer assembly -> acceptance ack -> ordering ->
+finality -> balances), ack signature verification, and status queries
+from multiple nodes' perspectives.
+"""
+
+import pytest
+
+from fabric_token_sdk_tpu.core import fabtoken
+from fabric_token_sdk_tpu.services import ttx_views as tv
+from fabric_token_sdk_tpu.services.auditor import AuditorNode
+from fabric_token_sdk_tpu.services.db.sqldb import TxStatus
+from fabric_token_sdk_tpu.services.identity.deserializer import Deserializer
+from fabric_token_sdk_tpu.services.identity.x509 import new_signing_identity
+from fabric_token_sdk_tpu.services.network.tcc import (MemoryLedger,
+                                                       TokenChaincode)
+from fabric_token_sdk_tpu.services.node import TokenNode
+from fabric_token_sdk_tpu.services.ttx import SessionBus, TtxError
+
+
+@pytest.fixture
+def net():
+    issuer_keys = new_signing_identity()
+    auditor_keys = new_signing_identity()
+    pp = fabtoken.setup(64)
+    pp.issuer_ids = [issuer_keys.identity]
+    pp.auditor = bytes(auditor_keys.identity)
+    validator = fabtoken.new_validator(pp, Deserializer())
+    cc = TokenChaincode(validator, MemoryLedger(), pp.serialize())
+    bus = SessionBus()
+    nodes = {
+        "issuer": TokenNode("issuer", issuer_keys, bus, cc,
+                            auditor_name="auditor"),
+        "auditor": AuditorNode("auditor", auditor_keys, bus, cc,
+                               auditor_name="auditor"),
+    }
+    for name in ("alice", "bob"):
+        nodes[name] = TokenNode(name, new_signing_identity(), bus, cc,
+                                auditor_name="auditor")
+    return nodes, tv.ViewBus(bus)
+
+
+class TestRecipientExchange:
+    def test_exchange_returns_usable_identity(self, net):
+        nodes, vbus = net
+        ident, ai = tv.request_recipient_identity(vbus, "bob")
+        assert nodes["bob"].owns_identity(ident)
+        assert not nodes["alice"].owns_identity(ident)
+        assert ai  # audit info present
+
+    def test_exchange_unknown_node_fails(self, net):
+        _, vbus = net
+        with pytest.raises(TtxError, match="unknown node"):
+            vbus.open_session("mallory", "recipient")
+
+    def test_exchanged_identity_feeds_transfer(self, net):
+        nodes, vbus = net
+        alice, bob = nodes["alice"], nodes["bob"]
+        tx = alice.issue("issuer", "alice", "USD", hex(100))
+        assert alice.execute(tx).status == "VALID"
+
+        recipient = tv.request_recipient_identity(vbus, "bob")
+        tx2 = alice.transfer("USD", hex(40), "bob", recipient=recipient)
+        assert alice.execute(tx2).status == "VALID"
+        assert bob.balance("USD") == 40
+        assert alice.balance("USD") == 60
+
+
+class TestWithdrawal:
+    def test_full_withdrawal_roundtrip(self, net):
+        nodes, vbus = net
+        tx_id = tv.request_withdrawal(vbus, "alice", "issuer", "USD", 250)
+        assert nodes["alice"].balance("USD") == 250
+        # both sides recorded the tx and saw it confirmed
+        assert nodes["alice"].ttxdb.get_status(tx_id) == TxStatus.CONFIRMED
+        assert nodes["issuer"].ttxdb.get_status(tx_id) == TxStatus.CONFIRMED
+        # the issuer holds alice's verified acceptance ack
+        acks = nodes["issuer"].ttxdb.get_endorsement_acks(tx_id)
+        assert nodes["alice"].identity() in acks
+
+    def test_issuer_failure_after_acceptance_closes_record(self, net,
+                                                           monkeypatch):
+        """If the issuer dies between the requester's acceptance and
+        ordering, no commit event ever fires — the requester's record must
+        be closed out as Deleted, not stuck Pending forever."""
+        nodes, vbus = net
+
+        def boom(tx, cc):
+            raise RuntimeError("orderer unreachable")
+
+        monkeypatch.setattr(tv, "ordering_and_finality", boom)
+        with pytest.raises(TtxError, match="withdrawal failed"):
+            tv.request_withdrawal(vbus, "alice", "issuer", "USD", 25)
+        recs = nodes["alice"].ttxdb.query_transactions()
+        assert len(recs) == 1
+        assert nodes["alice"].ttxdb.get_status(recs[0].tx_id) \
+            == TxStatus.DELETED
+        assert nodes["alice"].balance("USD") == 0
+
+    def test_withdrawal_from_non_issuer_fails(self, net):
+        nodes, vbus = net
+        with pytest.raises(TtxError, match="withdrawal"):
+            tv.request_withdrawal(vbus, "alice", "bob", "USD", 10)
+        assert nodes["alice"].balance("USD") == 0
+
+
+class TestAcceptAndStatus:
+    def test_distribute_for_acceptance_collects_verified_acks(self, net):
+        nodes, vbus = net
+        alice, bob = nodes["alice"], nodes["bob"]
+        tx = alice.issue("issuer", "alice", "USD", hex(100))
+        assert alice.execute(tx).status == "VALID"
+        tx2 = alice.transfer("USD", hex(30), "bob")
+        # route the distribution through the accept view instead of the
+        # direct dispatch: endorsements first, without distribution
+        dist, tx2.distribution = tx2.distribution, []
+        from fabric_token_sdk_tpu.services.ttx import collect_endorsements
+
+        collect_endorsements(tx2, alice.bus, alice.auditor_name)
+        tx2.distribution = dist
+        acks = tv.distribute_for_acceptance(vbus, tx2,
+                                            deserializer=Deserializer(),
+                                            parties=["alice", "bob"])
+        assert set(acks) == {"alice", "bob"}  # change output + payment
+        alice._watched[tx2.tx_id] = tx2.request
+        alice.ttxdb.add_token_request(tx2.tx_id, tx2.request.to_bytes())
+        from fabric_token_sdk_tpu.services.ttx import ordering_and_finality
+
+        ev = ordering_and_finality(tx2, alice.cc)
+        assert ev.status == "VALID"
+        assert bob.balance("USD") == 30
+
+    def test_status_view_across_nodes(self, net):
+        nodes, vbus = net
+        tx_id = tv.request_withdrawal(vbus, "alice", "issuer", "USD", 50)
+        assert tv.request_status(vbus, "alice", tx_id) == TxStatus.CONFIRMED
+        assert tv.request_status(vbus, "issuer", tx_id) == TxStatus.CONFIRMED
+        # a node with no record reports unknown
+        assert tv.request_status(vbus, "bob", tx_id) == TxStatus.UNKNOWN
+
+
+class TestZkWithdrawalViews:
+    """The same view choreography with the zkatdlog driver: commitment
+    openings actually ride the acceptance session."""
+
+    @pytest.fixture
+    def zknet(self):
+        from fabric_token_sdk_tpu.core import zkatdlog
+        from fabric_token_sdk_tpu.core.zkatdlog.driver import \
+            ZkDlogDriverService
+        from fabric_token_sdk_tpu.crypto import setup
+
+        pp = setup.setup(16)
+        issuer_keys = new_signing_identity()
+        auditor_keys = new_signing_identity()
+        pp.issuer_ids = [issuer_keys.identity]
+        pp.auditor = bytes(auditor_keys.identity)
+        validator = zkatdlog.new_validator(pp, Deserializer(), device=False)
+        cc = TokenChaincode(validator, MemoryLedger(), pp.serialize())
+        bus = SessionBus()
+        driver = ZkDlogDriverService(pp, device=False)
+        nodes = {
+            "issuer": TokenNode("issuer", issuer_keys, bus, cc,
+                                precision=16, auditor_name="auditor",
+                                driver=driver),
+            "auditor": AuditorNode("auditor", auditor_keys, bus, cc,
+                                   precision=16, auditor_name="auditor",
+                                   driver=driver),
+            "alice": TokenNode("alice", new_signing_identity(), bus, cc,
+                               precision=16, auditor_name="auditor",
+                               driver=driver),
+        }
+        return nodes, tv.ViewBus(bus)
+
+    def test_zk_withdrawal_openings_over_session(self, zknet):
+        nodes, vbus = zknet
+        tx_id = tv.request_withdrawal(vbus, "alice", "issuer", "EUR", 77)
+        # the opening arrived over the session and was ingested at
+        # finality: the committed token deobfuscates to alice's balance
+        assert nodes["alice"].balance("EUR") == 77
+        assert tv.request_status(vbus, "alice", tx_id) == TxStatus.CONFIRMED
