@@ -31,7 +31,10 @@ import numpy as np
 
 from . import limbs as L
 
-MASK = jnp.uint32(L.LIMB_MASK)
+# Python int (not a jnp scalar): keeps kernels that trace field ops inside
+# pallas_call bodies from capturing a device constant; dtype promotion with
+# uint32 arrays is unchanged.
+MASK = L.LIMB_MASK
 BITS = L.LIMB_BITS
 N = L.NLIMBS
 
@@ -95,16 +98,24 @@ def _lookahead(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     """Exclusive carry/borrow-lookahead prefix over the limb axis.
 
     Kogge-Stone generate/propagate: carry_{0..i} = g_i | (p_i & carry_{0..i-1}).
-    Returns carry_in per limb (exclusive prefix). Loop-free: log2(limbs)
-    combine steps via associative_scan.
+    Returns carry_in per limb (exclusive prefix). Loop-free: the log2(limbs)
+    combine steps are unrolled explicitly (pad + slice shifts only — an
+    associative_scan here emits zero-size slices that Mosaic, the pallas TPU
+    lowering, rejects; the unrolled form runs everywhere and traces to the
+    same number of vector ops).
     """
-    def combine(left, right):
-        lg, lp = left
-        rg, rp = right
-        return rg | (rp & lg), lp & rp
-
-    inc_g, _ = jax.lax.associative_scan(combine, (g, p), axis=-1)
-    return _shift_right_one(inc_g.astype(jnp.uint32))
+    n = g.shape[-1]
+    pad_cfg = lambda d: [(0, 0)] * (g.ndim - 1) + [(d, 0)]
+    d = 1
+    while d < n:
+        # combine((g,p) shifted right by d, (g,p)): shifted-in identity is
+        # (g=0, p=1) so lanes below d keep their current value.
+        g_s = jnp.pad(g[..., :-d], pad_cfg(d))
+        p_s = jnp.pad(p[..., :-d], pad_cfg(d), constant_values=True)
+        g = g | (p & g_s)
+        p = p & p_s
+        d *= 2
+    return _shift_right_one(g.astype(jnp.uint32))
 
 
 def _carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
@@ -137,9 +148,12 @@ def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray
     borrow_in = _lookahead(g, p)
     diff = (a + jnp.uint32(1 << BITS) - b - borrow_in) & MASK
     # total borrow-out: generate at the top limb after including borrow chain
-    last_g = jnp.logical_or(g[..., -1],
-                            jnp.logical_and(p[..., -1],
-                                            borrow_in[..., -1].astype(bool)))
+    # (static slices, not int indexing — jnp's scalar getitem emits a
+    # dynamic_slice, which the pallas TPU lowering does not implement)
+    top = lambda x: jnp.squeeze(x[..., x.shape[-1] - 1:], axis=-1)
+    last_g = jnp.logical_or(top(g),
+                            jnp.logical_and(top(p),
+                                            top(borrow_in).astype(bool)))
     return diff, last_g.astype(jnp.uint32)
 
 
@@ -196,10 +210,13 @@ def _shift_add_product(a: jnp.ndarray, b: jnp.ndarray, nb: int,
     flat = a.shape[:-1] + (na * nb,)
     m_lo, m_hi = _diag_mats(na, nb, out_cols)
     if _reduction_dtype() == jnp.bfloat16:
-        b0 = (p & 0xFF).astype(jnp.bfloat16).reshape(flat)
-        b1 = ((p >> 8) & 0xFF).astype(jnp.bfloat16).reshape(flat)
-        b2 = ((p >> 16) & 0xFF).astype(jnp.bfloat16).reshape(flat)
-        b3 = (p >> 24).astype(jnp.bfloat16).reshape(flat)
+        # uint32 -> int32 -> bf16: Mosaic (pallas) has no direct u32->bf16
+        # cast; the detour is exact (values <= 255) and free under XLA.
+        bf = lambda x: x.astype(jnp.int32).astype(jnp.bfloat16)
+        b0 = bf(p & 0xFF).reshape(flat)
+        b1 = bf((p >> 8) & 0xFF).reshape(flat)
+        b2 = bf((p >> 16) & 0xFF).reshape(flat)
+        b3 = bf(p >> 24).reshape(flat)
         f32 = jnp.float32
         lo_cols = (
             jnp.matmul(b0, m_lo, preferred_element_type=f32)
@@ -214,7 +231,8 @@ def _shift_add_product(a: jnp.ndarray, b: jnp.ndarray, nb: int,
         # single f32 pass is exact on CPU (sums < 2^24)
         cols = (jnp.matmul(lo, m_lo, precision=jax.lax.Precision.HIGHEST)
                 + jnp.matmul(hi, m_hi, precision=jax.lax.Precision.HIGHEST))
-    return cols.astype(jnp.uint32)
+    # f32 -> int32 -> uint32 (exact: cols < 2^26); Mosaic lacks f32->u32
+    return cols.astype(jnp.int32).astype(jnp.uint32)
 
 
 _NIBBLE_MATS: dict = {}

@@ -1,0 +1,174 @@
+"""Pallas TPU kernel: fused fixed-base table select + window fold.
+
+The XLA path (ec.fixed_base_gather / fixed_base_msm) materializes a
+(B, T, 32, 256) one-hot tensor and a (B, T, 32, 3, 16) selection in HBM —
+~4.5 GB at B=2048, and every field op in the 31-add window fold round-trips
+HBM (the round-3 roofline's measured wall: the batch verify is
+bandwidth-bound on unfused VPU ops, not compute-bound). This kernel keeps
+the whole select+fold in VMEM: per grid step it loads one term's byte-plane
+table block (1.6 MB), builds the one-hot per window on the fly (a (256, bB)
+iota compare), selects via one MXU matmul, and folds the 32 windows into an
+accumulator with the transposed complete-add chain (ops/tec.py). HBM
+traffic drops to tables + digits in, folded points out.
+
+Replaces the sequential per-proof table walk of the reference
+(token/core/zkatdlog/nogh/v1/crypto/rp/bulletproof.go:252-333 and
+math/mathlib G1.Mul) as the throughput path of SURVEY.md §2.5.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+from . import tec
+from . import tfield as tf
+
+N = L.NLIMBS
+
+#: lane-block: batch lanes per grid step (multiple of 128).
+LANE_BLOCK = 512
+
+
+def _plane_dtype():
+    from . import ec
+
+    return ec.plane_dtype()
+
+
+def _fb_fold_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
+                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+    """One (term, lane-block) grid step: fold `windows` table selections.
+
+    planes_ref: (1, windows, 96, 256) plane-dtype — one term's tables,
+        transposed so the select contraction is (96, 256) x (256, bB).
+    digits_ref: (1, windows, bB) int32 — 8-bit window digits.
+    out_ref:    (1, 48, bB) uint32 — sum_w table[w][digit_w], transposed
+        projective Montgomery.
+    Remaining refs carry the field/curve constants (tfield.TSpec layout).
+    """
+    cc = tec.CurveConsts(
+        ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
+                    r1=r1_ref[...], w_nprime=wnp_ref[...],
+                    w_mod=wmod_ref[...], mod_int=0),
+        b3=b3_ref[...])
+    bB = digits_ref.shape[-1]
+    dt = planes_ref.dtype
+
+    def body(w, acc):
+        d = digits_ref[0, w, :]                           # (bB,) int32
+        iota = jax.lax.broadcasted_iota(jnp.int32, (256, bB), 0)
+        onehot = (iota == d[None, :]).astype(jnp.int32).astype(dt)
+        sel = jax.lax.dot_general(
+            planes_ref[0, w], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (96, bB) f32
+        u = sel.astype(jnp.int32).astype(jnp.uint32)
+        pt = u[0:48, :] + (u[48:96, :] << 8)              # (48, bB) limbs
+        return tec.add(acc, pt, cc)
+
+    out_ref[0] = jax.lax.fori_loop(
+        0, windows, body, tec.identity(bB, cc), unroll=False)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
+              interpret: bool = False) -> jnp.ndarray:
+    """Fused fixed-base fold, transposed interface.
+
+    planes_t: (T, W, 96, 256) plane-dtype byte-plane tables (transposed);
+    digits_t: (T, W, B) int32 with B a multiple of LANE_BLOCK (pad digit 0
+        -> identity entry -> identity point for dead lanes).
+    Returns (T, 48, B) uint32: per-(term, lane) folded points.
+    """
+    from jax.experimental import pallas as pl
+
+    T, W, _, _ = planes_t.shape
+    B = digits_t.shape[-1]
+    assert B % LANE_BLOCK == 0, (B, LANE_BLOCK)
+    cc = tec.make_consts()
+    consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
+              cc.ts.w_mod, cc.b3)
+    const_specs = [
+        pl.BlockSpec(c.shape, lambda t, b, *, _nd=c.ndim: (0,) * _nd)
+        for c in consts
+    ]
+    kernel = functools.partial(_fb_fold_kernel, windows=W)
+    return pl.pallas_call(
+        kernel,
+        grid=(T, B // LANE_BLOCK),
+        in_specs=[
+            pl.BlockSpec((1, W, 96, 256), lambda t, b: (t, 0, 0, 0)),
+            pl.BlockSpec((1, W, LANE_BLOCK), lambda t, b: (t, 0, b)),
+            *const_specs,
+        ],
+        out_specs=pl.BlockSpec((1, 48, LANE_BLOCK), lambda t, b: (t, 0, b)),
+        out_shape=jax.ShapeDtypeStruct((T, 48, B), jnp.uint32),
+        interpret=interpret,
+    )(planes_t, digits_t, *consts)
+
+
+# --------------------------------------------------------------------------
+# XLA-layout adapters (drop-in for ec.fixed_base_gather / fixed_base_msm)
+# --------------------------------------------------------------------------
+
+def transpose_planes(table_planes: jnp.ndarray) -> jnp.ndarray:
+    """(T, W, 256, 96) ec.fixed_base_planes layout -> (T, W, 96, 256)."""
+    return jnp.transpose(table_planes, (0, 1, 3, 2))
+
+
+def _digits_t(scalars: jnp.ndarray) -> jnp.ndarray:
+    """(B, T, 16) limb scalars -> (T, W=32, B) int32 window digits."""
+    from . import ec
+
+    d = ec.window_digits8(scalars)            # (B, T, 32)
+    return jnp.transpose(d, (1, 2, 0)).astype(jnp.int32)
+
+
+def _untranspose(folded: jnp.ndarray) -> jnp.ndarray:
+    """(T, 48, B) -> (B, T, 3, 16)."""
+    T, _, B = folded.shape
+    out = jnp.transpose(folded, (2, 0, 1))    # (B, T, 48)
+    return out.reshape(B, T, 3, N)
+
+
+def _pad_lanes(digits_t: jnp.ndarray) -> tuple[jnp.ndarray, int]:
+    B = digits_t.shape[-1]
+    pad = (-B) % LANE_BLOCK
+    if pad:
+        digits_t = jnp.concatenate(
+            [digits_t,
+             jnp.zeros(digits_t.shape[:-1] + (pad,), dtype=digits_t.dtype)],
+            axis=-1)
+    return digits_t, B
+
+
+def fixed_base_gather_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
+                            interpret: bool = False) -> jnp.ndarray:
+    """Per-term fixed-base scalar mul (ec.fixed_base_gather semantics).
+
+    planes_t: (T, 32, 96, 256) transposed planes; scalars: (B, T, 16).
+    Returns (B, T, 3, 16) = scalars[b, t] * P_t.
+    """
+    dt, B = _pad_lanes(_digits_t(scalars))
+    return _untranspose(fb_fold_t(planes_t, dt, interpret=interpret))[:B]
+
+
+def fixed_base_msm_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Fixed-base MSM (ec.fixed_base_msm semantics) via the fused fold.
+
+    planes_t: (T, 32, 96, 256); scalars: (..., T, 16) -> (..., 3, 16).
+    The per-term folds run in the kernel; the T-axis fold is a small XLA
+    tree (T*192 bytes per lane — negligible traffic).
+    """
+    from . import ec
+
+    batch = scalars.shape[:-2]
+    flat = scalars.reshape((-1,) + scalars.shape[-2:])
+    per_term = fixed_base_gather_fused(planes_t, flat, interpret=interpret)
+    folded = ec._tree_sum_shrink(per_term)    # (Bflat, 3, 16)
+    return folded.reshape(batch + (3, N))

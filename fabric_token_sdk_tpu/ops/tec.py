@@ -1,0 +1,125 @@
+"""Transposed-layout BN254 G1 ops for the Pallas (Mosaic) kernels.
+
+Points are (..., 48, LANE) uint32: the X/Y/Z Montgomery projective
+coordinates (16 limbs each) stacked along the sublane axis, batch on the
+128-wide lane axis — see ops/tfield.py for why. Identity is (0 : r1 : 0).
+
+Same complete RCB15 a=0 addition as ops/ec.py (eprint 2015/1060 Alg 7,
+b3=9); the only structural difference is how the 14 field multiplications
+batch: ec.py stacks them on a new leading axis, here they CONCATENATE along
+the lane axis so the whole group stays a 2-D tile and every product rides
+the in-kernel MXU nibble-Toeplitz path (tfield.mont_mul's 2-D fast path).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+from . import tfield as tf
+
+N = L.NLIMBS
+
+
+class CurveConsts(NamedTuple):
+    """Field spec + curve constants for the in-kernel G1 ops."""
+
+    ts: tf.TSpec
+    b3: jnp.ndarray   # (N, 1) uint32: 3*b = 9 in Montgomery form
+
+
+def make_consts() -> CurveConsts:
+    from .field import FP
+
+    b3 = np.array(L.int_to_limbs(L.fp_to_mont_int(9)),
+                  dtype=np.uint32)[:, None]
+    return CurveConsts(ts=tf.make_tspec(FP), b3=jnp.asarray(b3))
+
+
+def coords(p: jnp.ndarray):
+    """(..., 48, LANE) -> X, Y, Z as (..., 16, LANE) static slices."""
+    return p[..., 0:N, :], p[..., N:2 * N, :], p[..., 2 * N:3 * N, :]
+
+
+def from_coords(x, y, z) -> jnp.ndarray:
+    return jnp.concatenate([x, y, z], axis=-2)
+
+
+def identity(lanes: int, cc: CurveConsts,
+             batch: tuple = ()) -> jnp.ndarray:
+    zero = jnp.zeros(batch + (N, lanes), dtype=jnp.uint32)
+    one = jnp.broadcast_to(cc.ts.r1, batch + (N, lanes))
+    return from_coords(zero, one, zero)
+
+
+def is_identity(p: jnp.ndarray) -> jnp.ndarray:
+    """(..., 48, LANE) -> (..., 1, LANE) bool (Z == 0)."""
+    _, _, z = coords(p)
+    return tf.is_zero(z)
+
+
+def _cat(parts) -> jnp.ndarray:
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _split(m: jnp.ndarray, k: int):
+    """Split (..., 16, k*LANE) back into k lane groups (static slices)."""
+    lanes = m.shape[-1] // k
+    return tuple(m[..., i * lanes:(i + 1) * lanes] for i in range(k))
+
+
+def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
+    """Complete projective addition, valid for every input pair.
+
+    Mirrors ec.add's three grouped multiplication rounds (6 + 2 + 6
+    products), batched along the LANE axis.
+    """
+    ts = cc.ts
+    X1, Y1, Z1 = coords(p)
+    X2, Y2, Z2 = coords(q)
+    addf = lambda a, b: tf.add(a, b, ts)
+    subf = lambda a, b: tf.sub(a, b, ts)
+
+    # round 1: X1X2, Y1Y2, Z1Z2 and the three cross sums.
+    a1 = _cat([X1, Y1, Z1, addf(X1, Y1), addf(Y1, Z1), addf(X1, Z1)])
+    b1 = _cat([X2, Y2, Z2, addf(X2, Y2), addf(Y2, Z2), addf(X2, Z2)])
+    m = tf.mont_mul(a1, b1, ts)
+    t0, t1, t2, m3, m4, m5 = _split(m, 6)
+    t3 = subf(m3, addf(t0, t1))          # X1Y2 + X2Y1
+    t4 = subf(m4, addf(t1, t2))          # Y1Z2 + Y2Z1
+    y3 = subf(m5, addf(t0, t2))          # X1Z2 + X2Z1
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2
+
+    # round 2: the two b3 scalings.
+    b3b = jnp.broadcast_to(cc.b3, t2.shape)
+    s = tf.mont_mul(_cat([t2, y3]), _cat([b3b, b3b]), ts)
+    t2, y3 = _split(s, 2)
+    z3 = addf(t1, t2)
+    t1 = subf(t1, t2)
+
+    # round 3: the six output products.
+    a3 = _cat([t4, t3, y3, t1, t0, z3])
+    b3v = _cat([y3, t1, t0, z3, t3, t4])
+    o = tf.mont_mul(a3, b3v, ts)
+    o0, o1, o2, o3, o4, o5 = _split(o, 6)
+    x3 = subf(o1, o0)                    # t3*t1 - t4*y3
+    y3o = addf(o3, o2)                   # t1*z3 + y3*t0
+    z3o = addf(o5, o4)                   # z3*t4 + t0*t3
+    return from_coords(x3, y3o, z3o)
+
+
+def tree_fold(p: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
+    """Fold the LANE axis down to one point by pairwise halving.
+
+    p: (..., 48, LANE) with LANE a power of two -> (..., 48, 1).
+    Static lane-half slices, log2(LANE) add levels.
+    """
+    lanes = p.shape[-1]
+    while lanes > 1:
+        half = lanes // 2
+        p = add(p[..., :half], p[..., half:2 * half], cc)
+        lanes = half
+    return p

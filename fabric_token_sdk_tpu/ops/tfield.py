@@ -1,0 +1,280 @@
+"""Transposed-layout field arithmetic for the Pallas (Mosaic) kernels.
+
+Layout: arrays are (..., K, LANE) — the limb axis K sits on TPU *sublanes*
+(second-minor) and LANE is a batch axis on the 128-wide *lane* dimension.
+`ops/field.py` puts limbs minor, which wastes 7/8 of every VPU lane once the
+ops run inside a Pallas kernel (a 16-limb minor axis occupies 16 of 128
+lanes); transposing batch onto the lane axis keeps every vector op
+full-width. Semantics are identical to ops/field.py — the parity suite
+pins every op against it (tests/test_tfield.py).
+
+Mosaic constraints shape the implementation (vs ops/field.py):
+- no associative_scan (zero-size slices): carry lookahead is an unrolled
+  Kogge-Stone;
+- no u32<->float casts: byte/nibble planes detour through int32;
+- no reshapes that mix tiled dims: shifts are concatenate-based along the
+  sublane axis, products use an explicit shift-add schedule;
+- no captured device constants: every modulus-dependent array rides in a
+  `TSpec` the caller builds (outside a kernel from host constants, inside a
+  kernel from refs passed to pallas_call).
+
+The per-mont_mul schedule mirrors field.mont_mul's separated (SOS) form:
+T = a*b (schoolbook shift-add columns), m = T_lo * N' (nibble-Toeplitz
+matmul, MXU), S = (T + m*mod) >> 256 (same matmul trick), one conditional
+subtract. Equivalent of the reference's gnark-crypto assembly field layer
+(reference token/core/zkatdlog/nogh/v1/crypto/setup.go:14) re-planned for
+the TPU memory hierarchy.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+
+N = L.NLIMBS
+BITS = L.LIMB_BITS
+MASK = L.LIMB_MASK  # python int: never captured as a device constant
+
+
+class TSpec(NamedTuple):
+    """Field constants in transposed layout (limb axis leading, lane=1).
+
+    All arrays broadcast over the lane axis. `w_nprime`/`w_mod` are the
+    nibble-Toeplitz matrices of field._nibble_toeplitz TRANSPOSED to
+    (out_nibbles, 64) so the in-kernel contraction is a plain (M,K)x(K,LANE)
+    matmul. mod_int is a python int (jit-static).
+    """
+
+    mod: jnp.ndarray       # (N, 1) uint32
+    nprime: jnp.ndarray    # (N, 1) uint32  (-mod^-1 mod 2^256, low limbs)
+    r1: jnp.ndarray        # (N, 1) uint32  (Montgomery 1)
+    w_nprime: jnp.ndarray  # (4, N, 64)  int8: T_lo * N' mod 2^256
+    w_mod: jnp.ndarray     # (4, 2N, 64) int8: m * mod, full 2N limbs
+    mod_int: int
+
+
+def _toeplitz_t(const_limbs: tuple, out_cols: int) -> np.ndarray:
+    """(4, out_cols, 64) int8: W[k, l, i] = nibble (4l + k - i) of the
+    constant — four per-nibble-position Toeplitz matrices so the in-kernel
+    contraction is four plain matmuls with no strided slicing (Mosaic)."""
+    from . import field
+
+    w = field._nibble_toeplitz(const_limbs, out_cols)   # (64, 4*out_cols)
+    return np.ascontiguousarray(
+        np.stack([w[:, k::4].T for k in range(4)]))
+
+
+def make_tspec(spec) -> TSpec:
+    """Build a TSpec from an ops.field.FieldSpec (host-side constants)."""
+    return TSpec(
+        mod=jnp.asarray(np.array(spec.mod, dtype=np.uint32)[:, None]),
+        nprime=jnp.asarray(np.array(spec.nprime, dtype=np.uint32)[:, None]),
+        r1=jnp.asarray(np.array(spec.r1, dtype=np.uint32)[:, None]),
+        w_nprime=jnp.asarray(_toeplitz_t(spec.nprime, N)),
+        w_mod=jnp.asarray(_toeplitz_t(spec.mod, 2 * N)),
+        mod_int=spec.mod_int,
+    )
+
+
+# --------------------------------------------------------------------------
+# shifts along the limb (second-minor) axis — concatenate-based: Mosaic has
+# no general pad, and slicing off the top + stacking zeros below is a plain
+# sublane rotation it handles well.
+# --------------------------------------------------------------------------
+
+def _shift_down(x: jnp.ndarray, d: int, fill=0) -> jnp.ndarray:
+    """x[..., i, :] -> x[..., i-d, :] (toward higher limb index); the d new
+    bottom rows are `fill`."""
+    if d == 0:
+        return x
+    k = x.shape[-2]
+    if d >= k:
+        return jnp.full_like(x, fill)
+    pad = jnp.full(x.shape[:-2] + (d, x.shape[-1]), fill, dtype=x.dtype)
+    return jnp.concatenate([pad, x[..., :k - d, :]], axis=-2)
+
+
+def _top_row(x: jnp.ndarray) -> jnp.ndarray:
+    """x[..., K-1, :] as (..., 1, LANE) (static slice; no int indexing)."""
+    return x[..., x.shape[-2] - 1:, :]
+
+
+# --------------------------------------------------------------------------
+# carry machinery (mirrors field._carry_propagate / _lookahead / _sub_limbs)
+# --------------------------------------------------------------------------
+
+def _lookahead(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive Kogge-Stone carry prefix along axis -2.
+
+    g, p are uint32 0/1 masks (not bool: Mosaic cannot concatenate i1
+    vectors, which the shifts need). Returns carry_in per limb as u32."""
+    k = g.shape[-2]
+    d = 1
+    while d < k:
+        g = g | (p & _shift_down(g, d, fill=0))
+        p = p & _shift_down(p, d, fill=1)
+        d *= 2
+    return _shift_down(g, 1)
+
+
+def carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Lazy column sums (< 2^32) -> canonical 16-bit limbs, axis -2."""
+    k = t.shape[-2]
+    if k < out_limbs:
+        z = jnp.zeros(t.shape[:-2] + (out_limbs - k, t.shape[-1]),
+                      dtype=t.dtype)
+        t = jnp.concatenate([t, z], axis=-2)
+    else:
+        t = t[..., :out_limbs, :]
+    v = (t & MASK) + _shift_down(t >> BITS, 1)
+    v = (v & MASK) + _shift_down(v >> BITS, 1)
+    g = v >> BITS                     # 0/1: v == 2^16 exactly
+    p = (v == MASK).astype(jnp.uint32)
+    return (v + _lookahead(g, p)) & MASK
+
+
+def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray):
+    """a - b canonical; returns (diff, borrow_out (..., 1, LANE) u32)."""
+    b = jnp.broadcast_to(b, a.shape)
+    g = (a < b).astype(jnp.uint32)
+    p = (a == b).astype(jnp.uint32)
+    borrow_in = _lookahead(g, p)
+    diff = (a + jnp.uint32(1 << BITS) - b - borrow_in) & MASK
+    last = _top_row(g) | (_top_row(p) & _top_row(borrow_in))
+    return diff, last
+
+
+def _cond_sub_mod(res: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    """One conditional subtract of mod over N+1 limbs -> N limbs."""
+    z = jnp.zeros(res.shape[:-2] + (1, 1), dtype=jnp.uint32)
+    mod_ext = jnp.concatenate(
+        [jnp.broadcast_to(ts.mod, res.shape[:-2] + (N, 1)), z], axis=-2)
+    diff, borrow = _sub_limbs(res, mod_ext)
+    keep = borrow != 0  # (..., 1, LANE): broadcasts over the limb axis
+    return jnp.where(keep, res, diff)[..., :N, :]
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    s = carry_propagate(a + b, N + 1)
+    return _cond_sub_mod(s, ts)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    diff, borrow = _sub_limbs(a, jnp.broadcast_to(b, a.shape))
+    fixed = carry_propagate(diff + ts.mod, N)
+    return jnp.where(borrow != 0, fixed, diff)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """(..., K, LANE) -> (..., 1, LANE) bool."""
+    return jnp.all(a == 0, axis=-2, keepdims=True)
+
+
+# --------------------------------------------------------------------------
+# products
+# --------------------------------------------------------------------------
+
+def _product_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy column sums of a*b, both (..., N, LANE) canonical.
+
+    Schoolbook shift-add: for each limb row i of `a`, one full-width vector
+    multiply a_i * b and two shifted accumulations (lo/hi halves). Columns
+    stay < 2^21 (32 half-terms of < 2^16). Returns (..., 2N, LANE).
+    All VPU; the variable x variable product has no constant operand to
+    Toeplitz-ize onto the MXU.
+    """
+    lanes = a.shape[-1]
+    batch = a.shape[:-2]
+
+    def placed(x, before: int):
+        """x padded to 2N rows starting at `before` (no zero-size pieces —
+        Mosaic rejects empty vectors)."""
+        parts = []
+        if before:
+            parts.append(jnp.zeros(batch + (before, lanes),
+                                   dtype=jnp.uint32))
+        parts.append(x)
+        after = 2 * N - before - x.shape[-2]
+        if after:
+            parts.append(jnp.zeros(batch + (after, lanes),
+                                   dtype=jnp.uint32))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                axis=-2)
+
+    cols = jnp.zeros(batch + (2 * N, lanes), dtype=jnp.uint32)
+    for i in range(N):
+        p = a[..., i:i + 1, :] * b          # (..., N, LANE) full products
+        cols = cols + placed(p & MASK, i)
+        cols = cols + placed(p >> BITS, i + 1)
+    return cols
+
+
+def _nibbles(a: jnp.ndarray) -> jnp.ndarray:
+    """(..., N, LANE) u32 canonical -> (..., 4N, LANE) int8 nibbles,
+    row 4i+k = (a[i] >> 4k) & 0xF (the field._nibble_toeplitz row order)."""
+    parts = []
+    for i in range(N):
+        row = a[..., i:i + 1, :].astype(jnp.int32)
+        for k in (0, 4, 8, 12):
+            parts.append((row >> k) & 0xF)
+    return jnp.concatenate(parts, axis=-2).astype(jnp.int8)
+
+
+def _const_product_cols(a: jnp.ndarray, w_t: jnp.ndarray) -> jnp.ndarray:
+    """Lazy columns of a * CONSTANT via the transposed nibble-Toeplitz dots.
+
+    a: (N, LANE) canonical; w_t: (4, out_cols, 64) int8 (TSpec layout).
+    Four (out_cols, 64) x (64, LANE) MXU matmuls in int32 accumulation
+    (one per output nibble position), folded with shifts. No batch dims:
+    the kernels call this on 2-D tiles.
+    """
+    nib = _nibbles(a)                                   # (64, LANE) i8
+
+    def dot_k(k):
+        c = jax.lax.dot_general(
+            w_t[k], nib, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)           # (out, LANE)
+        return c.astype(jnp.uint32)
+
+    return (dot_k(0) + (dot_k(1) << 4) + (dot_k(2) << 8)
+            + (dot_k(3) << 12))                         # (out_cols, LANE)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    """Montgomery product a*b*R^-1 mod m over (..., N, LANE) limbs.
+
+    Same separated reduction as field.mont_mul; the two constant-operand
+    products ride the nibble-Toeplitz MXU dot when the input is 2-D
+    (in-kernel tiles), else the schoolbook path (parity testing with
+    batch dims)."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    t_cols = _product_cols(a, b)
+    T = carry_propagate(t_cols, 2 * N + 1)
+    t_lo = T[..., :N, :]
+    if a.ndim == 2:
+        m = carry_propagate(_const_product_cols(t_lo, ts.w_nprime), N)
+        u_cols = _const_product_cols(m, ts.w_mod)
+    else:
+        # batch-dim path (parity tests): schoolbook against the limb consts.
+        # m needs only the low N columns of t_lo * nprime.
+        np_b = jnp.broadcast_to(ts.nprime, t_lo.shape)
+        m = carry_propagate(_product_cols(t_lo, np_b)[..., :N, :], N)
+        u_cols = _product_cols(m, jnp.broadcast_to(ts.mod, m.shape))
+    z1 = jnp.zeros(T.shape[:-2] + (1, T.shape[-1]), dtype=jnp.uint32)
+    u_ext = jnp.concatenate([u_cols, z1], axis=-2)[..., :2 * N + 1, :]
+    s = carry_propagate(T + u_ext, 2 * N + 1)
+    res = s[..., N:, :]
+    return _cond_sub_mod(res, ts)
+
+
+def from_mont(a: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    one_col = jnp.ones(a.shape[:-2] + (1, a.shape[-1]), dtype=jnp.uint32)
+    zeros = jnp.zeros(a.shape[:-2] + (N - 1, a.shape[-1]), dtype=jnp.uint32)
+    return mont_mul(a, jnp.concatenate([one_col, zeros], axis=-2), ts)

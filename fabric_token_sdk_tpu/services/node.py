@@ -148,11 +148,16 @@ class TokenNode:
     def identity(self) -> bytes:
         return bytes(self.keys.identity)
 
-    def recipient_identity(self) -> tuple[bytes, bytes]:
+    def recipient_identity(self, wallet_id: str = "") -> tuple[bytes, bytes]:
         """Recipient-exchange responder view (ttx/recipients.go): the
         identity to make an output to + its audit info. Fresh per call for
-        pseudonymous wallets."""
-        return self.owner_wallet.recipient_identity()
+        pseudonymous wallets. A non-empty wallet_id resolves through the
+        role registry (recipients.go honors the request's wallet id) and
+        raises for unknown wallets rather than silently substituting the
+        default."""
+        if not wallet_id:
+            return self.owner_wallet.recipient_identity()
+        return self.wallets.owner_wallet(wallet_id).recipient_identity()
 
     def issuer_public_identity(self) -> bytes:
         """Issuer-identity responder view (withdrawal flow's first leg):

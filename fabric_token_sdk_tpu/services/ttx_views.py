@@ -112,8 +112,8 @@ def request_recipient_identity(vbus: ViewBus, recipient_node: str,
 def _respond_recipient(node, session: Session, vbus: ViewBus) -> None:
     """RespondRequestRecipientIdentityView (recipients.go:140-180)."""
     try:
-        session.recv()  # RecipientRequest{wallet_id}: default wallet here
-        ident, audit_info = node.recipient_identity()
+        msg = session.recv()  # RecipientRequest{wallet_id}
+        ident, audit_info = node.recipient_identity(msg.get("wallet_id", ""))
         session.send({"identity": ident.hex(),
                       "audit_info": bytes(audit_info).hex()})
     except Exception as e:  # responder views report, never crash the node
@@ -272,6 +272,7 @@ def _respond_withdrawal(node, session: Session, vbus: ViewBus) -> None:
     from ..core.fabtoken.driver import OutputSpec
     from ..token.request_builder import Request
 
+    stored_tx: str | None = None
     try:
         msg = session.recv()
         ident = bytes.fromhex(msg["recipient"]["identity"])
@@ -324,10 +325,18 @@ def _respond_withdrawal(node, session: Session, vbus: ViewBus) -> None:
         node.ttxdb.add_token_request(tx_id, request_raw)
         for rec in tx.records:
             node.ttxdb.add_transaction(rec)
+        stored_tx = tx_id
         ev = ordering_and_finality(tx, node.cc)
         session.send({"tx_id": tx_id, "status": ev.status,
                       "message": ev.message})
     except Exception as e:
+        if stored_tx is not None:
+            # failed AFTER storing the issuer's PENDING record but before
+            # (or during) ordering: no commit event will ever fire, so
+            # close out the issuer's own record and stop watching —
+            # mirroring the requester-side close-out in request_withdrawal
+            node._watched.pop(stored_tx, None)
+            node.ttxdb.set_status(stored_tx, TxStatus.DELETED, str(e))
         session.send({"error": str(e)})
 
 

@@ -161,7 +161,19 @@ def configure_jax_cache() -> None:
     # the cache; loading those under the local cpu backend SIGILLs/aborts
     # (root cause of the mid-suite faulthandler crashes).
     platform = (jax.config.jax_platforms or "default").replace(",", "_")
+    # ... AND by the virtual-device-count config: XLA:CPU AOT executables
+    # bake pseudo target features (+prefer-no-scatter/+prefer-no-gather)
+    # that differ between a plain 1-device process and one running under
+    # --xla_force_host_platform_device_count=N; entries written by one
+    # config fail the other's AOT machine-feature validation and force a
+    # full recompile (the round-3 multichip-gate timeout). Separate dirs
+    # make the mismatch unreachable.
+    ndev = "1"
+    flags = os.environ.get("XLA_FLAGS", "")
+    for tok in flags.split():
+        if tok.startswith("--xla_force_host_platform_device_count="):
+            ndev = tok.split("=", 1)[1]
     jax.config.update("jax_compilation_cache_dir",
-                      f"{base}-{platform}-{_host_tag()}")
+                      f"{base}-{platform}-{_host_tag()}-d{ndev}")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

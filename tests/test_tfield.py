@@ -1,0 +1,161 @@
+"""Parity: transposed-layout field/EC ops vs the host oracle + ops/field.
+
+The transposed layer (ops/tfield, ops/tec) exists for the Pallas kernels;
+its semantics must match ops/field.py and the pure-Python bn254 oracle
+bit-for-bit. The fused kernel itself is covered in interpret mode here
+(runs the same traced ops on XLA:CPU) and on real hardware by the bench.
+"""
+
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.ops import ec, limbs as L, pallas_fb, tec
+from fabric_token_sdk_tpu.ops import tfield as tf
+
+R_INV = pow(2 ** 256, -1, L.P_INT)
+LANE = 8
+
+
+def _rand_fp(n):
+    return [secrets.randbelow(L.P_INT) for _ in range(n)]
+
+
+def _to_t(vals):
+    """Fp ints -> (16, LANE) transposed limb array (no mod-r reduction —
+    scalars_to_limbs is for Fr scalars and would corrupt Fp values >= r)."""
+    return jnp.asarray(np.stack([L.int_to_limbs(v) for v in vals]).T)
+
+
+def _col_int(arr, i):
+    return L.limbs_to_int(np.asarray(arr)[:, i])
+
+
+def _pts_to_t(pts):
+    arr = L.points_to_projective_limbs(pts)          # (B, 3, 16)
+    return jnp.asarray(arr.reshape(len(pts), 48).T)  # (48, B)
+
+
+def _t_col_point(arr, i) -> bn254.G1:
+    return L.projective_limbs_to_point(np.asarray(arr)[:, i].reshape(3, 16))
+
+
+def _same(p: bn254.G1, q: bn254.G1) -> bool:
+    return (p.inf and q.inf) or (not p.inf and not q.inf
+                                 and p.x == q.x and p.y == q.y)
+
+
+@pytest.fixture(scope="module")
+def cc():
+    return tec.make_consts()
+
+
+def _rand_pts(n):
+    return [bn254.g1_mul(bn254.G1_GENERATOR, secrets.randbelow(bn254.R))
+            for _ in range(n)]
+
+
+class TestTField:
+    def test_mont_mul_2d(self, cc):
+        av, bv = _rand_fp(LANE), _rand_fp(LANE)
+        out = np.asarray(tf.mont_mul(_to_t(av), _to_t(bv), cc.ts))
+        for i in range(LANE):
+            assert _col_int(out, i) == av[i] * bv[i] * R_INV % L.P_INT
+
+    def test_mont_mul_batch_dims(self, cc):
+        av, bv = _rand_fp(LANE), _rand_fp(LANE)
+        a3 = jnp.stack([_to_t(av), _to_t(bv)])
+        b3 = jnp.stack([_to_t(bv), _to_t(av)])
+        out = np.asarray(tf.mont_mul(a3, b3, cc.ts))
+        for j in range(2):
+            for i in range(LANE):
+                assert (L.limbs_to_int(out[j][:, i])
+                        == av[i] * bv[i] * R_INV % L.P_INT)
+
+    def test_add_sub_edges(self, cc):
+        av = _rand_fp(LANE - 2) + [0, L.P_INT - 1]
+        bv = _rand_fp(LANE - 2) + [0, L.P_INT - 1]
+        s = np.asarray(tf.add(_to_t(av), _to_t(bv), cc.ts))
+        d = np.asarray(tf.sub(_to_t(av), _to_t(bv), cc.ts))
+        for i in range(LANE):
+            assert _col_int(s, i) == (av[i] + bv[i]) % L.P_INT
+            assert _col_int(d, i) == (av[i] - bv[i]) % L.P_INT
+
+    def test_from_mont(self, cc):
+        av = _rand_fp(LANE)
+        out = np.asarray(tf.from_mont(_to_t(av), cc.ts))
+        for i in range(LANE):
+            assert _col_int(out, i) == av[i] * R_INV % L.P_INT
+
+    def test_is_zero(self, cc):
+        av = [0, 1] + _rand_fp(LANE - 2)
+        z = np.asarray(tf.is_zero(_to_t(av)))[0]
+        assert list(z) == [v == 0 for v in av]
+
+
+class TestTEC:
+    def test_add_parity_vs_oracle(self, cc):
+        p1 = _rand_pts(LANE - 3) + [bn254.G1_IDENTITY]
+        p2 = _rand_pts(LANE - 3) + [bn254.G1_IDENTITY]
+        p1 += [p1[0], p1[0]]                    # doubling + inverse lanes
+        p2 += [p1[0], bn254.g1_neg(p1[0])]
+        out = np.asarray(tec.add(_pts_to_t(p1), _pts_to_t(p2), cc))
+        for i in range(LANE):
+            want = bn254.g1_add(p1[i], p2[i])
+            assert _same(_t_col_point(out, i), want), f"lane {i}"
+
+    def test_identity_constant(self, cc):
+        idp = np.asarray(tec.identity(4, cc))
+        for i in range(4):
+            assert _t_col_point(idp, i).inf
+        flags = np.asarray(tec.is_identity(jnp.asarray(idp)))[0]
+        assert flags.all()
+
+    def test_tree_fold(self, cc):
+        pts = _rand_pts(LANE)
+        folded = np.asarray(tec.tree_fold(_pts_to_t(pts), cc))
+        acc = bn254.G1_IDENTITY
+        for p in pts:
+            acc = bn254.g1_add(acc, p)
+        assert _same(_t_col_point(folded, 0), acc)
+
+
+class TestFusedFixedBase:
+    """Interpret-mode run of the Pallas kernel vs ec.fixed_base_gather."""
+
+    def test_fold_parity(self):
+        T, B = 3, 4
+        gens = [bn254.g1_mul(bn254.G1_GENERATOR, 7 + i) for i in range(T)]
+        gen_dev = jnp.asarray(L.points_to_projective_limbs(gens))
+        planes = ec.fixed_base_planes(gen_dev)          # (T, 32, 256, 96)
+        sc_int = [[secrets.randbelow(bn254.R) for _ in range(T)]
+                  for _ in range(B)]
+        scalars = jnp.asarray(np.stack(
+            [L.scalars_to_limbs(row) for row in sc_int]))   # (B, T, 16)
+        planes_t = pallas_fb.transpose_planes(planes)
+        got = np.asarray(pallas_fb.fixed_base_gather_fused(
+            planes_t, scalars, interpret=True))
+        for b in range(B):
+            for t in range(T):
+                want = bn254.g1_mul(gens[t], sc_int[b][t])
+                pt = L.projective_limbs_to_point(got[b, t])
+                assert _same(pt, want), (b, t)
+
+    def test_msm_parity(self):
+        T, B = 4, 3
+        gens = [bn254.g1_mul(bn254.G1_GENERATOR, 11 + i) for i in range(T)]
+        gen_dev = jnp.asarray(L.points_to_projective_limbs(gens))
+        planes = ec.fixed_base_planes(gen_dev)
+        sc_int = [[secrets.randbelow(bn254.R) for _ in range(T)]
+                  for _ in range(B)]
+        scalars = jnp.asarray(np.stack(
+            [L.scalars_to_limbs(row) for row in sc_int]))
+        got = np.asarray(pallas_fb.fixed_base_msm_fused(
+            pallas_fb.transpose_planes(planes), scalars, interpret=True))
+        for b in range(B):
+            want = bn254.msm(gens, sc_int[b])
+            pt = L.projective_limbs_to_point(got[b])
+            assert _same(pt, want), b

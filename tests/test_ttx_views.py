@@ -57,6 +57,28 @@ class TestRecipientExchange:
         with pytest.raises(TtxError, match="unknown node"):
             vbus.open_session("mallory", "recipient")
 
+    def test_exchange_honors_wallet_id(self, net):
+        """recipients.go:140-180 carries the wallet id: a named wallet
+        answers with ITS identity, not the default wallet's."""
+        from fabric_token_sdk_tpu.services.identity.wallet import \
+            X509OwnerWallet
+
+        nodes, vbus = net
+        savings = X509OwnerWallet(new_signing_identity())
+        nodes["bob"].wallets.register_owner_wallet("savings", savings)
+        ident, _ = tv.request_recipient_identity(vbus, "bob",
+                                                 wallet_id="savings")
+        assert savings.owns(ident)
+        assert not nodes["bob"].owner_wallet.owns(ident)
+
+    def test_exchange_unknown_wallet_id_rejected(self, net):
+        """An unknown wallet id must fail loudly, not silently hand out
+        the default wallet (tokens would land in the wrong wallet)."""
+        _, vbus = net
+        with pytest.raises(TtxError, match="recipient exchange failed"):
+            tv.request_recipient_identity(vbus, "bob",
+                                          wallet_id="no-such-wallet")
+
     def test_exchanged_identity_feeds_transfer(self, net):
         nodes, vbus = net
         alice, bob = nodes["alice"], nodes["bob"]
@@ -100,6 +122,12 @@ class TestWithdrawal:
         assert nodes["alice"].ttxdb.get_status(recs[0].tx_id) \
             == TxStatus.DELETED
         assert nodes["alice"].balance("USD") == 0
+        # the ISSUER's own record closes out too (it stored PENDING rows
+        # before ordering), and it stops watching the dead request
+        vbus.join()
+        issuer = nodes["issuer"]
+        assert issuer.ttxdb.get_status(recs[0].tx_id) == TxStatus.DELETED
+        assert recs[0].tx_id not in issuer._watched
 
     def test_withdrawal_from_non_issuer_fails(self, net):
         nodes, vbus = net
