@@ -104,6 +104,17 @@ _affine_rows_kernel = jax.jit(ec.to_affine_batch)
 _affine_kernel = jax.jit(ec.to_affine)
 
 
+def _pallas_enabled() -> bool:
+    """Fused Pallas kernels: TPU backend only (Mosaic lowering), opt-out
+    via FTS_NO_PALLAS=1. The CPU backend and the CPU-mesh dryrun keep the
+    XLA one-hot path."""
+    import os
+
+    if os.environ.get("FTS_NO_PALLAS"):
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
 @jax.jit
 def _k_pass_kernel(tables, k_idx, k_fixed_sc, dc_pts, dc_sc):
     """K = fixed-base part + x*D + C, per proof: (B, 3, 16).
@@ -123,10 +134,23 @@ def _rgp_gather_kernel(tables, rgp_idx, scalars):
 
 
 @jax.jit
+def _k_var_add_kernel(k_fixed_pt, dc_pts, dc_sc):
+    """K = fused fixed-base part + x*D + C (fused-path tail)."""
+    return ec.add(k_fixed_pt, ec.msm_windowed(dc_pts, dc_sc))
+
+
+@jax.jit
 def _combined_kernel(tables, fixed_sc, var_pts, var_sc):
     """RLC of every proof's eq1+eq2 == identity? -> () bool."""
     fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
     var_pt = ec.msm_windowed(var_pts, var_sc)
+    return ec.is_identity(ec.add(fixed_pt, var_pt))
+
+
+@jax.jit
+def _combined_fused_tail(tables, fixed_sc, var_pt):
+    """Fixed-generator part + pallas var-MSM partial -> () bool."""
+    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
     return ec.is_identity(ec.add(fixed_pt, var_pt))
 
 
@@ -167,6 +191,11 @@ class RangeVerifierParams:
     # left_gen ++ [Q] bytes are pp constants.
     left_gen_bytes: tuple
     q_bytes: bytes
+    # transposed (96, 256)-contraction table subsets for the fused Pallas
+    # kernels (TPU only; None on CPU). Pre-gathered at build time so the
+    # per-call jnp.take copies of the XLA path disappear too.
+    tables_t_rgp: jnp.ndarray | None = None   # (n, 32, 96, 256)
+    tables_t_k: jnp.ndarray | None = None     # (n+2, 32, 96, 256)
 
     @classmethod
     def from_pp(cls, pp) -> "RangeVerifierParams":
@@ -181,6 +210,13 @@ class RangeVerifierParams:
         gen_dev = jnp.asarray(limbs.points_to_projective_limbs(gen_points))
         tables = _tables_kernel(gen_dev)
         k_idx = list(range(n, 2 * n)) + [2 * n, 2 * n + 4]  # H_i ++ [P, S_G]
+        tables_t_rgp = tables_t_k = None
+        if _pallas_enabled():
+            from ..ops import pallas_fb
+
+            tr = jax.jit(pallas_fb.transpose_planes)
+            tables_t_rgp = tr(tables[n:2 * n])
+            tables_t_k = tr(jnp.take(tables, jnp.asarray(k_idx), axis=0))
         return cls(
             bit_length=n,
             rounds=rpp.number_of_rounds,
@@ -196,6 +232,8 @@ class RangeVerifierParams:
                 ser.g1_to_bytes(p).hex().encode("ascii")
                 for p in rpp.left_generators),
             q_bytes=ser.g1_to_bytes(rpp.Q).hex().encode("ascii"),
+            tables_t_rgp=tables_t_rgp,
+            tables_t_k=tables_t_k,
         )
 
 
@@ -585,11 +623,23 @@ class BatchRangeVerifier:
              for i in live])
         dc_sc = self._put_rows(_pad_rows(dc_sc_np, b_bucket, zero_sc))
 
-        rgp_aff = _affine_rows_kernel(
-            _rgp_gather_kernel(params.tables, params.rgp_idx, yinv))
-        k_aff = _affine_kernel(
-            _k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
-                           dc_sc))
+        if params.tables_t_rgp is not None and self.mesh is None:
+            # fused Pallas pass-1: select+fold in VMEM (no one-hot in HBM)
+            from ..ops import pallas_fb
+
+            rgp_pts = pallas_fb.fixed_base_gather_fused(
+                params.tables_t_rgp, yinv)
+            k_pt = _k_var_add_kernel(
+                pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
+                dc_pts, dc_sc)
+            rgp_aff = _affine_rows_kernel(rgp_pts)
+            k_aff = _affine_kernel(k_pt)
+        else:
+            rgp_aff = _affine_rows_kernel(
+                _rgp_gather_kernel(params.tables, params.rgp_idx, yinv))
+            k_aff = _affine_kernel(
+                _k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
+                               dc_sc))
         rgp_bytes = affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
         k_bytes = affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
 
@@ -694,6 +744,16 @@ class BatchRangeVerifier:
             ok = self._combined_sharded(
                 params.tables, jnp.asarray(fixed_np),
                 self._put_rows(pts_np), self._put_rows(sc_np))
+        elif params.tables_t_rgp is not None:
+            # fused path: the variable MSM walks its multiple tables and
+            # window folds in VMEM (pallas), only the tiny fixed-part +
+            # identity check remain in XLA
+            from ..ops import pallas_fb
+
+            var_pt = pallas_fb.msm_var_fused(jnp.asarray(pts_np),
+                                             jnp.asarray(sc_np))
+            ok = _combined_fused_tail(params.tables, jnp.asarray(fixed_np),
+                                      var_pt)
         else:
             ok = _combined_kernel(params.tables, jnp.asarray(fixed_np),
                                   jnp.asarray(pts_np), jnp.asarray(sc_np))

@@ -254,9 +254,52 @@ def _multiple_table(points: jnp.ndarray, entries: int) -> jnp.ndarray:
     return jnp.concatenate([idp[..., None, :, :], chain], axis=-3)
 
 
-def _tree_sum_shrink(pts: jnp.ndarray) -> jnp.ndarray:
-    """Tree reduction over axis -3 with shrinking shapes (odd tail carried)."""
+def _tree_sum_loop(pts: jnp.ndarray) -> jnp.ndarray:
+    """Tree reduction over axis -3 with ONE add instantiation.
+
+    Pads the term axis to a power of two with identities, then folds
+    inside a fori_loop whose body keeps the array shape constant (pair-add
+    the valid prefix, refill with identities — the ec.msm fold_level
+    trick). Graph size is O(1) in T instead of O(log T) distinct add
+    shapes; XLA:CPU compile time of the big term buckets drops several-
+    fold, which is what keeps the driver's multichip dryrun inside its
+    budget (the persistent cache cannot help: XLA:CPU AOT entries bake
+    LLVM *tuning* pseudo-features like +prefer-no-gather that the loader
+    then rejects against raw cpuid host features — every entry is
+    write-only). Costs up to 2x the lane-adds of the shrinking fold, so
+    the TPU backend keeps the shrink variant.
+    """
     T = pts.shape[-3]
+    pow2 = 1
+    while pow2 < T:
+        pow2 *= 2
+    batch = pts.shape[:-3]
+    if pow2 != T:
+        pts = jnp.concatenate(
+            [pts, identity(batch + (pow2 - T,))], axis=-3)
+    if pow2 == 1:
+        return pts[..., 0, :, :]
+    half = pow2 // 2
+    levels = pow2.bit_length() - 1
+    pad_ids = identity(batch + (half,))
+
+    def fold_level(_, x):
+        xr = x.reshape(batch + (half, 2) + x.shape[-2:])
+        s = add(xr[..., 0, :, :], xr[..., 1, :, :])
+        return jnp.concatenate([s, pad_ids], axis=-3)
+
+    out = jax.lax.fori_loop(0, levels, fold_level, pts)
+    return out[..., 0, :, :]
+
+
+def _tree_sum_shrink(pts: jnp.ndarray) -> jnp.ndarray:
+    """Tree reduction over axis -3 with shrinking shapes (odd tail carried).
+
+    On XLA:CPU, large term counts route through the compile-cheap
+    single-instantiation fold instead (see _tree_sum_loop)."""
+    T = pts.shape[-3]
+    if T > 4 and jax.default_backend() == "cpu":
+        return _tree_sum_loop(pts)
     while T > 1:
         half = T // 2
         s = add(pts[..., :half, :, :], pts[..., half : 2 * half, :, :])

@@ -74,21 +74,22 @@ def _fb_fold_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         0, windows, body, tec.identity(bB, cc), unroll=False)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "lane_block"))
 def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
-              interpret: bool = False) -> jnp.ndarray:
+              interpret: bool = False,
+              lane_block: int = LANE_BLOCK) -> jnp.ndarray:
     """Fused fixed-base fold, transposed interface.
 
     planes_t: (T, W, 96, 256) plane-dtype byte-plane tables (transposed);
-    digits_t: (T, W, B) int32 with B a multiple of LANE_BLOCK (pad digit 0
-        -> identity entry -> identity point for dead lanes).
+    digits_t: (T, W, B) int32 with B a multiple of `lane_block` (pad digit
+        0 -> identity entry -> identity point for dead lanes).
     Returns (T, 48, B) uint32: per-(term, lane) folded points.
     """
     from jax.experimental import pallas as pl
 
     T, W, _, _ = planes_t.shape
     B = digits_t.shape[-1]
-    assert B % LANE_BLOCK == 0, (B, LANE_BLOCK)
+    assert B % lane_block == 0, (B, lane_block)
     cc = tec.make_consts()
     consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
               cc.ts.w_mod, cc.b3)
@@ -99,13 +100,13 @@ def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
     kernel = functools.partial(_fb_fold_kernel, windows=W)
     return pl.pallas_call(
         kernel,
-        grid=(T, B // LANE_BLOCK),
+        grid=(T, B // lane_block),
         in_specs=[
             pl.BlockSpec((1, W, 96, 256), lambda t, b: (t, 0, 0, 0)),
-            pl.BlockSpec((1, W, LANE_BLOCK), lambda t, b: (t, 0, b)),
+            pl.BlockSpec((1, W, lane_block), lambda t, b: (t, 0, b)),
             *const_specs,
         ],
-        out_specs=pl.BlockSpec((1, 48, LANE_BLOCK), lambda t, b: (t, 0, b)),
+        out_specs=pl.BlockSpec((1, 48, lane_block), lambda t, b: (t, 0, b)),
         out_shape=jax.ShapeDtypeStruct((T, 48, B), jnp.uint32),
         interpret=interpret,
     )(planes_t, digits_t, *consts)
@@ -135,9 +136,18 @@ def _untranspose(folded: jnp.ndarray) -> jnp.ndarray:
     return out.reshape(B, T, 3, N)
 
 
-def _pad_lanes(digits_t: jnp.ndarray) -> tuple[jnp.ndarray, int]:
+def _lane_block_for(b: int) -> int:
+    """Smallest 128-multiple block that does not over-pad small batches."""
+    for cand in (128, 256, 512):
+        if b <= cand:
+            return cand
+    return LANE_BLOCK
+
+
+def _pad_lanes(digits_t: jnp.ndarray,
+               lane_block: int) -> tuple[jnp.ndarray, int]:
     B = digits_t.shape[-1]
-    pad = (-B) % LANE_BLOCK
+    pad = (-B) % lane_block
     if pad:
         digits_t = jnp.concatenate(
             [digits_t,
@@ -146,17 +156,23 @@ def _pad_lanes(digits_t: jnp.ndarray) -> tuple[jnp.ndarray, int]:
     return digits_t, B
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def fixed_base_gather_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
                             interpret: bool = False) -> jnp.ndarray:
     """Per-term fixed-base scalar mul (ec.fixed_base_gather semantics).
 
     planes_t: (T, 32, 96, 256) transposed planes; scalars: (B, T, 16).
-    Returns (B, T, 3, 16) = scalars[b, t] * P_t.
+    Returns (B, T, 3, 16) = scalars[b, t] * P_t. Jitted end-to-end so the
+    digit prep / transposes / tree folds around the pallas_call never run
+    eagerly (each eager op is a separate dispatch through the TPU tunnel).
     """
-    dt, B = _pad_lanes(_digits_t(scalars))
-    return _untranspose(fb_fold_t(planes_t, dt, interpret=interpret))[:B]
+    lb = _lane_block_for(scalars.shape[0])
+    dt, B = _pad_lanes(_digits_t(scalars), lb)
+    return _untranspose(
+        fb_fold_t(planes_t, dt, interpret=interpret, lane_block=lb))[:B]
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def fixed_base_msm_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
                          interpret: bool = False) -> jnp.ndarray:
     """Fixed-base MSM (ec.fixed_base_msm semantics) via the fused fold.
@@ -172,3 +188,115 @@ def fixed_base_msm_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
     per_term = fixed_base_gather_fused(planes_t, flat, interpret=interpret)
     folded = ec._tree_sum_shrink(per_term)    # (Bflat, 3, 16)
     return folded.reshape(batch + (3, N))
+
+
+# --------------------------------------------------------------------------
+# Fused variable-base windowed MSM (the combined-RLC pass-2 kernel)
+# --------------------------------------------------------------------------
+
+#: term lanes per grid step for the variable-base kernel.
+VAR_BLOCK = 512
+#: lanes the per-window partial reduces down to inside the kernel (the
+#: Horner accumulator width; folded to one point by the XLA-side tail).
+_VAR_KEEP = 128
+
+
+def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
+                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+    """One term-block: 4-bit-window Horner over a VMEM multiple table.
+
+    pts_ref:    (48, VAR_BLOCK) uint32 transposed projective points.
+    digits_ref: (windows, 1, VAR_BLOCK) int32 — 4-bit digits, LSB-first
+        window index on the LEADING axis (dynamic indexing inside the
+        window loop must hit a non-tiled dim).
+    out_ref:    (1, 48, _VAR_KEEP) uint32 — this block's partial sum,
+        spread over _VAR_KEEP lanes (callers fold the lanes + blocks).
+
+    Per window (MSB-first): 16-entry masked select per lane, two halving
+    adds down to _VAR_KEEP lanes, then acc = 16*acc + partial. The whole
+    walk — table build, selects, folds, doublings — stays in VMEM; the
+    XLA path materializes each of these in HBM.
+    """
+    cc = tec.CurveConsts(
+        ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
+                    r1=r1_ref[...], w_nprime=wnp_ref[...],
+                    w_mod=wmod_ref[...], mod_int=0),
+        b3=b3_ref[...])
+    pts = pts_ref[...]
+    bV = pts.shape[-1]
+
+    # 16-entry multiple table: tbl[e] = e * P per lane (15 sequential adds)
+    tbl = [tec.identity(bV, cc), pts]
+    for _ in range(2, 16):
+        tbl.append(tec.add(tbl[-1], pts, cc))
+
+    def body(i, acc):
+        w = windows - 1 - i
+        d = digits_ref[w, 0, :]                           # (bV,) int32
+        sel = tbl[0]
+        for e in range(1, 16):
+            sel = jnp.where(d[None, :] == e, tbl[e], sel)
+        lanes = bV
+        while lanes > _VAR_KEEP:                          # halving folds
+            half = lanes // 2
+            sel = tec.add(sel[..., :half], sel[..., half:lanes], cc)
+            lanes = half
+        for _ in range(4):                                # acc *= 16
+            acc = tec.add(acc, acc, cc)
+        return tec.add(acc, sel, cc)
+
+    out_ref[0] = jax.lax.fori_loop(0, windows, body,
+                                   tec.identity(_VAR_KEEP, cc))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def msm_var_fused(points: jnp.ndarray, scalars: jnp.ndarray,
+                  interpret: bool = False) -> jnp.ndarray:
+    """Windowed variable-base MSM (ec.msm_windowed semantics, one row).
+
+    points: (V, 3, 16) Montgomery projective; scalars: (V, 16) plain
+    limbs. Returns (3, 16) = sum_v scalars[v] * points[v]. V is padded to
+    a VAR_BLOCK multiple with identity points (exact no-ops).
+    """
+    from jax.experimental import pallas as pl
+
+    from . import ec
+
+    V = points.shape[0]
+    pad = (-V) % VAR_BLOCK
+    if pad:
+        points = jnp.concatenate([points, ec.identity((pad,))], axis=0)
+        scalars = jnp.concatenate(
+            [scalars, jnp.zeros((pad, N), dtype=scalars.dtype)], axis=0)
+        V += pad
+    pts_t = jnp.transpose(points.reshape(V, 48), (1, 0))  # (48, V)
+    digits = ec.window_digits4(scalars)                   # (V, W)
+    W = digits.shape[-1]
+    digits_t = jnp.transpose(digits, (1, 0)).reshape(W, 1, V).astype(
+        jnp.int32)
+
+    cc = tec.make_consts()
+    consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
+              cc.ts.w_mod, cc.b3)
+    const_specs = [
+        pl.BlockSpec(c.shape, lambda b, *, _nd=c.ndim: (0,) * _nd)
+        for c in consts
+    ]
+    nblocks = V // VAR_BLOCK
+    partials = pl.pallas_call(
+        functools.partial(_msm_var_kernel, windows=W),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((48, VAR_BLOCK), lambda b: (0, b)),
+            pl.BlockSpec((W, 1, VAR_BLOCK), lambda b: (0, 0, b)),
+            *const_specs,
+        ],
+        out_specs=pl.BlockSpec((1, 48, _VAR_KEEP), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nblocks, 48, _VAR_KEEP),
+                                       jnp.uint32),
+        interpret=interpret,
+    )(pts_t, digits_t, *consts)
+    # XLA tail: (nblocks * _VAR_KEEP) lanes -> one point
+    flat = jnp.transpose(partials, (0, 2, 1)).reshape(
+        nblocks * _VAR_KEEP, 3, N)
+    return ec._tree_sum_shrink(flat)

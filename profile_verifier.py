@@ -78,8 +78,15 @@ def main():
     dc_sc = jnp.asarray(rv._pad_rows(dc_sc_np, b_bucket, zero_sc))
     t_marshal = time.perf_counter() - t0
 
+    fused = params.tables_t_rgp is not None
     t0 = time.perf_counter()
-    rgp_dev = rv._rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
+    if fused:
+        from fabric_token_sdk_tpu.ops import pallas_fb
+
+        rgp_dev = pallas_fb.fixed_base_gather_fused(params.tables_t_rgp,
+                                                    yinv)
+    else:
+        rgp_dev = rv._rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
     rgp_dev.block_until_ready()
     t_rgp = time.perf_counter() - t0
 
@@ -89,8 +96,13 @@ def main():
     t_rgp_aff = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    k_dev = rv._k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
-                              dc_sc)
+    if fused:
+        k_dev = rv._k_var_add_kernel(
+            pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
+            dc_pts, dc_sc)
+    else:
+        k_dev = rv._k_pass_kernel(params.tables, params.k_idx, k_fixed,
+                                  dc_pts, dc_sc)
     k_aff = rv._affine_kernel(k_dev)
     k_aff.block_until_ready()
     t_k = time.perf_counter() - t0

@@ -159,3 +159,17 @@ class TestFusedFixedBase:
             want = bn254.msm(gens, sc_int[b])
             pt = L.projective_limbs_to_point(got[b])
             assert _same(pt, want), b
+
+
+class TestFusedVarMSM:
+    """Interpret-mode run of the variable-base Horner kernel."""
+
+    def test_var_msm_parity(self):
+        V = 7   # pads to VAR_BLOCK with identity terms
+        pts = _rand_pts(V - 1) + [bn254.G1_IDENTITY]
+        sc = [secrets.randbelow(bn254.R) for _ in range(V)]
+        got = np.asarray(pallas_fb.msm_var_fused(
+            jnp.asarray(L.points_to_projective_limbs(pts)),
+            jnp.asarray(L.scalars_to_limbs(sc)), interpret=True))
+        want = bn254.msm(pts[:-1], sc[:-1])
+        assert _same(L.projective_limbs_to_point(got), want)
