@@ -270,6 +270,19 @@ def build_parser() -> argparse.ArgumentParser:
     pprint.add_argument("path")
     pprint.set_defaults(fn=_pp_print)
 
+    # `utils pp print -i FILE`: the reference's nested utils verb
+    # (cmd/tokengen/main.go:49 -> cobra/pp/utils.go -> printpp/print.go);
+    # same inspection as `pp print`, kept verb-compatible for operators.
+    utils = sub.add_parser("utils", help="public parameters utils")
+    utilssub = utils.add_subparsers(dest="utilscmd", required=True)
+    upp = utilssub.add_parser("pp", help="public parameters utility "
+                                         "commands")
+    uppsub = upp.add_subparsers(dest="uppcmd", required=True)
+    upprint = uppsub.add_parser("print", help="inspect public parameters")
+    upprint.add_argument("--input", "-i", dest="path", required=True,
+                         help="path of the public param file")
+    upprint.set_defaults(fn=_pp_print)
+
     upd = sub.add_parser("update", help="refresh serialized parameters")
     upd.add_argument("path")
     upd.set_defaults(fn=_update)

@@ -37,6 +37,8 @@ verifier, preserving the reference's observable error ordering.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import secrets
 from dataclasses import dataclass
 
@@ -46,8 +48,7 @@ import numpy as np
 
 from ..crypto import bn254, rp
 from ..crypto import serialization as ser
-from ..crypto.bn254 import (fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub,
-                            hash_to_zr)
+from ..crypto.bn254 import fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub
 from ..native import load_frmont
 from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows
@@ -58,6 +59,11 @@ R = bn254.R
 
 # Native host-phase accelerator (C Montgomery Fr); None -> pure Python.
 _FRNATIVE = load_frmont()
+
+#: rows per pipeline chunk (single-chip): all chunks' pass-1 kernels are
+#: dispatched before any sync, so host stage-2 of chunk k overlaps the
+#: device's pass-1 of chunks k+1... (the round-4 profile's host wall).
+_CHUNK_ROWS = max(1, int(os.environ.get("FTS_VERIFY_CHUNK", "256")))
 
 
 # --------------------------------------------------------------------------
@@ -92,6 +98,22 @@ def affine_batch_to_bytes(arr: np.ndarray) -> np.ndarray:
     return inter.reshape(*a.shape[:-2], 64)
 
 
+_HEX_LUT = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)
+
+
+def hex_ascii(a: np.ndarray) -> np.ndarray:
+    """Vectorized bytes->lowercase-hex-ascii: (..., K) u8 -> (..., 2K) u8.
+
+    One batch of table lookups replaces per-proof bytes.hex() loops (the
+    Fiat-Shamir transcripts hash hex text, reference
+    crypto/common/array.go:25-36)."""
+    a = np.asarray(a, dtype=np.uint8)
+    out = np.empty(a.shape[:-1] + (2 * a.shape[-1],), dtype=np.uint8)
+    out[..., 0::2] = _HEX_LUT[a >> 4]
+    out[..., 1::2] = _HEX_LUT[a & 0xF]
+    return out
+
+
 # --------------------------------------------------------------------------
 # device kernels
 # --------------------------------------------------------------------------
@@ -100,19 +122,40 @@ def affine_batch_to_bytes(arr: np.ndarray) -> np.ndarray:
 # compile superlinearly; split, each compiles in seconds and the persistent
 # cache reuses them across runs.
 _tables_kernel = jax.jit(ec.fixed_base_planes)
-_affine_rows_kernel = jax.jit(ec.to_affine_batch)
-_affine_kernel = jax.jit(ec.to_affine)
+
+
+def _limbs_to_bytes_dev(aff: jnp.ndarray) -> jnp.ndarray:
+    """Device twin of affine_batch_to_bytes: (..., 2, 16) u32 -> (..., 64)
+    u8 mathlib G1 bytes. Halves the device->host transfer (the tunnel is
+    a measured cost at B>=1024) and removes the host-side conversion."""
+    a = aff[..., ::-1]
+    hi = (a >> 8).astype(jnp.uint8)
+    lo = (a & 0xFF).astype(jnp.uint8)
+    inter = jnp.stack([hi, lo], axis=-1)  # (..., 2, 16, 2)
+    return inter.reshape(*a.shape[:-2], 64)
+
+
+@jax.jit
+def _affine_bytes_rows_kernel(pts):
+    """(B, T, 3, 16) projective -> (B, T, 64) u8 canonical bytes."""
+    return _limbs_to_bytes_dev(ec.to_affine_batch(pts))
+
+
+@jax.jit
+def _affine_bytes_kernel(pts):
+    """(B, 3, 16) projective -> (B, 64) u8 canonical bytes."""
+    return _limbs_to_bytes_dev(ec.to_affine(pts))
 
 
 def _pallas_enabled() -> bool:
-    """Fused Pallas kernels: TPU backend only (Mosaic lowering), opt-out
-    via FTS_NO_PALLAS=1. The CPU backend and the CPU-mesh dryrun keep the
-    XLA one-hot path."""
-    import os
-
+    """Fused Pallas kernels: TPU backend only (the kernels are written
+    against Mosaic lowering constraints; on any other non-CPU backend the
+    Triton lowering would likely fail — ADVICE r4), opt-out via
+    FTS_NO_PALLAS=1. The CPU backend and the CPU-mesh dryrun keep the XLA
+    one-hot path."""
     if os.environ.get("FTS_NO_PALLAS"):
         return False
-    return jax.default_backend() not in ("cpu",)
+    return jax.default_backend() == "tpu"
 
 
 @jax.jit
@@ -140,26 +183,22 @@ def _k_var_add_kernel(k_fixed_pt, dc_pts, dc_sc):
 
 
 @jax.jit
-def _combined_kernel(tables, fixed_sc, var_pts, var_sc):
-    """RLC of every proof's eq1+eq2 == identity? -> () bool."""
-    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
-    var_pt = ec.msm_windowed(var_pts, var_sc)
-    return ec.is_identity(ec.add(fixed_pt, var_pt))
-
-
-@jax.jit
-def _combined_fused_tail(tables, fixed_sc, var_pt):
-    """Fixed-generator part + pallas var-MSM partial -> () bool."""
-    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
-    return ec.is_identity(ec.add(fixed_pt, var_pt))
-
-
-@jax.jit
 def _exact_pass_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
     """Two per-proof MSM identity checks; returns (B,) bool accept vector."""
     ok1 = ec.is_identity(ec.msm_windowed(eq1_pts, eq1_sc))
     ok2 = ec.is_identity(ec.msm_windowed(eq2_pts, eq2_sc))
     return jnp.logical_and(ok1, ok2)
+
+
+_var_partial_kernel = jax.jit(ec.msm_windowed)
+
+
+@jax.jit
+def _finalize_kernel(tables, fixed_sc, partials):
+    """Fixed-generator MSM + fold of per-chunk var partials -> () bool."""
+    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
+    var_pt = ec._tree_sum_shrink(partials)
+    return ec.is_identity(ec.add(fixed_pt, var_pt))
 
 
 # --------------------------------------------------------------------------
@@ -391,6 +430,104 @@ def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
                             k_fixed_scalars=k_fixed, k_var_scalars=k_var)
 
 
+# --------------------------------------------------------------------------
+# batched Fiat-Shamir transcript assembly (host, numpy-vectorized)
+# --------------------------------------------------------------------------
+
+_XIPA_LAYOUTS: dict = {}
+
+
+def _xipa_layout(params):
+    """Precomputed byte template + fill indices for the first-IPA-challenge
+    message (reference ipa.go:159-173).
+
+    The message is marshal_std_bytes_slices([array_bytes, SEPARATOR,
+    zr_to_bytes(ip)]) where array_bytes joins fixed-length hex items:
+    n per-proof H' points, the constant left generators, Q, and the
+    per-proof K. Every length is static for a given bit_length, so one
+    uint8 template + three fancy-index fills assemble the whole batch.
+    """
+    # key covers EVERY byte baked into the template: two pp sets differing
+    # in any generator must never share a cached layout
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes)
+    if key in _XIPA_LAYOUTS:
+        return _XIPA_LAYOUTS[key]
+    n = params.bit_length
+    hexlen = 128
+    sep = ser.SEPARATOR
+    buf = bytearray()
+    rgp_off = []
+    for _ in range(n):
+        rgp_off.append(len(buf))
+        buf += b"\x00" * hexlen + sep
+    for lg in params.left_gen_bytes:
+        buf += lg + sep
+    buf += params.q_bytes + sep
+    k_off = len(buf)
+    buf += b"\x00" * hexlen
+    array_bytes = bytes(buf)
+    oct1 = b"\x04" + ser._der_len(len(array_bytes)) + array_bytes
+    oct2 = b"\x04" + ser._der_len(len(sep)) + sep
+    oct3 = b"\x04" + ser._der_len(32) + b"\x00" * 32
+    body = oct1 + oct2 + oct3
+    msg = b"\x30" + ser._der_len(len(body)) + body
+    base = len(msg) - len(body) + (len(oct1) - len(array_bytes))
+    tmpl = np.frombuffer(msg, dtype=np.uint8).copy()
+    rgp_idx = np.concatenate(
+        [np.arange(base + o, base + o + hexlen) for o in rgp_off])
+    k_idx = np.arange(base + k_off, base + k_off + hexlen)
+    ip_idx = np.arange(len(tmpl) - 32, len(tmpl))
+    _XIPA_LAYOUTS[key] = (tmpl, rgp_idx, k_idx, ip_idx)
+    return _XIPA_LAYOUTS[key]
+
+
+def _xipa_batch(params, proofs, live, rgp_u8: np.ndarray,
+                k_u8: np.ndarray) -> list[int]:
+    """First IPA challenge for every live proof, one vectorized assembly.
+
+    rgp_u8: (L, n, 64) u8 pass-1 H' bytes; k_u8: (L, 64) u8 K bytes.
+    """
+    tmpl, rgp_idx, k_idx, ip_idx = _xipa_layout(params)
+    L = len(live)
+    msg = np.tile(tmpl, (L, 1))
+    msg[:, rgp_idx] = hex_ascii(rgp_u8).reshape(L, -1)
+    msg[:, k_idx] = hex_ascii(k_u8)
+    ip_np = np.frombuffer(
+        b"".join(ser.zr_to_bytes(proofs[i].data.inner_product)
+                 for i in live), dtype=np.uint8).reshape(L, 32)
+    msg[:, ip_idx] = ip_np
+    return [int.from_bytes(hashlib.sha256(msg[r].data).digest(), "big") % R
+            for r in range(L)]
+
+
+def _round_challenges_batch(proofs, live, rounds: int) -> np.ndarray:
+    """IPA round challenges for every live proof (reference ipa.go:224-252):
+    hash(hex(L_r) || hex(R_r)) per round, assembled as one uint8 batch.
+
+    Returns an (L, rounds) object array of ints.
+    """
+    L = len(live)
+    pts = np.empty((L, rounds, 2, 64), dtype=np.uint8)
+    for row, i in enumerate(live):
+        ipa = proofs[i].ipa
+        for r_i in range(rounds):
+            pts[row, r_i, 0] = np.frombuffer(
+                ser.g1_to_bytes(ipa.L[r_i]), dtype=np.uint8)
+            pts[row, r_i, 1] = np.frombuffer(
+                ser.g1_to_bytes(ipa.R[r_i]), dtype=np.uint8)
+    hexed = hex_ascii(pts)                       # (L, rounds, 2, 128)
+    msg = np.empty((L, rounds, 258), dtype=np.uint8)
+    msg[..., :128] = hexed[..., 0, :]
+    msg[..., 128:130] = np.frombuffer(ser.SEPARATOR, dtype=np.uint8)
+    msg[..., 130:] = hexed[..., 1, :]
+    out = np.empty((L, rounds), dtype=object)
+    for row in range(L):
+        for r_i in range(rounds):
+            out[row, r_i] = int.from_bytes(
+                hashlib.sha256(msg[row, r_i].data).digest(), "big") % R
+    return out
+
+
 @dataclass
 class _ProofEquations:
     """Per-proof eq1/eq2 scalars, split fixed-generator vs proof points.
@@ -410,9 +547,18 @@ class _ProofEquations:
 
 
 def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
-                  rgp_bytes_hex: list[bytes], k_bytes_hex: bytes,
-                  params) -> _ProofEquations:
-    """First IPA challenge + round folding -> combined scalar vectors."""
+                  x_ipa: int, round_ch: list[int], params,
+                  ch_packed: bytes | None = None,
+                  inv_packed: bytes | None = None) -> _ProofEquations:
+    """Round folding -> combined scalar vectors.
+
+    Challenges arrive precomputed: x_ipa from _xipa_batch (it needs the
+    pass-1 bytes), round_ch from _round_challenges_batch (proof bytes
+    only, so the caller overlaps them with the device pass). ch_packed /
+    inv_packed carry the native-path packed forms when _FRNATIVE is live
+    (inversions batched across the WHOLE chunk by the caller — one
+    Fermat inversion per chunk, not per proof).
+    """
     n = params.bit_length
     d = proof.data
     ipa = proof.ipa
@@ -420,22 +566,11 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     z_sq = fr_mul(z, z)
     x_sq = fr_mul(x, x)
 
-    # first IPA challenge: hash(right_gen' ++ left_gen ++ [Q, K], ip)
-    # (reference ipa.go:159-173 — right generators first).
-    array_bytes = ser.SEPARATOR.join(
-        list(rgp_bytes_hex) + list(params.left_gen_bytes)
-        + [params.q_bytes, k_bytes_hex])
-    raw = ser.marshal_std_bytes_slices(
-        [array_bytes, ser.SEPARATOR, ser.zr_to_bytes(d.inner_product)])
-    x_ipa = hash_to_zr(raw)
-
-    round_ch = [rp.ipa_round_challenge(L, Rp) for L, Rp in zip(ipa.L, ipa.R)]
-
     if _FRNATIVE is not None:
-        # fused native assembly (frmont.c phase_b, parity-pinned); round
-        # inversions ride the same extension
-        ch_packed = limbs.pack_scalars(round_ch)
-        inv_packed = _FRNATIVE.batch_inv(ch_packed)
+        # fused native assembly (frmont.c phase_b, parity-pinned)
+        if ch_packed is None:
+            ch_packed = limbs.pack_scalars(round_ch)
+            inv_packed = _FRNATIVE.batch_inv(ch_packed)
         scalars = limbs.pack_scalars(
             [ipa.left, ipa.right, ts.z, x, x_ipa, d.inner_product, d.tau,
              d.delta]) + ts.pol_eval_packed
@@ -446,7 +581,7 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
                                fixed_packed=out[:split],
                                var_packed=out[split:])
 
-    # one batched inversion for (y, every round challenge)
+    # one batched inversion for every round challenge
     round_inv = fr_batch_inv(round_ch)
     pairs = list(zip(round_ch, round_inv))
     a_coeffs = _fold_coefficients(pairs, n, invert_first_half=True)
@@ -572,9 +707,15 @@ class BatchRangeVerifier:
         Fast path: one random-linear-combination identity check for the
         whole batch; falls back to per-proof exact checks when it rejects
         (or when exact=True).
+
+        Single-chip, the batch runs as a PIPELINE of row chunks: every
+        chunk's pass-1 kernels are dispatched up front (async), so the
+        host's challenge hashing + scalar expansion for chunk k overlaps
+        the device's pass-1 of chunks k+1... and each chunk's weighted
+        var-MSM partial is dispatched as soon as its scalars exist. The
+        mesh path keeps one chunk (rows shard over devices instead).
         """
         params = self.params
-        n = params.bit_length
         B = len(proofs)
         if B == 0:
             return np.zeros(0, dtype=bool)
@@ -586,75 +727,36 @@ class BatchRangeVerifier:
             self.last_path = "structure-only"
             return ok_structure
 
-        transcripts = {i: _host_phase_a(proofs[i], commitments[i], params)
-                       for i in live}
+        chunk = len(live) if self.mesh is not None else _CHUNK_ROWS
+        chunks = [live[o:o + chunk] for o in range(0, len(live), chunk)]
 
-        # ---- pass 1: K + right_gen' via fixed-base tables
-        b_bucket = _bucket_rows(len(live))
-        if self._n_shard > 1:
-            # batch rows must divide evenly over the mesh
-            b_bucket = max(b_bucket, self._n_shard)
-            b_bucket += (-b_bucket) % self._n_shard
-        zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
-        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+        # ---- stage 1: all chunks' pass-1 dispatched before any sync
+        stage1 = [self._dispatch_pass1(proofs, commitments, ch)
+                  for ch in chunks]
 
-        if _FRNATIVE is not None:
-            yinv_np = limbs.packed_to_limbs(
-                b"".join(transcripts[i].yinv_packed for i in live)
-            ).reshape(len(live), n, limbs.NLIMBS)
-            k_fixed_np = limbs.packed_to_limbs(
-                b"".join(transcripts[i].k_fixed_packed for i in live)
-            ).reshape(len(live), n + 2, limbs.NLIMBS)
-        else:
-            yinv_np = np.stack(
-                [limbs.scalars_to_limbs(transcripts[i].yinv_pows)
-                 for i in live])
-            k_fixed_np = np.stack(
-                [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
-                 for i in live])
-        yinv = self._put_rows(_pad_rows(yinv_np, b_bucket, zero_sc))
-        k_fixed = self._put_rows(_pad_rows(k_fixed_np, b_bucket, zero_sc))
-        dc_pts_np = np.stack(
-            [limbs.points_to_projective_limbs(
-                [proofs[i].data.D, proofs[i].data.C]) for i in live])
-        dc_pts = self._put_rows(_pad_rows(dc_pts_np, b_bucket, id_pt))
-        dc_sc_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
-             for i in live])
-        dc_sc = self._put_rows(_pad_rows(dc_sc_np, b_bucket, zero_sc))
-
-        if params.tables_t_rgp is not None and self.mesh is None:
-            # fused Pallas pass-1: select+fold in VMEM (no one-hot in HBM)
-            from ..ops import pallas_fb
-
-            rgp_pts = pallas_fb.fixed_base_gather_fused(
-                params.tables_t_rgp, yinv)
-            k_pt = _k_var_add_kernel(
-                pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
-                dc_pts, dc_sc)
-            rgp_aff = _affine_rows_kernel(rgp_pts)
-            k_aff = _affine_kernel(k_pt)
-        else:
-            rgp_aff = _affine_rows_kernel(
-                _rgp_gather_kernel(params.tables, params.rgp_idx, yinv))
-            k_aff = _affine_kernel(
-                _k_pass_kernel(params.tables, params.k_idx, k_fixed, dc_pts,
-                               dc_sc))
-        rgp_bytes = affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
-        k_bytes = affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
-
-        # ---- host: challenges + scalar expansion
+        # ---- stage 2: per chunk, sync bytes -> challenges -> equations;
+        # combined partial dispatched immediately (device keeps working)
+        n_fixed = 2 * params.bit_length + 5
         equations: dict[int, _ProofEquations] = {}
-        for row, i in enumerate(live):
-            rgp_hex = [bytes(rgp_bytes[row, j]).hex().encode("ascii")
-                       for j in range(n)]
-            k_hex = bytes(k_bytes[row]).hex().encode("ascii")
-            equations[i] = _host_phase_b(proofs[i], transcripts[i], rgp_hex,
-                                         k_hex, params)
+        fixed_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
+                     else [0] * n_fixed)
+        partials: list = []
+        for ch, st in zip(chunks, stage1):
+            eqs_ch = self._host_stage2(proofs, ch, st)
+            equations.update(eqs_ch)
+            if not exact and self.mesh is None:
+                fixed_acc, part = self._combined_chunk(
+                    proofs, commitments, ch, eqs_ch, fixed_acc)
+                partials.append(part)
 
         # ---- pass 2
         if not exact:
-            if self._verify_combined(proofs, commitments, live, equations):
+            if self.mesh is not None:
+                ok = self._verify_combined(proofs, commitments, live,
+                                           equations)
+            else:
+                ok = self._combined_finalize(fixed_acc, partials)
+            if ok:
                 self.last_path = "combined"
                 return ok_structure
         accepts_live = self._verify_exact(proofs, commitments, live,
@@ -666,54 +768,138 @@ class BatchRangeVerifier:
         return out
 
     # ------------------------------------------------------------------
-    def _verify_combined(self, proofs, commitments, live,
-                         equations) -> bool:
-        """One RLC MSM over every live proof's eq1+eq2; True iff identity.
+    def _dispatch_pass1(self, proofs, commitments, ch):
+        """Host phase-a + marshal for one chunk, then async dispatch of the
+        pass-1 kernels; returns (transcripts, rgp_bytes_dev, k_bytes_dev)
+        with device->host copies already in flight."""
+        params = self.params
+        n = params.bit_length
+        transcripts = {i: _host_phase_a(proofs[i], commitments[i], params)
+                       for i in ch}
+        b_bucket = _bucket_rows(len(ch))
+        if self._n_shard > 1:
+            # batch rows must divide evenly over the mesh
+            b_bucket = max(b_bucket, self._n_shard)
+            b_bucket += (-b_bucket) % self._n_shard
+        zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
+        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
 
-        Per-proof weights w1 (eq1 terms) and w2 (eq2 terms) are fresh
-        uniform randoms, so cross-proof or cross-equation cancellation of
-        invalid proofs has probability <= 2/r.
+        if _FRNATIVE is not None:
+            yinv_np = limbs.packed_to_limbs(
+                b"".join(transcripts[i].yinv_packed for i in ch)
+            ).reshape(len(ch), n, limbs.NLIMBS)
+            k_fixed_np = limbs.packed_to_limbs(
+                b"".join(transcripts[i].k_fixed_packed for i in ch)
+            ).reshape(len(ch), n + 2, limbs.NLIMBS)
+        else:
+            yinv_np = np.stack(
+                [limbs.scalars_to_limbs(transcripts[i].yinv_pows)
+                 for i in ch])
+            k_fixed_np = np.stack(
+                [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
+                 for i in ch])
+        yinv = self._put_rows(_pad_rows(yinv_np, b_bucket, zero_sc))
+        k_fixed = self._put_rows(_pad_rows(k_fixed_np, b_bucket, zero_sc))
+        dc_pts_np = np.stack(
+            [limbs.points_to_projective_limbs(
+                [proofs[i].data.D, proofs[i].data.C]) for i in ch])
+        dc_pts = self._put_rows(_pad_rows(dc_pts_np, b_bucket, id_pt))
+        dc_sc_np = np.stack(
+            [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
+             for i in ch])
+        dc_sc = self._put_rows(_pad_rows(dc_sc_np, b_bucket, zero_sc))
+
+        if params.tables_t_rgp is not None and self.mesh is None:
+            # fused Pallas pass-1: select+fold in VMEM (no one-hot in HBM)
+            from ..ops import pallas_fb
+
+            rgp_pts = pallas_fb.fixed_base_gather_fused(
+                params.tables_t_rgp, yinv)
+            k_pt = _k_var_add_kernel(
+                pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
+                dc_pts, dc_sc)
+        else:
+            rgp_pts = _rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
+            k_pt = _k_pass_kernel(params.tables, params.k_idx, k_fixed,
+                                  dc_pts, dc_sc)
+        rgp_bytes_dev = _affine_bytes_rows_kernel(rgp_pts)
+        k_bytes_dev = _affine_bytes_kernel(k_pt)
+        for arr in (rgp_bytes_dev, k_bytes_dev):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+        return transcripts, rgp_bytes_dev, k_bytes_dev
+
+    def _host_stage2(self, proofs, ch, st) -> dict:
+        """Challenges (vectorized) + per-proof scalar expansion for one
+        chunk. Blocks on that chunk's pass-1 bytes only."""
+        params = self.params
+        rr = params.rounds
+        transcripts, rgp_dev, k_dev = st
+        # round challenges depend only on proof bytes: hash them BEFORE
+        # blocking on the device transfer so they hide under it
+        rch = _round_challenges_batch(proofs, ch, rr)
+        rgp_u8 = np.asarray(rgp_dev)[:len(ch)]
+        k_u8 = np.asarray(k_dev)[:len(ch)]
+        x_ipa = _xipa_batch(params, proofs, ch, rgp_u8, k_u8)
+        ch_packed_all = inv_packed_all = None
+        if _FRNATIVE is not None:
+            ch_packed_all = limbs.pack_scalars(
+                [rch[row, r] for row in range(len(ch)) for r in range(rr)])
+            inv_packed_all = _FRNATIVE.batch_inv(ch_packed_all)
+        eqs: dict[int, _ProofEquations] = {}
+        for row, i in enumerate(ch):
+            sl = slice(row * rr * 32, (row + 1) * rr * 32)
+            eqs[i] = _host_phase_b(
+                proofs[i], transcripts[i], x_ipa[row], list(rch[row]),
+                params,
+                ch_packed_all[sl] if ch_packed_all is not None else None,
+                inv_packed_all[sl] if inv_packed_all is not None else None)
+        return eqs
+
+    def _weight_equations(self, proofs, commitments, ch, equations,
+                          fixed_acc):
+        """RLC-weight one row set: per-proof fresh (w1, w2), fixed-generator
+        scalars accumulated into fixed_acc on host, weighted var scalars
+        collected. Returns (fixed_acc, var_pts, var_scalar_limbs_fn).
+
+        Shared by the single-chip chunk pipeline and the sharded full
+        pass — the weight layout lives HERE only.
         """
         params = self.params
         n = params.bit_length
-        rr = params.rounds
-        n_fixed = 2 * n + 5
-        n_eq2 = 2 + 2 * rr
+        n_eq2 = 2 + 2 * params.rounds
 
         var_pts: list = []
-        for i in live:
+        for i in ch:
             d = proofs[i].data
             var_pts.extend([d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R
                            + [d.T1, d.T2, commitments[i]])
 
         if _FRNATIVE is not None:
-            fixed_acc_packed = bytes(32 * n_fixed)
             var_sc_packed: list[bytes] = []
             zero32 = bytes(32)
-            for i in live:
+            for i in ch:
                 w1 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
                 w2 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
                 eq = equations[i]
                 # fixed layout: G(n), H(n), P, Q @ w2 | cg0, cg1 @ w1 | S_G
                 weights = w2 * (2 * n + 2) + w1 * 2 + zero32
-                fixed_acc_packed = _FRNATIVE.addmul_many(
-                    fixed_acc_packed, eq.fixed_packed, weights)
+                fixed_acc = _FRNATIVE.addmul_many(
+                    fixed_acc, eq.fixed_packed, weights)
                 var_sc_packed.append(_FRNATIVE.mul_many(
                     eq.var_packed, w2 * n_eq2 + w1 * 3))
+            sc_blob = b"".join(var_sc_packed)
 
             def var_scalar_limbs(n_pad: int) -> np.ndarray:
-                return limbs.packed_to_limbs(
-                    b"".join(var_sc_packed) + bytes(32) * n_pad)
-
-            fixed_np = limbs.packed_to_limbs(fixed_acc_packed)
+                return limbs.packed_to_limbs(sc_blob + bytes(32) * n_pad)
         else:
-            fixed_acc = [0] * n_fixed
             var_sc: list[int] = []
-            for i in live:
+            for i in ch:
                 w1 = 1 + secrets.randbelow(R - 1)
                 w2 = 1 + secrets.randbelow(R - 1)
                 eq = equations[i]
-                # fixed layout: G(n), H(n) @ w2 | P, Q @ w2 | cg0, cg1 @ w1
                 for j in range(2 * n + 2):
                     fixed_acc[j] = fr_add(fixed_acc[j],
                                           fr_mul(w2, eq.fixed[j]))
@@ -727,7 +913,64 @@ class BatchRangeVerifier:
             def var_scalar_limbs(n_pad: int) -> np.ndarray:
                 return limbs.scalars_to_limbs(var_sc + [0] * n_pad)
 
-            fixed_np = limbs.scalars_to_limbs(fixed_acc)
+        return fixed_acc, var_pts, var_scalar_limbs
+
+    def _combined_chunk(self, proofs, commitments, ch, equations,
+                        fixed_acc):
+        """Weight one chunk's equations into the running RLC and dispatch
+        the chunk's var-MSM partial on device. Returns (fixed_acc,
+        partial_device_point)."""
+        params = self.params
+        fixed_acc, var_pts, var_scalar_limbs = self._weight_equations(
+            proofs, commitments, ch, equations, fixed_acc)
+
+        v = len(var_pts)
+        p = _next_pow2(max(128, v))
+        v_target = (3 * p // 4) if v <= 3 * p // 4 else p
+        pts_np = limbs.points_to_projective_limbs(
+            var_pts + [bn254.G1_IDENTITY] * (v_target - v))
+        sc_np = var_scalar_limbs(v_target - v)
+        if params.tables_t_rgp is not None:
+            from ..ops import pallas_fb
+
+            part = pallas_fb.msm_var_fused(jnp.asarray(pts_np),
+                                           jnp.asarray(sc_np))
+        else:
+            part = _var_partial_kernel(jnp.asarray(pts_np),
+                                       jnp.asarray(sc_np))
+        return fixed_acc, part
+
+    def _combined_finalize(self, fixed_acc, partials) -> bool:
+        """Fixed-base MSM of the accumulated scalars + fold of the chunk
+        partials; True iff the total is the identity."""
+        fixed_np = (limbs.packed_to_limbs(fixed_acc)
+                    if _FRNATIVE is not None
+                    else limbs.scalars_to_limbs(fixed_acc))
+        parts = jnp.stack(partials)
+        return bool(_finalize_kernel(self.params.tables,
+                                     jnp.asarray(fixed_np), parts))
+
+    # ------------------------------------------------------------------
+    def _verify_combined(self, proofs, commitments, live,
+                         equations) -> bool:
+        """Sharded RLC pass (mesh path): one MSM over every live proof's
+        eq1+eq2 with the term axis sharded over the mesh; True iff
+        identity. Weight layout lives in _weight_equations (shared with
+        the single-chip chunk pipeline).
+
+        Per-proof weights w1 (eq1 terms) and w2 (eq2 terms) are fresh
+        uniform randoms, so cross-proof or cross-equation cancellation of
+        invalid proofs has probability <= 2/r.
+        """
+        params = self.params
+        n_fixed = 2 * params.bit_length + 5
+        fixed_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
+                     else [0] * n_fixed)
+        fixed_acc, var_pts, var_scalar_limbs = self._weight_equations(
+            proofs, commitments, live, equations, fixed_acc)
+        fixed_np = (limbs.packed_to_limbs(fixed_acc)
+                    if _FRNATIVE is not None
+                    else limbs.scalars_to_limbs(fixed_acc))
 
         # pad the variable MSM to the next {2^k, 1.5*2^k} bucket: still a
         # handful of compiled shapes, but at most 33% padding waste (a
@@ -735,28 +978,13 @@ class BatchRangeVerifier:
         v = len(var_pts)
         p = _next_pow2(max(128, v))
         v_target = (3 * p // 4) if v <= 3 * p // 4 else p
-        if self._n_shard > 1:
-            v_target += (-v_target) % self._n_shard
+        v_target += (-v_target) % self._n_shard
         pts_np = limbs.points_to_projective_limbs(
             var_pts + [bn254.G1_IDENTITY] * (v_target - v))
         sc_np = var_scalar_limbs(v_target - v)
-        if self._combined_sharded is not None:
-            ok = self._combined_sharded(
-                params.tables, jnp.asarray(fixed_np),
-                self._put_rows(pts_np), self._put_rows(sc_np))
-        elif params.tables_t_rgp is not None:
-            # fused path: the variable MSM walks its multiple tables and
-            # window folds in VMEM (pallas), only the tiny fixed-part +
-            # identity check remain in XLA
-            from ..ops import pallas_fb
-
-            var_pt = pallas_fb.msm_var_fused(jnp.asarray(pts_np),
-                                             jnp.asarray(sc_np))
-            ok = _combined_fused_tail(params.tables, jnp.asarray(fixed_np),
-                                      var_pt)
-        else:
-            ok = _combined_kernel(params.tables, jnp.asarray(fixed_np),
-                                  jnp.asarray(pts_np), jnp.asarray(sc_np))
+        ok = self._combined_sharded(
+            params.tables, jnp.asarray(fixed_np),
+            self._put_rows(pts_np), self._put_rows(sc_np))
         return bool(ok)
 
     # ------------------------------------------------------------------

@@ -192,6 +192,24 @@ class WalletService:
     def wallet_ids(self, role: str) -> list[str]:
         return self.registries[role].wallet_ids()
 
+    def wallet(self, identity: bytes):
+        """wallet/service.go Wallet(identity): the wallet owning
+        `identity` across every role (long-term identities and bound
+        pseudonyms alike), else None. request.go:1069 BindTo uses this
+        to recognize — and skip — locally-owned identities."""
+        ident = bytes(identity)
+        for r in RoleType.ALL:
+            reg = self.registries[r]
+            if reg.contains_identity(ident):
+                m = reg.role.membership
+                label = m.get_identifier(ident)
+                if label is None:
+                    label = reg._bindings[ident][1]
+                w = m.wallet(label)
+                if w is not None:
+                    return w
+        return None
+
     # -------------------------------------------------------- registration
     def register_owner_wallet(self, wallet_id: str, wallet,
                               enrollment_id: str = "") -> None:

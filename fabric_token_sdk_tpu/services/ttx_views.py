@@ -327,6 +327,10 @@ def _respond_withdrawal(node, session: Session, vbus: ViewBus) -> None:
             node.ttxdb.add_transaction(rec)
         stored_tx = tx_id
         ev = ordering_and_finality(tx, node.cc)
+        # Ordered: the ledger outcome is now authoritative, so the failure
+        # close-out below must NOT mark the record DELETED if the final
+        # status send to a disconnected requester raises (ADVICE r4).
+        stored_tx = None
         session.send({"tx_id": tx_id, "status": ev.status,
                       "message": ev.message})
     except Exception as e:

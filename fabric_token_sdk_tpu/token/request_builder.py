@@ -163,6 +163,46 @@ class Request:
     def input_owner_ids(self) -> list[bytes]:
         return list(self._input_owner_ids)
 
+    def bind_to(self, binder, identity: bytes,
+                wallet_service=None) -> None:
+        """request.go:1069 BindTo: when the party submitting this request
+        changes (e.g. a recipient finalizes a transaction assembled by the
+        sender), every transfer sender, extra signer, and receiver identity
+        that is NOT owned by a local wallet must be bound to the submitting
+        party's identity so endorsement-signature resolution routes to it.
+
+        binder: any object with bind(long_term: bytes, ephemeral: bytes)
+        (the endpoint-binding service); wallet_service: the local
+        WalletService used to recognize own identities (skipped).
+        """
+        ws = wallet_service
+        if ws is None:
+            ws = getattr(self.driver, "wallets", None)
+
+        def is_mine(ident: bytes) -> bool:
+            return ws is not None and ws.wallet(ident) is not None
+
+        seen: set[bytes] = set()
+
+        def bind(ident) -> None:
+            if ident is None:
+                return
+            b = bytes(ident)
+            if not b or b in seen or is_mine(b):
+                return
+            seen.add(b)
+            binder.bind(bytes(identity), b)
+
+        for sender in self._input_owner_ids:       # transfer senders
+            bind(sender)
+        for a, md in self._transfers:
+            # extra signers live on the transfer METADATA (metadata.py
+            # TransferActionMetadata.extra_signers), not the action
+            for eid in getattr(md, "extra_signers", None) or []:
+                bind(eid)
+            for out in a.get_outputs():            # receivers
+                bind(getattr(out, "owner", None))
+
     def marshal_to_sign(self) -> bytes:
         """request.go:968 MarshalToSign: the bytes every endorser, the
         issuer, and the auditor sign."""

@@ -1,7 +1,9 @@
 """Phase-level profile of the batched range verifier on the current backend.
 
-Times pass-1 (transcript points), host phase a/b, and pass-2 (combined MSM)
-separately at a given batch size. Run on the real chip:
+Reports (a) the end-to-end pipelined verify time at a given batch size and
+(b) a barriered per-phase breakdown of one chunk (phases serialized with
+block_until_ready, so the sum exceeds the pipelined wall time — that gap is
+the host/device overlap the pipeline buys). Run on the real chip:
     python profile_verifier.py [BATCH]
 """
 
@@ -13,15 +15,12 @@ from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
 configure_jax_cache()
 
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from bench import _load  # noqa: E402
 from fabric_token_sdk_tpu.models import range_verifier as rv  # noqa: E402
-from fabric_token_sdk_tpu.ops import limbs  # noqa: E402
-from fabric_token_sdk_tpu.crypto import bn254  # noqa: E402
 
-BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 
 
 def main():
@@ -32,105 +31,86 @@ def main():
 
     t0 = time.perf_counter()
     v = rv.BatchRangeVerifier(pp)
-    params = v.params
     print(f"tables: {time.perf_counter()-t0:.2f}s", flush=True)
 
-    # warm-up full verify (compiles everything)
     t0 = time.perf_counter()
     out = v.verify(proofs, coms)
     print(f"warmup verify: {time.perf_counter()-t0:.2f}s all={out.all()}",
           flush=True)
 
-    # ---- phase timings (steady state)
-    n = params.bit_length
-    live = list(range(BATCH))
-    t0 = time.perf_counter()
-    transcripts = {i: rv._host_phase_a(proofs[i], coms[i], params)
-                   for i in live}
-    t_host_a = time.perf_counter() - t0
+    # ---- end-to-end pipelined (steady state)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = v.verify(proofs, coms)
+        total = time.perf_counter() - t0
+        print(f"B={BATCH}  pipelined total={total:.3f}s "
+              f"({BATCH/total:.1f}/s)  ok={bool(out.all())} "
+              f"path={v.last_path}", flush=True)
 
-    b_bucket = rv._bucket_rows(len(live))
-    zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
-    id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
+    # ---- barriered breakdown of ONE chunk
+    ch = list(range(min(rv._CHUNK_ROWS, BATCH)))
     t0 = time.perf_counter()
+    st = v._dispatch_pass1(proofs, coms, ch)
+    t_dispatch = time.perf_counter() - t0
+    transcripts, rgp_dev, k_dev = st
+    t0 = time.perf_counter()
+    jax.block_until_ready((rgp_dev, k_dev))
+    t_pass1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    rgp_u8 = np.asarray(rgp_dev)[:len(ch)]
+    k_u8 = np.asarray(k_dev)[:len(ch)]
+    t_transfer = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    x_ipa = rv._xipa_batch(v.params, proofs, ch, rgp_u8, k_u8)
+    t_xipa = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    rch = rv._round_challenges_batch(proofs, ch, v.params.rounds)
+    t_round = time.perf_counter() - t0
+    rr = v.params.rounds
+    t0 = time.perf_counter()
+    ch_packed_all = inv_packed_all = None
     if rv._FRNATIVE is not None:
-        yinv_np = limbs.packed_to_limbs(
-            b"".join(transcripts[i].yinv_packed for i in live)
-        ).reshape(len(live), n, limbs.NLIMBS)
-        k_fixed_np = limbs.packed_to_limbs(
-            b"".join(transcripts[i].k_fixed_packed for i in live)
-        ).reshape(len(live), n + 2, limbs.NLIMBS)
-    else:
-        yinv_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].yinv_pows) for i in live])
-        k_fixed_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
-             for i in live])
-    yinv = jnp.asarray(rv._pad_rows(yinv_np, b_bucket, zero_sc))
-    k_fixed = jnp.asarray(rv._pad_rows(k_fixed_np, b_bucket, zero_sc))
-    dc_pts_np = np.stack(
-        [limbs.points_to_projective_limbs(
-            [proofs[i].data.D, proofs[i].data.C]) for i in live])
-    dc_pts = jnp.asarray(rv._pad_rows(dc_pts_np, b_bucket, id_pt))
-    dc_sc_np = np.stack(
-        [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
-         for i in live])
-    dc_sc = jnp.asarray(rv._pad_rows(dc_sc_np, b_bucket, zero_sc))
-    t_marshal = time.perf_counter() - t0
+        from fabric_token_sdk_tpu.ops import limbs
 
-    fused = params.tables_t_rgp is not None
+        ch_packed_all = limbs.pack_scalars(
+            [rch[row, r] for row in range(len(ch)) for r in range(rr)])
+        inv_packed_all = rv._FRNATIVE.batch_inv(ch_packed_all)
+    eqs = {}
+    for row, i in enumerate(ch):
+        sl = slice(row * rr * 32, (row + 1) * rr * 32)
+        eqs[i] = rv._host_phase_b(
+            proofs[i], transcripts[i], x_ipa[row], list(rch[row]), v.params,
+            ch_packed_all[sl] if ch_packed_all is not None else None,
+            inv_packed_all[sl] if inv_packed_all is not None else None)
+    t_phase_b = time.perf_counter() - t0
+    n_fixed = 2 * v.params.bit_length + 5
+    fixed_acc = (bytes(32 * n_fixed) if rv._FRNATIVE is not None
+                 else [0] * n_fixed)
     t0 = time.perf_counter()
-    if fused:
-        from fabric_token_sdk_tpu.ops import pallas_fb
-
-        rgp_dev = pallas_fb.fixed_base_gather_fused(params.tables_t_rgp,
-                                                    yinv)
-    else:
-        rgp_dev = rv._rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
-    rgp_dev.block_until_ready()
-    t_rgp = time.perf_counter() - t0
-
+    fixed_acc, part = v._combined_chunk(proofs, coms, ch, eqs, fixed_acc)
+    t_comb_host = time.perf_counter() - t0
     t0 = time.perf_counter()
-    rgp_aff = rv._affine_rows_kernel(rgp_dev)
-    rgp_aff.block_until_ready()
-    t_rgp_aff = time.perf_counter() - t0
-
+    jax.block_until_ready(part)
+    t_comb_dev = time.perf_counter() - t0
     t0 = time.perf_counter()
-    if fused:
-        k_dev = rv._k_var_add_kernel(
-            pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
-            dc_pts, dc_sc)
-    else:
-        k_dev = rv._k_pass_kernel(params.tables, params.k_idx, k_fixed,
-                                  dc_pts, dc_sc)
-    k_aff = rv._affine_kernel(k_dev)
-    k_aff.block_until_ready()
-    t_k = time.perf_counter() - t0
+    ok = v._combined_finalize(fixed_acc, [part])
+    t_final = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    rgp_bytes = rv.affine_batch_to_bytes(np.asarray(rgp_aff)[:len(live)])
-    k_bytes = rv.affine_batch_to_bytes(np.asarray(k_aff)[:len(live)])
-    equations = {}
-    for row, i in enumerate(live):
-        rgp_hex = [bytes(rgp_bytes[row, j]).hex().encode("ascii")
-                   for j in range(n)]
-        k_hex = bytes(k_bytes[row]).hex().encode("ascii")
-        equations[i] = rv._host_phase_b(proofs[i], transcripts[i], rgp_hex,
-                                        k_hex, params)
-    t_host_b = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    ok = v._verify_combined(proofs, coms, live, equations)
-    t_combined = time.perf_counter() - t0
-
-    total = t_host_a + t_marshal + t_rgp + t_rgp_aff + t_k + t_host_b + \
-        t_combined
-    print(f"B={BATCH}  total={total:.3f}s  ({BATCH/total:.1f}/s)  ok={ok}")
-    for name, t in [("host_a", t_host_a), ("marshal", t_marshal),
-                    ("rgp_gather", t_rgp), ("rgp_affine", t_rgp_aff),
-                    ("k_pass+affine", t_k), ("host_b(+bytes)", t_host_b),
-                    ("combined_msm", t_combined)]:
-        print(f"  {name:>14}: {t:.3f}s  {100*t/total:.1f}%")
+    total = (t_dispatch + t_pass1 + t_transfer + t_xipa + t_round
+             + t_phase_b + t_comb_host + t_comb_dev + t_final)
+    bc = len(ch)
+    print(f"chunk={bc}  barriered total={total:.3f}s  ({bc/total:.1f}/s)  "
+          f"ok={ok}")
+    for name, t in [("phase_a+marshal+disp", t_dispatch),
+                    ("pass1 device", t_pass1),
+                    ("bytes transfer", t_transfer),
+                    ("x_ipa batch", t_xipa),
+                    ("round chall", t_round),
+                    ("phase_b", t_phase_b),
+                    ("comb host+disp", t_comb_host),
+                    ("comb device", t_comb_dev),
+                    ("finalize", t_final)]:
+        print(f"  {name:>20}: {t:.3f}s  {100*t/total:.1f}%")
 
 
 if __name__ == "__main__":

@@ -91,3 +91,41 @@ def test_vault_certification_storage():
     assert not v.certification_exists(ID("t", 0))
     v.store_certifications({ID("t", 0): b"c"})
     assert v.certification_exists(ID("t", 0))
+
+
+def test_request_bind_to(node):
+    """request.go:1069 BindTo: foreign sender/receiver identities bind to
+    the submitter's identity; locally-owned ones are skipped."""
+    from fabric_token_sdk_tpu.core.fabtoken.driver import OutputSpec
+    from fabric_token_sdk_tpu.token.request_builder import Request
+
+    bob = TokenNode("bob", new_signing_identity(), node.bus, node.cc)
+    sel = node.selector.select("alice", "USD", hex(30), "tx-bind")
+    bob_owner, bob_ai = bob.recipient_identity()
+    req = Request("tx-bind", node.driver)
+    req.transfer(
+        sel.tokens,
+        [OutputSpec(owner=bob_owner, token_type="USD", value=30,
+                    audit_info=bob_ai),
+         OutputSpec(owner=node.owner_wallet.recipient_identity()[0],
+                    token_type="USD", value=70,
+                    audit_info=node.owner_wallet.recipient_identity()[1])],
+        wallet=node.token_loader,
+        sender_audit_info=node.owner_wallet.audit_info_for,
+        receivers=["bob", "alice"])
+
+    calls = []
+
+    class Binder:
+        def bind(self, long_term, ephemeral):
+            calls.append((bytes(long_term), bytes(ephemeral)))
+
+    req.bind_to(Binder(), b"submitter-id", wallet_service=node.wallets)
+    bound = {eph for _, eph in calls}
+    # bob's receiver identity is foreign -> bound
+    assert bytes(bob_owner) in bound
+    # every bound pair targets the submitter identity
+    assert all(lt == b"submitter-id" for lt, _ in calls)
+    # alice's own sender identities are skipped
+    for sender in req.input_owner_ids():
+        assert bytes(sender) not in bound

@@ -216,3 +216,17 @@ def test_nft_issue_transfer_query(net):
 def test_nft_unknown_query(net):
     with pytest.raises(NoResults):
         NFTService(net["alice"]).query_by_key("model", "missing")
+
+
+def test_tokengen_utils_pp_print(tmp_path, capsys):
+    """The nested `utils pp print -i FILE` verb (cmd/tokengen/main.go:49 ->
+    cobra/pp/utils.go -> printpp/print.go) mirrors `pp print`."""
+    issuer_pem, _ = _write_identity(tmp_path, "issuer")
+    rc = main(["gen", "fabtoken", "--precision", "16",
+               "--issuer", str(issuer_pem), "--output", str(tmp_path)])
+    assert rc == 0
+    out = tmp_path / "fabtoken_pp.json"
+    rc = main(["utils", "pp", "print", "-i", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "fabtoken" in text and "16" in text
