@@ -41,6 +41,23 @@ class ZKVerifier:
             self._range = BatchRangeVerifier(pp)
             self._sigma = BatchSigmaVerifier(pp)
 
+    def prewarm(self, batch_sizes=(1,)) -> float:
+        """Compile the device kernels at pp-install time (tcc.go:90
+        availability semantics: a validator must answer its first invoke
+        at steady-state latency, not after minutes of first-compile).
+        Covers BOTH device backends — the batched range verifier and the
+        Σ-row kernel. Returns elapsed seconds; no-op without a device
+        backend."""
+        if self._range is None:
+            return 0.0
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._range.prewarm(batch_sizes=batch_sizes)
+        if self._sigma is not None:
+            self._sigma.prewarm(batch_sizes=batch_sizes)
+        return _time.perf_counter() - t0
+
     # ------------------------------------------------------------ transfer
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
                         outputs: list[G1]) -> None:

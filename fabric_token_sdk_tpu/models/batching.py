@@ -13,8 +13,11 @@ import numpy as np
 
 #: Batch-dimension buckets: every request size pads up to one of these.
 #: 2048 exists so the chip's post-fusion sweet spot doesn't pad to 4096
-#: (a 2048-proof block would otherwise pay double device work).
-B_BUCKETS = (16, 128, 1024, 2048, 4096)
+#: (a 2048-proof block would otherwise pay double device work); 256/512
+#: exist because the pipelined verifier's row CHUNKS (default 256) must
+#: land exactly on a bucket — padding a chunk to 1024 would quadruple
+#: pass-1 device work.
+B_BUCKETS = (16, 128, 256, 512, 1024, 2048, 4096)
 
 
 def bucket_rows(b: int) -> int:

@@ -37,6 +37,7 @@ verifier, preserving the reference's observable error ordering.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import os
 import secrets
@@ -174,12 +175,6 @@ def _k_pass_kernel(tables, k_idx, k_fixed_sc, dc_pts, dc_sc):
 def _rgp_gather_kernel(tables, rgp_idx, scalars):
     """Right-generator fold: gather H_i tables in-jit, then per-term mul."""
     return ec.fixed_base_gather(jnp.take(tables, rgp_idx, axis=0), scalars)
-
-
-@jax.jit
-def _k_var_add_kernel(k_fixed_pt, dc_pts, dc_sc):
-    """K = fused fixed-base part + x*D + C (fused-path tail)."""
-    return ec.add(k_fixed_pt, ec.msm_windowed(dc_pts, dc_sc))
 
 
 @jax.jit
@@ -376,12 +371,56 @@ class _ProofTranscript:
     k_fixed_packed: bytes | None = None
 
 
-def _host_phase_a(proof: rp.RangeProof, commitment, params) -> _ProofTranscript:
+def _phase_a_challenges_batch(proofs, commitments, ch):
+    """x, y, z challenges for every proof in `ch`, one vectorized assembly
+    (reference bulletproof.go:266-282: x = H(hex(T1)||hex(T2)),
+    y = H(hex(C)||hex(D)||hex(Com)), z = H(bytes32(y)))."""
+    L = len(ch)
+    sep = np.frombuffer(ser.SEPARATOR, dtype=np.uint8)
+    ptx = np.empty((L, 2, 64), dtype=np.uint8)
+    pty = np.empty((L, 3, 64), dtype=np.uint8)
+    for row, i in enumerate(ch):
+        d = proofs[i].data
+        ptx[row, 0] = np.frombuffer(ser.g1_to_bytes(d.T1), dtype=np.uint8)
+        ptx[row, 1] = np.frombuffer(ser.g1_to_bytes(d.T2), dtype=np.uint8)
+        pty[row, 0] = np.frombuffer(ser.g1_to_bytes(d.C), dtype=np.uint8)
+        pty[row, 1] = np.frombuffer(ser.g1_to_bytes(d.D), dtype=np.uint8)
+        pty[row, 2] = np.frombuffer(ser.g1_to_bytes(commitments[i]),
+                                    dtype=np.uint8)
+    hx = hex_ascii(ptx)
+    hy = hex_ascii(pty)
+    msgx = np.empty((L, 258), dtype=np.uint8)
+    msgx[:, :128] = hx[:, 0]
+    msgx[:, 128:130] = sep
+    msgx[:, 130:] = hx[:, 1]
+    msgy = np.empty((L, 388), dtype=np.uint8)
+    msgy[:, :128] = hy[:, 0]
+    msgy[:, 128:130] = sep
+    msgy[:, 130:258] = hy[:, 1]
+    msgy[:, 258:260] = sep
+    msgy[:, 260:] = hy[:, 2]
+    out = []
+    for r in range(L):
+        x = int.from_bytes(hashlib.sha256(msgx[r].data).digest(),
+                           "big") % R
+        y = int.from_bytes(hashlib.sha256(msgy[r].data).digest(),
+                           "big") % R
+        z = int.from_bytes(
+            hashlib.sha256(y.to_bytes(32, "big")).digest(), "big") % R
+        out.append((x, y, z))
+    return out
+
+
+def _host_phase_a(proof: rp.RangeProof, commitment, params,
+                  xyz=None) -> _ProofTranscript:
     """Challenges + K-equation scalars from literal proof bytes."""
     n = params.bit_length
     d = proof.data
-    x = rp.challenge_x(d.T1, d.T2)
-    y, z = rp.challenges_y_z(d.C, d.D, commitment)
+    if xyz is not None:
+        x, y, z = xyz
+    else:
+        x = rp.challenge_x(d.T1, d.T2)
+        y, z = rp.challenges_y_z(d.C, d.D, commitment)
 
     if _FRNATIVE is not None:
         # fused native assembly (frmont.c phase_a, parity-pinned)
@@ -479,6 +518,183 @@ def _xipa_layout(params):
     ip_idx = np.arange(len(tmpl) - 32, len(tmpl))
     _XIPA_LAYOUTS[key] = (tmpl, rgp_idx, k_idx, ip_idx)
     return _XIPA_LAYOUTS[key]
+
+
+def _hex_ascii_dev(a: jnp.ndarray) -> jnp.ndarray:
+    """Device twin of hex_ascii: (..., K) u8 -> (..., 2K) u8 ascii."""
+    lut = jnp.asarray(_HEX_LUT)
+    hi = jnp.take(lut, (a >> 4).astype(jnp.int32))
+    lo = jnp.take(lut, (a & 0xF).astype(jnp.int32))
+    return jnp.stack([hi, lo], axis=-1).reshape(*a.shape[:-1],
+                                               2 * a.shape[-1])
+
+
+_XIPA_DEV_FNS: dict = {}
+
+
+_POW2_MONT: dict = {}
+
+
+def _pow2_mont_limbs(n: int) -> np.ndarray:
+    """(n, 16) uint32: 2^i in Fr Montgomery form (device constants for the
+    on-device K-coefficient derivation)."""
+    if n not in _POW2_MONT:
+        _POW2_MONT[n] = np.stack([
+            limbs.int_to_limbs((pow(2, i, R) * limbs.MONT_R) % R)
+            for i in range(n)])
+    return _POW2_MONT[n]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _derive_pass1_scalars(sc4, n: int):
+    """Expand per-proof (y^-1, z, delta, x) into the pass-1 scalar arrays
+    ON DEVICE: 4 uploaded scalars replace n + (n+2) + 2 of them (the
+    measured round-5 wall is host->device transfer on the tunneled chip).
+
+    sc4: (B, 4, 16) PLAIN limbs. Returns (yinv_pows (B, n, 16),
+    k_fixed (B, n+2, 16), k_var (B, 2, 16)) plain limbs, exactly the
+    vectors _host_phase_a produces (k_fixed[i] = z + z^2 2^i y^-i;
+    P -> -delta; S_G -> -z; k_var = [x, 1]).
+    """
+    from ..ops import field
+
+    FR = field.FR
+    B = sc4.shape[0]
+    yinv_m = field.to_mont(sc4[:, 0], FR)
+    z_m = field.to_mont(sc4[:, 1], FR)
+    delta_m = field.to_mont(sc4[:, 2], FR)
+
+    one_m = jnp.broadcast_to(FR.r1_arr, (B, limbs.NLIMBS))
+
+    def step(carry, _):
+        return field.mont_mul(carry, yinv_m, FR), carry
+
+    _, pows_m = jax.lax.scan(step, one_m, None, length=n)
+    pows_m = jnp.moveaxis(pows_m, 0, 1)            # (B, n, 16) y^-i mont
+    z_sq = field.mont_mul(z_m, z_m, FR)
+    two_i = jnp.asarray(_pow2_mont_limbs(n))       # (n, 16) mont
+    term = field.mont_mul(
+        field.mont_mul(z_sq[:, None], two_i[None], FR), pows_m, FR)
+    kf = field.add(jnp.broadcast_to(z_m[:, None], term.shape), term, FR)
+    k_fixed_m = jnp.concatenate(
+        [kf, field.neg(delta_m, FR)[:, None], field.neg(z_m, FR)[:, None]],
+        axis=1)
+    one_plain = jnp.zeros((B, 1, limbs.NLIMBS),
+                          dtype=jnp.uint32).at[..., 0].set(1)
+    k_var = jnp.concatenate([sc4[:, 3][:, None], one_plain], axis=1)
+    return (field.from_mont(pows_m, FR), field.from_mont(k_fixed_m, FR),
+            k_var)
+
+
+_PASS1_FUSED_FNS: dict = {}
+
+
+def _pass1_fused_fn(params):
+    """ONE jitted device program for a whole chunk's pass-1 (TPU path):
+    unpack the single uploaded u32 row -> derive scalar vectors -> Pallas
+    fixed-base folds -> affine bytes -> transcript SHA. Collapses ~12
+    dispatches + 4 uploads per chunk into 1 + 1 — per-call tunnel latency
+    (measured ~2.5 ms/dispatch, ~6.5 ms/device_put) was the next wall.
+
+    Packed row layout (u32): [sc4 64 | xy-as-u16-pairs nv*2*8 | inf nv |
+    ip 8]. Returns ((B, 8) digests, (B, nv, 3, 16) projective points).
+    """
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes)
+    if key in _PASS1_FUSED_FNS:
+        return _PASS1_FUSED_FNS[key]
+    from ..ops import pallas_fb
+
+    n = params.bit_length
+    nv = 2 + 2 * params.rounds + 3
+    xipa = _xipa_device_fn(params)
+    o_xy = 64
+    o_inf = o_xy + nv * 16
+    o_ip = o_inf + nv
+
+    @jax.jit
+    def run(tables_t_rgp, tables_t_k, packed):
+        B = packed.shape[0]
+        sc4 = packed[:, :o_xy].reshape(B, 4, limbs.NLIMBS)
+        xyw = packed[:, o_xy:o_inf].reshape(B, nv, 2, 8)
+        xy = jnp.stack([xyw & 0xFFFF, xyw >> 16], axis=-1).reshape(
+            B, nv, 2, limbs.NLIMBS)
+        inf = packed[:, o_inf:o_ip].astype(jnp.uint8)
+        ipw = packed[:, o_ip:o_ip + 8]
+        ip_u8 = jnp.stack(
+            [ipw & 0xFF, (ipw >> 8) & 0xFF, (ipw >> 16) & 0xFF,
+             ipw >> 24], axis=-1).reshape(B, 32).astype(jnp.uint8)
+
+        yinv, k_fixed, dc_sc = _derive_pass1_scalars(sc4, n)
+        pts = _reconstruct_points(xy, inf)
+        rgp_pts = pallas_fb.fixed_base_gather_fused(tables_t_rgp, yinv)
+        k_pt = ec.add(
+            pallas_fb.fixed_base_msm_fused(tables_t_k, k_fixed),
+            ec.msm_windowed(pts[:, :2], dc_sc))
+        digests = xipa(_limbs_to_bytes_dev(ec.to_affine_batch(rgp_pts)),
+                       _limbs_to_bytes_dev(ec.to_affine(k_pt)), ip_u8)
+        return digests, pts
+
+    _PASS1_FUSED_FNS[key] = (run, nv, o_inf, o_ip)
+    return _PASS1_FUSED_FNS[key]
+
+
+@jax.jit
+def _reconstruct_points(xy, inf_mask):
+    """(B, T, 2, 16) affine Montgomery limbs + (B, T) u8 identity mask ->
+    (B, T, 3, 16) projective (identity = (0 : 1 : 0))."""
+    B, T = xy.shape[0], xy.shape[1]
+    r1 = jnp.asarray(np.array(limbs.int_to_limbs(limbs.P_R1_INT),
+                              dtype=np.uint32))
+    zed = jnp.where((inf_mask == 0)[..., None],
+                    jnp.broadcast_to(r1, (B, T, limbs.NLIMBS)),
+                    jnp.zeros((B, T, limbs.NLIMBS), dtype=jnp.uint32))
+    return jnp.concatenate([xy, zed[:, :, None]], axis=2)
+
+
+def _xipa_device_fn(params):
+    """Jitted on-device x_ipa transcript assembly + SHA-256.
+
+    (rgp_bytes (B, n, 64) u8, k_bytes (B, 64) u8, ip (B, 32) u8)
+    -> (B, 8) u32 digest words. The transcript is built by concatenating
+    constant template segments (from _xipa_layout) with device-hexed
+    pass-1 bytes, then hashed by the batched SHA-256 kernel — so only 32
+    digest bytes per proof ever cross the host link (the measured
+    transfer wall on the tunneled chip).
+    """
+    from ..ops import sha256 as dsha
+
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes)
+    if key in _XIPA_DEV_FNS:
+        return _XIPA_DEV_FNS[key]
+    n = params.bit_length
+    tmpl, rgp_idx, k_idx, ip_idx = _xipa_layout(params)
+    L = len(tmpl)
+    start = int(rgp_idx[0])
+    rgp_end = start + n * 130          # n x (128 hex + 2 sep)
+    k_start, k_end = int(k_idx[0]), int(k_idx[0]) + 128
+    ip_start = int(ip_idx[0])
+    assert ip_start + 32 == L
+    prefix = tmpl[:start]
+    mid = tmpl[rgp_end:k_start]
+    tail1 = tmpl[k_end:ip_start]
+    shapad = dsha.pad_tail(L)
+    sep2 = np.frombuffer(ser.SEPARATOR, dtype=np.uint8)
+
+    @jax.jit
+    def run(rgp_bytes, k_bytes, ip_bytes):
+        B = rgp_bytes.shape[0]
+        hx = _hex_ascii_dev(rgp_bytes)                   # (B, n, 128)
+        sep_b = jnp.broadcast_to(jnp.asarray(sep2), (B, n, 2))
+        rgp_seg = jnp.concatenate([hx, sep_b], axis=2).reshape(B, n * 130)
+        const = lambda seg: jnp.broadcast_to(jnp.asarray(seg),
+                                             (B, len(seg)))
+        msg = jnp.concatenate(
+            [const(prefix), rgp_seg, const(mid), _hex_ascii_dev(k_bytes),
+             const(tail1), ip_bytes, const(shapad)], axis=1)
+        return dsha.digest_padded(msg)
+
+    _XIPA_DEV_FNS[key] = run
+    return run
 
 
 def _xipa_batch(params, proofs, live, rgp_u8: np.ndarray,
@@ -614,18 +830,28 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     return _ProofEquations(fixed=fixed, var=var)
 
 
-def _make_sharded_combined(mesh):
+def _make_sharded_combined(mesh, fused: bool = False):
     """Sharded RLC pass: var-MSM terms sharded over EVERY mesh device;
     each device runs the windowed MSM on its term shard, partial points
     are all-gathered (96 uint32/device riding ICI) and folded locally —
     point addition is not a psum-able ring op, so gather+fold is the
-    TPU-native collective for it (SURVEY.md §2.5)."""
+    TPU-native collective for it (SURVEY.md §2.5).
+
+    With fused=True (TPU mesh) each device's term shard runs the Pallas
+    VMEM-resident var-MSM kernel instead of the XLA one-hot walk — the
+    sharded path no longer shards the slow kernels (VERDICT r4 ask #2).
+    """
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)
 
     def body(fixed_pt, pts, sc):
-        partial = ec.msm_windowed(pts, sc)            # local term shard
+        if fused:
+            from ..ops import pallas_fb
+
+            partial = pallas_fb.msm_var_fused(pts, sc)  # local term shard
+        else:
+            partial = ec.msm_windowed(pts, sc)
         gathered = jax.lax.all_gather(partial, axes)  # (ndev, 3, 16)
         total = ec._tree_sum_shrink(gathered)
         return ec.is_identity(ec.add(fixed_pt, total))
@@ -645,6 +871,38 @@ def _make_sharded_combined(mesh):
     return run
 
 
+def _make_sharded_pass1(mesh, params):
+    """Row-sharded fused pass-1: every device runs the Pallas select+fold
+    kernels on its row shard, converts to canonical bytes, and hashes its
+    x_ipa transcripts locally (device SHA-256); pure data-parallel, no
+    communication (VERDICT r4 ask #2 — the multi-chip path rides the SAME
+    fused kernels as single-chip). Output: (B, 8) digest words."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import pallas_fb
+
+    axes = tuple(mesh.axis_names)
+    xipa = _xipa_device_fn(params)
+
+    def body(t_rgp, t_k, yinv, k_fixed, dc_pts, dc_sc, ip_bytes):
+        rgp = pallas_fb.fixed_base_gather_fused(t_rgp, yinv)
+        k = ec.add(pallas_fb.fixed_base_msm_fused(t_k, k_fixed),
+                   ec.msm_windowed(dc_pts, dc_sc))
+        return xipa(_limbs_to_bytes_dev(ec.to_affine_batch(rgp)),
+                    _limbs_to_bytes_dev(ec.to_affine(k)), ip_bytes)
+
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(),
+                  P(axes, None, None), P(axes, None, None),
+                  P(axes, None, None, None), P(axes, None, None),
+                  P(axes, None)),
+        out_specs=P(axes, None),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 class BatchRangeVerifier:
     """Vectorized range-proof verification for one public-parameter set.
 
@@ -658,8 +916,15 @@ class BatchRangeVerifier:
         self.params = _params_for(pp)
         self.mesh = mesh
         self._n_shard = int(mesh.devices.size) if mesh is not None else 1
-        self._combined_sharded = (_make_sharded_combined(mesh)
-                                  if mesh is not None else None)
+        # fused Pallas kernels under the mesh (TPU); the CPU-mesh dryrun
+        # keeps the XLA path via _pallas_enabled() -> tables_t_rgp is None
+        self._fused_sharded = (mesh is not None
+                               and self.params.tables_t_rgp is not None)
+        self._pass1_sharded = (_make_sharded_pass1(mesh, self.params)
+                               if self._fused_sharded else None)
+        self._combined_sharded = (
+            _make_sharded_combined(mesh, fused=self._fused_sharded)
+            if mesh is not None else None)
         #: which pass-2 strategy the last verify() used ("combined",
         #: "exact", or "structure-only"); exposed for tests/metrics.
         self.last_path: str | None = None
@@ -735,114 +1000,189 @@ class BatchRangeVerifier:
                   for ch in chunks]
 
         # ---- stage 2: per chunk, sync bytes -> challenges -> equations;
-        # combined partial dispatched immediately (device keeps working)
+        # combined partial dispatched immediately (device keeps working).
+        # Each chunk keeps its OWN fixed accumulator so a rejecting batch
+        # can be bisected per chunk (adversarial floor: one bad proof
+        # costs an exact pass over its chunk, not the whole batch).
         n_fixed = 2 * params.bit_length + 5
+        zero_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
+                    else None)
         equations: dict[int, _ProofEquations] = {}
-        fixed_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
-                     else [0] * n_fixed)
-        partials: list = []
+        chunk_rlc: list = []    # (rows, fixed_acc_chunk, partial)
         for ch, st in zip(chunks, stage1):
             eqs_ch = self._host_stage2(proofs, ch, st)
             equations.update(eqs_ch)
             if not exact and self.mesh is None:
-                fixed_acc, part = self._combined_chunk(
-                    proofs, commitments, ch, eqs_ch, fixed_acc)
-                partials.append(part)
+                acc = zero_acc if zero_acc is not None else [0] * n_fixed
+                acc, part = self._combined_chunk(
+                    proofs, commitments, ch, eqs_ch, acc, st[2])
+                chunk_rlc.append((ch, acc, part))
 
         # ---- pass 2
+        bad_rows = live
         if not exact:
             if self.mesh is not None:
                 ok = self._verify_combined(proofs, commitments, live,
                                            equations)
             else:
-                ok = self._combined_finalize(fixed_acc, partials)
+                total = self._sum_fixed_accs([a for _, a, _ in chunk_rlc])
+                ok = self._combined_finalize(
+                    total, [p for _, _, p in chunk_rlc])
             if ok:
                 self.last_path = "combined"
                 return ok_structure
-        accepts_live = self._verify_exact(proofs, commitments, live,
-                                          equations)
+            if self.mesh is None and len(chunk_rlc) > 1:
+                # bisect: re-check each chunk's RLC; exact only where it
+                # fails (a passing chunk RLC carries the same soundness
+                # as the whole-batch one: fresh per-proof weights)
+                bad_rows = []
+                for ch, acc, part in chunk_rlc:
+                    if not self._combined_finalize(acc, [part]):
+                        bad_rows.extend(ch)
+                if not bad_rows:    # unreachable, kept for safety
+                    bad_rows = live
+        accepts_bad = self._verify_exact(proofs, commitments, bad_rows,
+                                         equations)
         self.last_path = "exact"
-        out = np.zeros(B, dtype=bool)
-        for row, i in enumerate(live):
-            out[i] = bool(accepts_live[row])
+        out = ok_structure.copy()
+        bad_set = {i: row for row, i in enumerate(bad_rows)}
+        for i in live:
+            if i in bad_set:
+                out[i] = bool(accepts_bad[bad_set[i]])
         return out
+
+    def _sum_fixed_accs(self, accs):
+        """Fold per-chunk fixed-scalar accumulators into one vector."""
+        if _FRNATIVE is not None:
+            ones = (1).to_bytes(32, "little") * (len(accs[0]) // 32)
+            total = accs[0]
+            for a in accs[1:]:
+                total = _FRNATIVE.addmul_many(total, a, ones)
+            return total
+        total = list(accs[0])
+        for a in accs[1:]:
+            for j, v in enumerate(a):
+                total[j] = fr_add(total[j], v)
+        return total
 
     # ------------------------------------------------------------------
     def _dispatch_pass1(self, proofs, commitments, ch):
         """Host phase-a + marshal for one chunk, then async dispatch of the
-        pass-1 kernels; returns (transcripts, rgp_bytes_dev, k_bytes_dev)
-        with device->host copies already in flight."""
+        pass-1 kernels; returns (transcripts, digests_dev (B, 8) x_ipa
+        digest words, pts_proj (B, nv, 3, 16) device-resident proof
+        points) with the digest device->host copy already in flight."""
         params = self.params
         n = params.bit_length
-        transcripts = {i: _host_phase_a(proofs[i], commitments[i], params)
-                       for i in ch}
+        xyz = _phase_a_challenges_batch(proofs, commitments, ch)
+        transcripts = {i: _host_phase_a(proofs[i], commitments[i], params,
+                                        xyz=xyz[row])
+                       for row, i in enumerate(ch)}
         b_bucket = _bucket_rows(len(ch))
         if self._n_shard > 1:
             # batch rows must divide evenly over the mesh
             b_bucket = max(b_bucket, self._n_shard)
             b_bucket += (-b_bucket) % self._n_shard
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
-        id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
 
-        if _FRNATIVE is not None:
-            yinv_np = limbs.packed_to_limbs(
-                b"".join(transcripts[i].yinv_packed for i in ch)
-            ).reshape(len(ch), n, limbs.NLIMBS)
-            k_fixed_np = limbs.packed_to_limbs(
-                b"".join(transcripts[i].k_fixed_packed for i in ch)
-            ).reshape(len(ch), n + 2, limbs.NLIMBS)
-        else:
-            yinv_np = np.stack(
-                [limbs.scalars_to_limbs(transcripts[i].yinv_pows)
-                 for i in ch])
-            k_fixed_np = np.stack(
-                [limbs.scalars_to_limbs(transcripts[i].k_fixed_scalars)
-                 for i in ch])
-        yinv = self._put_rows(_pad_rows(yinv_np, b_bucket, zero_sc))
-        k_fixed = self._put_rows(_pad_rows(k_fixed_np, b_bucket, zero_sc))
-        dc_pts_np = np.stack(
-            [limbs.points_to_projective_limbs(
-                [proofs[i].data.D, proofs[i].data.C]) for i in ch])
-        dc_pts = self._put_rows(_pad_rows(dc_pts_np, b_bucket, id_pt))
-        dc_sc_np = np.stack(
-            [limbs.scalars_to_limbs(transcripts[i].k_var_scalars)
-             for i in ch])
-        dc_sc = self._put_rows(_pad_rows(dc_sc_np, b_bucket, zero_sc))
+        # 4 scalars per proof (y^-1, z, delta, x): the device derives the
+        # n + (n+2) + 2 pass-1 vectors itself (_derive_pass1_scalars) —
+        # host->device bytes drop ~85% (the tunnel's upload side is a
+        # measured wall)
+        def sc4_bytes(i):
+            ts = transcripts[i]
+            yinv1 = (ts.yinv_packed[32:64]
+                     if ts.yinv_packed is not None
+                     else (ts.yinv_pows[1] % R).to_bytes(32, "little"))
+            return (yinv1 + (ts.z % R).to_bytes(32, "little")
+                    + (proofs[i].data.delta % R).to_bytes(32, "little")
+                    + (ts.x % R).to_bytes(32, "little"))
+
+        sc4_np = limbs.packed_to_limbs(
+            b"".join(sc4_bytes(i) for i in ch)
+        ).reshape(len(ch), 4, limbs.NLIMBS)
+
+        # every proof's points, marshalled ONCE as affine + identity mask
+        # (stage 1), reused by the var-MSM partial in stage 2:
+        # [D, C, L_r.., R_r.., T1, T2, Com] — the _weight_equations order.
+        nv = 2 + 2 * params.rounds + 3
+        allpts = []
+        for i in ch:
+            d = proofs[i].data
+            allpts += ([d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R
+                       + [d.T1, d.T2, commitments[i]])
+        proj = limbs.points_to_projective_limbs(allpts).reshape(
+            len(ch), nv, 3, limbs.NLIMBS)
+        inf_np = (proj[:, :, 2] == 0).all(-1).astype(np.uint32)
+        # x_ipa transcript tail: the per-proof inner-product bytes (the
+        # only literal proof bytes in that hash); padded rows hash garbage
+        # that is never read back.
+        ip_np = np.frombuffer(
+            b"".join(ser.zr_to_bytes(proofs[i].data.inner_product)
+                     for i in ch), dtype=np.uint8).reshape(len(ch), 32)
 
         if params.tables_t_rgp is not None and self.mesh is None:
-            # fused Pallas pass-1: select+fold in VMEM (no one-hot in HBM)
-            from ..ops import pallas_fb
-
-            rgp_pts = pallas_fb.fixed_base_gather_fused(
-                params.tables_t_rgp, yinv)
-            k_pt = _k_var_add_kernel(
-                pallas_fb.fixed_base_msm_fused(params.tables_t_k, k_fixed),
-                dc_pts, dc_sc)
+            # TPU fast path: ONE packed upload + ONE fused device program
+            # per chunk (per-call tunnel latency is a measured cost)
+            run, nv_, o_inf, o_ip = _pass1_fused_fn(params)
+            packed = np.zeros((len(ch), o_ip + 8), dtype=np.uint32)
+            packed[:, :64] = sc4_np.reshape(len(ch), 64)
+            xyu16 = proj[:, :, :2].astype("<u2")          # (L, nv, 2, 16)
+            packed[:, 64:o_inf] = np.ascontiguousarray(
+                xyu16.reshape(len(ch), -1)).view("<u4")
+            packed[:, o_inf:o_ip] = inf_np
+            packed[:, o_ip:] = np.ascontiguousarray(ip_np).view("<u4")
+            pad_row = np.zeros(o_ip + 8, dtype=np.uint32)
+            pad_row[o_inf:o_ip] = 1                        # identity points
+            digests_dev, pts_proj = run(
+                params.tables_t_rgp, params.tables_t_k,
+                jnp.asarray(_pad_rows(packed, b_bucket, pad_row)))
         else:
-            rgp_pts = _rgp_gather_kernel(params.tables, params.rgp_idx, yinv)
-            k_pt = _k_pass_kernel(params.tables, params.k_idx, k_fixed,
-                                  dc_pts, dc_sc)
-        rgp_bytes_dev = _affine_bytes_rows_kernel(rgp_pts)
-        k_bytes_dev = _affine_bytes_kernel(k_pt)
-        for arr in (rgp_bytes_dev, k_bytes_dev):
-            try:
-                arr.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
-        return transcripts, rgp_bytes_dev, k_bytes_dev
+            zero_sc2 = zero_sc
+            sc4 = self._put_rows(_pad_rows(sc4_np, b_bucket, zero_sc2))
+            xy = self._put_rows(_pad_rows(
+                proj[:, :, :2], b_bucket,
+                np.zeros((nv, 2, limbs.NLIMBS), dtype=np.uint32)))
+            inf = self._put_rows(_pad_rows(
+                inf_np.astype(np.uint8), b_bucket,
+                np.ones(nv, dtype=np.uint8)))
+            ip_dev = self._put_rows(_pad_rows(
+                ip_np, b_bucket, np.zeros(32, dtype=np.uint8)))
+            yinv, k_fixed, dc_sc = _derive_pass1_scalars(sc4, n)
+            pts_proj = _reconstruct_points(xy, inf)      # (B, nv, 3, 16)
+            dc_pts = pts_proj[:, :2]
+
+            if self._pass1_sharded is not None:
+                # fused Pallas kernels per device under the mesh
+                digests_dev = self._pass1_sharded(
+                    params.tables_t_rgp, params.tables_t_k, yinv, k_fixed,
+                    dc_pts, dc_sc, ip_dev)
+            else:
+                rgp_pts = _rgp_gather_kernel(params.tables, params.rgp_idx,
+                                             yinv)
+                k_pt = _k_pass_kernel(params.tables, params.k_idx, k_fixed,
+                                      dc_pts, dc_sc)
+                digests_dev = _xipa_device_fn(params)(
+                    _affine_bytes_rows_kernel(rgp_pts),
+                    _affine_bytes_kernel(k_pt), ip_dev)
+        try:
+            digests_dev.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass
+        return transcripts, digests_dev, pts_proj
 
     def _host_stage2(self, proofs, ch, st) -> dict:
         """Challenges (vectorized) + per-proof scalar expansion for one
         chunk. Blocks on that chunk's pass-1 bytes only."""
+        from ..ops import sha256 as dsha
+
         params = self.params
         rr = params.rounds
-        transcripts, rgp_dev, k_dev = st
+        transcripts, digests_dev, _pts = st
         # round challenges depend only on proof bytes: hash them BEFORE
         # blocking on the device transfer so they hide under it
         rch = _round_challenges_batch(proofs, ch, rr)
-        rgp_u8 = np.asarray(rgp_dev)[:len(ch)]
-        k_u8 = np.asarray(k_dev)[:len(ch)]
-        x_ipa = _xipa_batch(params, proofs, ch, rgp_u8, k_u8)
+        words = np.asarray(digests_dev)[:len(ch)]
+        x_ipa = [v % R for v in dsha.digest_words_to_ints(words)]
         ch_packed_all = inv_packed_all = None
         if _FRNATIVE is not None:
             ch_packed_all = limbs.pack_scalars(
@@ -916,28 +1256,26 @@ class BatchRangeVerifier:
         return fixed_acc, var_pts, var_scalar_limbs
 
     def _combined_chunk(self, proofs, commitments, ch, equations,
-                        fixed_acc):
+                        fixed_acc, pts_dev):
         """Weight one chunk's equations into the running RLC and dispatch
-        the chunk's var-MSM partial on device. Returns (fixed_acc,
-        partial_device_point)."""
+        the chunk's var-MSM partial on device. The var POINTS are the
+        stage-1 device upload (pts_dev (b_bucket, 17, 3, 16), identity on
+        padded rows) — only the weighted scalars go up here. Returns
+        (fixed_acc, partial_device_point)."""
         params = self.params
         fixed_acc, var_pts, var_scalar_limbs = self._weight_equations(
             proofs, commitments, ch, equations, fixed_acc)
 
-        v = len(var_pts)
-        p = _next_pow2(max(128, v))
-        v_target = (3 * p // 4) if v <= 3 * p // 4 else p
-        pts_np = limbs.points_to_projective_limbs(
-            var_pts + [bn254.G1_IDENTITY] * (v_target - v))
-        sc_np = var_scalar_limbs(v_target - v)
+        b_bucket, nv = pts_dev.shape[0], pts_dev.shape[1]
+        n_pad = b_bucket * nv - len(var_pts)
+        sc = jnp.asarray(var_scalar_limbs(n_pad))
+        flat_pts = pts_dev.reshape(b_bucket * nv, 3, limbs.NLIMBS)
         if params.tables_t_rgp is not None:
             from ..ops import pallas_fb
 
-            part = pallas_fb.msm_var_fused(jnp.asarray(pts_np),
-                                           jnp.asarray(sc_np))
+            part = pallas_fb.msm_var_fused(flat_pts, sc)
         else:
-            part = _var_partial_kernel(jnp.asarray(pts_np),
-                                       jnp.asarray(sc_np))
+            part = _var_partial_kernel(flat_pts, sc)
         return fixed_acc, part
 
     def _combined_finalize(self, fixed_acc, partials) -> bool:

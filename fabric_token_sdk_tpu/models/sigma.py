@@ -73,6 +73,14 @@ class BatchSigmaVerifier:
             list(pp.pedersen_generators[:3]))
         self.tables = _sigma_tables_kernel(jnp.asarray(gens))
 
+    def prewarm(self, batch_sizes=(1,)) -> None:
+        """Compile _sigma_rows_kernel for the row buckets covering
+        `batch_sizes` (pp-install availability, tcc.go:90 semantics)."""
+        g = bn254.G1_GENERATOR
+        for b in batch_sizes:
+            self._run_rows([_Row(fixed=(1, 1, 1), var_point=g,
+                                 var_scalar=1)] * b)
+
     # ------------------------------------------------------------ device
     def _run_rows(self, rows: list[_Row]) -> np.ndarray:
         """(R, 64)-byte affine encodings for every row, device-computed."""

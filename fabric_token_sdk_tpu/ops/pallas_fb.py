@@ -172,22 +172,104 @@ def fixed_base_gather_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
         fb_fold_t(planes_t, dt, interpret=interpret, lane_block=lb))[:B]
 
 
+def _fb_msm_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
+                   wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+    """One (lane-block, term) grid step of the ACCUMULATED fixed-base MSM.
+
+    Same per-term select+fold as _fb_fold_kernel, but the grid's term axis
+    is innermost and every term accumulates into the SAME output block —
+    out_ref stays VMEM-resident across the consecutive revisits (Mosaic
+    reduction pattern), so the T-axis fold never materializes a
+    (B, T, 3, 16) intermediate nor runs XLA-layout point adds.
+    """
+    from jax.experimental import pallas as pl
+
+    cc = tec.CurveConsts(
+        ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
+                    r1=r1_ref[...], w_nprime=wnp_ref[...],
+                    w_mod=wmod_ref[...], mod_int=0),
+        b3=b3_ref[...])
+    bB = digits_ref.shape[-1]
+    dt = planes_ref.dtype
+
+    def body(w, acc):
+        d = digits_ref[0, w, :]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (256, bB), 0)
+        onehot = (iota == d[None, :]).astype(jnp.int32).astype(dt)
+        sel = jax.lax.dot_general(
+            planes_ref[0, w], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        u = sel.astype(jnp.int32).astype(jnp.uint32)
+        pt = u[0:48, :] + (u[48:96, :] << 8)
+        return tec.add(acc, pt, cc)
+
+    folded = jax.lax.fori_loop(0, windows, body, tec.identity(bB, cc),
+                               unroll=False)
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[0] = folded
+
+    @pl.when(t > 0)
+    def _acc():
+        out_ref[0] = tec.add(out_ref[0], folded, cc)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "lane_block"))
+def fb_msm_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
+             interpret: bool = False,
+             lane_block: int = LANE_BLOCK) -> jnp.ndarray:
+    """Accumulated fixed-base MSM, transposed interface.
+
+    planes_t: (T, W, 96, 256); digits_t: (T, W, B) -> (48, B) uint32:
+    per-lane sum over every term of table[t][digit]. The term axis rides
+    the INNER grid dim so each lane-block's accumulator stays in VMEM.
+    """
+    from jax.experimental import pallas as pl
+
+    T, W, _, _ = planes_t.shape
+    B = digits_t.shape[-1]
+    assert B % lane_block == 0, (B, lane_block)
+    cc = tec.make_consts()
+    consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
+              cc.ts.w_mod, cc.b3)
+    const_specs = [
+        pl.BlockSpec(c.shape, lambda b, t, *, _nd=c.ndim: (0,) * _nd)
+        for c in consts
+    ]
+    kernel = functools.partial(_fb_msm_kernel, windows=W)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B // lane_block, T),
+        in_specs=[
+            pl.BlockSpec((1, W, 96, 256), lambda b, t: (t, 0, 0, 0)),
+            pl.BlockSpec((1, W, lane_block), lambda b, t: (t, 0, b)),
+            *const_specs,
+        ],
+        out_specs=pl.BlockSpec((1, 48, lane_block), lambda b, t: (0, 0, b)),
+        out_shape=jax.ShapeDtypeStruct((1, 48, B), jnp.uint32),
+        interpret=interpret,
+    )(planes_t, digits_t, *consts)
+    return out[0]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fixed_base_msm_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
                          interpret: bool = False) -> jnp.ndarray:
-    """Fixed-base MSM (ec.fixed_base_msm semantics) via the fused fold.
+    """Fixed-base MSM (ec.fixed_base_msm semantics) via the fused
+    accumulated fold: per-term select+fold AND the term-axis sum run in
+    one pallas kernel (no XLA tree, no (B, T, 3, 16) intermediate).
 
     planes_t: (T, 32, 96, 256); scalars: (..., T, 16) -> (..., 3, 16).
-    The per-term folds run in the kernel; the T-axis fold is a small XLA
-    tree (T*192 bytes per lane — negligible traffic).
     """
-    from . import ec
-
     batch = scalars.shape[:-2]
     flat = scalars.reshape((-1,) + scalars.shape[-2:])
-    per_term = fixed_base_gather_fused(planes_t, flat, interpret=interpret)
-    folded = ec._tree_sum_shrink(per_term)    # (Bflat, 3, 16)
-    return folded.reshape(batch + (3, N))
+    lb = _lane_block_for(flat.shape[0])
+    dt, B = _pad_lanes(_digits_t(flat), lb)
+    folded = fb_msm_t(planes_t, dt, interpret=interpret, lane_block=lb)
+    out = jnp.transpose(folded, (1, 0)).reshape(-1, 3, N)[:B]
+    return out.reshape(batch + (3, N))
 
 
 # --------------------------------------------------------------------------

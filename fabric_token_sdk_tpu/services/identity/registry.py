@@ -161,6 +161,18 @@ class WalletRegistry:
             return False
         return wallet_id is None or label == wallet_id
 
+    def owning_wallet(self, identity: bytes):
+        """The registered wallet owning `identity` (long-term identity or
+        bound pseudonym), else None — one scan, no private access for
+        callers."""
+        ident = bytes(identity)
+        m = self.role.membership
+        label = m.get_identifier(ident)
+        if label is None:
+            entry = self._bindings.get(ident)
+            label = entry[1] if entry is not None else None
+        return m.wallet(label) if label is not None else None
+
 
 class WalletService:
     """wallet/service.go: the per-TMS wallet manager — one registry per
@@ -197,17 +209,10 @@ class WalletService:
         `identity` across every role (long-term identities and bound
         pseudonyms alike), else None. request.go:1069 BindTo uses this
         to recognize — and skip — locally-owned identities."""
-        ident = bytes(identity)
         for r in RoleType.ALL:
-            reg = self.registries[r]
-            if reg.contains_identity(ident):
-                m = reg.role.membership
-                label = m.get_identifier(ident)
-                if label is None:
-                    label = reg._bindings[ident][1]
-                w = m.wallet(label)
-                if w is not None:
-                    return w
+            w = self.registries[r].owning_wallet(identity)
+            if w is not None:
+                return w
         return None
 
     # -------------------------------------------------------- registration

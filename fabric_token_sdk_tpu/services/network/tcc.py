@@ -109,6 +109,24 @@ class TokenChaincode:
         tr = Translator(tx_id="genesis", rws=rws)
         tr.commit_setup(pp_raw)
         ledger.commit("genesis", rws)
+        # pp-install prewarm (tcc.go:90 availability): compile the device
+        # verification kernels NOW so the first invoke answers at
+        # steady-state latency. Opt-in (FTS_PREWARM=1, or comma-separated
+        # batch buckets e.g. FTS_PREWARM=1,256): test topologies build
+        # many chaincodes and must not pay a compile per node.
+        import os
+
+        spec = os.environ.get("FTS_PREWARM")
+        zk = getattr(validator, "zk_verifier", None) or getattr(
+            getattr(validator, "pp", None), "zk_verifier", None)
+        if spec and zk is not None and hasattr(zk, "prewarm"):
+            # numeric tokens select buckets; any boolean-ish value
+            # (FTS_PREWARM=1 / true / yes) means the default bucket
+            sizes = tuple(int(s) for s in spec.split(",")
+                          if s.strip().isdigit())
+            elapsed = zk.prewarm(batch_sizes=sizes or (1,))
+            logging.getLogger("fabric_token_sdk_tpu.tcc").info(
+                "pp-install prewarm: %.1fs (buckets %s)", elapsed, sizes)
 
     # ---- invoke("invoke") -------------------------------------------------
     def process_request(self, tx_id: str, request_raw: bytes) -> CommitEvent:

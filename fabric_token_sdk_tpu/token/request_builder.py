@@ -163,8 +163,7 @@ class Request:
     def input_owner_ids(self) -> list[bytes]:
         return list(self._input_owner_ids)
 
-    def bind_to(self, binder, identity: bytes,
-                wallet_service=None) -> None:
+    def bind_to(self, binder, identity: bytes, wallet_service) -> None:
         """request.go:1069 BindTo: when the party submitting this request
         changes (e.g. a recipient finalizes a transaction assembled by the
         sender), every transfer sender, extra signer, and receiver identity
@@ -173,14 +172,16 @@ class Request:
 
         binder: any object with bind(long_term: bytes, ephemeral: bytes)
         (the endpoint-binding service); wallet_service: the local
-        WalletService used to recognize own identities (skipped).
+        WalletService used to recognize own identities (required — without
+        it every local identity would be mis-bound to the submitter).
         """
+        if wallet_service is None:
+            raise RequestBuilderError(
+                "bind_to needs the local wallet service")
         ws = wallet_service
-        if ws is None:
-            ws = getattr(self.driver, "wallets", None)
 
         def is_mine(ident: bytes) -> bool:
-            return ws is not None and ws.wallet(ident) is not None
+            return ws.wallet(ident) is not None
 
         seen: set[bytes] = set()
 

@@ -143,3 +143,54 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def pallas_kernels():
+    """Pallas-kernel-level microbench at production shapes (TPU):
+      - fb_fold_t (pass-1 rgp): T=64, B=256/1024
+      - fb_msm_t vs fold+XLA-tree (K fixed part): T=66
+      - msm_var_fused vs XLA msm_windowed (combined pass-2): V=17408
+      - tec.add throughput inside a minimal pallas loop
+    Usage: python -c "import perf_experiments as p; p.pallas_kernels()"
+    """
+    import secrets
+
+    from fabric_token_sdk_tpu.crypto import bn254
+    from fabric_token_sdk_tpu.ops import pallas_fb
+
+    assert jax.default_backend() == "tpu", "pallas bench needs the chip"
+    for B in (256, 1024):
+        T = 64
+        gens = [bn254.g1_mul(bn254.G1_GENERATOR, 3 + i) for i in range(T)]
+        planes = ec.fixed_base_planes(
+            jnp.asarray(L.points_to_projective_limbs(gens)))
+        planes_t = jax.jit(pallas_fb.transpose_planes)(planes)
+        sc = jnp.asarray(np.stack([L.scalars_to_limbs(
+            [secrets.randbelow(bn254.R) for _ in range(T)])
+            for _ in range(B)]))
+        t = _bench(pallas_fb.fixed_base_gather_fused, planes_t, sc, iters=4)
+        print(f"fb gather T={T} B={B:5d}: {t*1e3:8.1f} ms "
+              f"({B*T*31/t/1e6:6.2f} M lane-adds/s)")
+        t = _bench(pallas_fb.fixed_base_msm_fused, planes_t, sc, iters=4)
+        print(f"fb MSM(acc) T={T} B={B:5d}: {t*1e3:8.1f} ms")
+
+        def msm_tree(pt, s):
+            per = pallas_fb.fixed_base_gather_fused(pt, s)
+            return ec._tree_sum_shrink(per)
+
+        msm_tree_j = jax.jit(msm_tree)
+        t = _bench(msm_tree_j, planes_t, sc, iters=4)
+        print(f"fb MSM(tree) T={T} B={B:5d}: {t*1e3:8.1f} ms")
+
+    for V in (4608, 17408):
+        pts_h = [bn254.g1_mul(bn254.G1_GENERATOR, 5 + i) for i in range(64)]
+        pts = jnp.asarray(np.stack(
+            [L.point_to_projective_limbs(pts_h[i % 64]) for i in range(V)]))
+        sc = jnp.asarray(L.scalars_to_limbs(
+            [secrets.randbelow(bn254.R) for _ in range(V)]))
+        t = _bench(pallas_fb.msm_var_fused, pts, sc, iters=4)
+        print(f"var MSM pallas V={V:6d}: {t*1e3:8.1f} ms "
+              f"({V/t/1e3:6.1f}k terms/s)")
+        mw = jax.jit(ec.msm_windowed)
+        t = _bench(mw, pts, sc, iters=4)
+        print(f"var MSM XLA    V={V:6d}: {t*1e3:8.1f} ms")

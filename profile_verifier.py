@@ -52,16 +52,17 @@ def main():
     t0 = time.perf_counter()
     st = v._dispatch_pass1(proofs, coms, ch)
     t_dispatch = time.perf_counter() - t0
-    transcripts, rgp_dev, k_dev = st
+    transcripts, digests_dev, pts_dev = st
     t0 = time.perf_counter()
-    jax.block_until_ready((rgp_dev, k_dev))
+    jax.block_until_ready(digests_dev)
     t_pass1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    rgp_u8 = np.asarray(rgp_dev)[:len(ch)]
-    k_u8 = np.asarray(k_dev)[:len(ch)]
+    words = np.asarray(digests_dev)[:len(ch)]
     t_transfer = time.perf_counter() - t0
     t0 = time.perf_counter()
-    x_ipa = rv._xipa_batch(v.params, proofs, ch, rgp_u8, k_u8)
+    from fabric_token_sdk_tpu.ops import sha256 as dsha
+
+    x_ipa = [vv % rv.R for vv in dsha.digest_words_to_ints(words)]
     t_xipa = time.perf_counter() - t0
     t0 = time.perf_counter()
     rch = rv._round_challenges_batch(proofs, ch, v.params.rounds)
@@ -87,7 +88,8 @@ def main():
     fixed_acc = (bytes(32 * n_fixed) if rv._FRNATIVE is not None
                  else [0] * n_fixed)
     t0 = time.perf_counter()
-    fixed_acc, part = v._combined_chunk(proofs, coms, ch, eqs, fixed_acc)
+    fixed_acc, part = v._combined_chunk(proofs, coms, ch, eqs, fixed_acc,
+                                        pts_dev)
     t_comb_host = time.perf_counter() - t0
     t0 = time.perf_counter()
     jax.block_until_ready(part)

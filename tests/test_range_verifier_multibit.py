@@ -133,3 +133,29 @@ def test_exact_path_matches_combined_accepts(world):
     exact = verifier.verify(proofs, coms, exact=True)
     assert verifier.last_path == "exact"
     assert fast.tolist() == exact.tolist() == [True, True]
+
+
+def test_multichunk_pipeline_bisect(monkeypatch):
+    """The chunked pipeline + per-chunk RLC bisect (production path for
+    B > FTS_VERIFY_CHUNK): corrupted proofs in NON-first chunks must be
+    isolated, clean chunks must keep their combined-accept verdicts."""
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+
+    monkeypatch.setattr(rv, "_CHUNK_ROWS", 2)
+    pp = setup.setup(16)
+    verifier = BatchRangeVerifier(pp)
+    proofs, coms = [], []
+    for i in range(6):
+        p, c = _prove_one(pp, 3 + i)
+        proofs.append(p)
+        coms.append(c)
+    out = verifier.verify(proofs, coms)     # 3 chunks, all clean
+    assert out.all() and verifier.last_path == "combined"
+
+    # corrupt one proof in chunk 2 (index 3): bisect isolates that chunk
+    proofs[3].data.tau = (proofs[3].data.tau + 1) % bn254.R
+    out = verifier.verify(proofs, coms)
+    assert list(out) == [True, True, True, False, True, True]
+    assert verifier.last_path == "exact"
+    # oracle agreement on the corrupted row
+    assert not _oracle_ok(pp, proofs[3], coms[3])

@@ -1,0 +1,125 @@
+"""Parity: the batched device SHA-256 vs hashlib (FIPS 180-4)."""
+
+import hashlib
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+
+from fabric_token_sdk_tpu.ops import sha256 as dsha
+
+
+def _check(messages: list[bytes]):
+    L = len(messages[0])
+    tail = dsha.pad_tail(L)
+    padded = np.stack([
+        np.concatenate([np.frombuffer(m, dtype=np.uint8), tail])
+        for m in messages])
+    words = np.asarray(dsha.digest_padded(jnp.asarray(padded)))
+    got = dsha.digest_words_to_ints(words)
+    want = [int.from_bytes(hashlib.sha256(m).digest(), "big")
+            for m in messages]
+    assert got == want
+
+
+def test_single_block():
+    _check([b"abc" + bytes(13)] * 2)
+
+
+def test_multi_block_batch():
+    msgs = [secrets.token_bytes(300) for _ in range(5)]
+    _check(msgs)
+
+
+def test_transcript_sized():
+    # the x_ipa transcript shape: ~17 KB, 265 blocks
+    msgs = [secrets.token_bytes(16944) for _ in range(3)]
+    _check(msgs)
+
+
+def test_block_boundary_lengths():
+    for L in (55, 56, 64, 119, 120, 128):
+        _check([secrets.token_bytes(L) for _ in range(2)])
+
+
+def test_xipa_device_matches_host_assembly():
+    """On-device transcript assembly + SHA == the host numpy/hashlib path
+    (which itself is parity-pinned to the reference's ipa.go:159-173)."""
+    from fabric_token_sdk_tpu.crypto import bn254
+    from fabric_token_sdk_tpu.crypto import serialization as ser
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+
+    class P:
+        bit_length = 8          # small n: cheap layout, same code path
+        rounds = 3
+        left_gen_bytes = tuple(
+            ser.g1_to_bytes(bn254.g1_mul(bn254.G1_GENERATOR, 3 + i))
+            .hex().encode("ascii") for i in range(8))
+        q_bytes = ser.g1_to_bytes(
+            bn254.g1_mul(bn254.G1_GENERATOR, 99)).hex().encode("ascii")
+
+    import numpy as np
+    rng = np.random.default_rng(3)
+    B = 4
+    rgp = rng.integers(0, 256, size=(B, 8, 64), dtype=np.uint8)
+    kb = rng.integers(0, 256, size=(B, 64), dtype=np.uint8)
+    ips = [int(rng.integers(1, 1 << 62)) for _ in range(B)]
+
+    class Proof:
+        def __init__(self, ip):
+            self.data = type("D", (), {"inner_product": ip})()
+
+    proofs = [Proof(ip) for ip in ips]
+    want = rv._xipa_batch(P, proofs, list(range(B)), rgp, kb)
+
+    ip_np = np.frombuffer(
+        b"".join(ser.zr_to_bytes(ip) for ip in ips),
+        dtype=np.uint8).reshape(B, 32)
+    words = np.asarray(rv._xipa_device_fn(P)(
+        jnp.asarray(rgp), jnp.asarray(kb), jnp.asarray(ip_np)))
+    from fabric_token_sdk_tpu.ops import sha256 as dsha
+
+    got = [v % bn254.R for v in dsha.digest_words_to_ints(words)]
+    assert got == want
+
+
+def test_derive_pass1_scalars_matches_host():
+    """Device-derived yinv powers / K coefficients == the host phase-a
+    expansion (native or Python) for real transcript scalars."""
+    import numpy as np
+
+    from fabric_token_sdk_tpu.crypto import bn254
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.ops import limbs
+
+    n = 16
+    R = bn254.R
+    rng = np.random.default_rng(11)
+    B = 3
+    rows = []
+    want_yinv, want_kf = [], []
+    for _ in range(B):
+        y = int(rng.integers(2, 1 << 62))
+        z = int(rng.integers(2, 1 << 62))
+        delta = int(rng.integers(2, 1 << 62))
+        x = int(rng.integers(2, 1 << 62))
+        y_inv = pow(y, R - 2, R)
+        rows.append(b"".join(v.to_bytes(32, "little")
+                             for v in (y_inv, z, delta, x)))
+        pows = [pow(y_inv, i, R) for i in range(n)]
+        want_yinv.append(pows)
+        z_sq = z * z % R
+        kf = [(z + z_sq * pow(2, i, R) % R * pows[i]) % R
+              for i in range(n)]
+        kf += [(R - delta) % R, (R - z) % R]
+        want_kf.append(kf)
+    sc4 = jnp.asarray(limbs.packed_to_limbs(b"".join(rows)).reshape(B, 4, 16))
+    yinv_d, kf_d, kvar_d = rv._derive_pass1_scalars(sc4, n)
+    for b in range(B):
+        got_p = [limbs.limbs_to_int(r) for r in np.asarray(yinv_d)[b]]
+        assert got_p == want_yinv[b], b
+        got_k = [limbs.limbs_to_int(r) for r in np.asarray(kf_d)[b]]
+        assert got_k == want_kf[b], b
+        assert limbs.limbs_to_int(np.asarray(kvar_d)[b, 0]) == \
+            int.from_bytes(rows[b][96:128], "little")
+        assert limbs.limbs_to_int(np.asarray(kvar_d)[b, 1]) == 1
