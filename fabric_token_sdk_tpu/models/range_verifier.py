@@ -185,6 +185,19 @@ def _exact_pass_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
     return jnp.logical_and(ok1, ok2)
 
 
+@jax.jit
+def _exact_var_tail_kernel(f1_pt, f2_pt, eq1_pts, eq1_sc, eq2_pts, eq2_sc):
+    """Fused-exact tail: per-proof fixed-base results + small var MSMs.
+
+    The deterministic exact pass is the adversarial DoS floor (one forged
+    proof forces it for its chunk); 87% of its terms are fixed generators,
+    so those ride the accumulated Pallas fixed-base kernel and only the
+    ~15 per-proof points stay on the XLA windowed path."""
+    ok1 = ec.is_identity(ec.add(f1_pt, ec.msm_windowed(eq1_pts, eq1_sc)))
+    ok2 = ec.is_identity(ec.add(f2_pt, ec.msm_windowed(eq2_pts, eq2_sc)))
+    return jnp.logical_and(ok1, ok2)
+
+
 _var_partial_kernel = jax.jit(ec.msm_windowed)
 
 
@@ -225,9 +238,11 @@ class RangeVerifierParams:
     # left_gen ++ [Q] bytes are pp constants.
     left_gen_bytes: tuple
     q_bytes: bytes
-    # transposed (96, 256)-contraction table subsets for the fused Pallas
-    # kernels (TPU only; None on CPU). Pre-gathered at build time so the
-    # per-call jnp.take copies of the XLA path disappear too.
+    # transposed (96, 256)-contraction tables for the fused Pallas kernels
+    # (TPU only; None on CPU). tables_t_all covers every generator in the
+    # `tables` index order; rgp/k are views/gathers of it (pre-built so
+    # per-call jnp.take copies disappear too).
+    tables_t_all: jnp.ndarray | None = None   # (2n+5, 32, 96, 256)
     tables_t_rgp: jnp.ndarray | None = None   # (n, 32, 96, 256)
     tables_t_k: jnp.ndarray | None = None     # (n+2, 32, 96, 256)
 
@@ -244,13 +259,16 @@ class RangeVerifierParams:
         gen_dev = jnp.asarray(limbs.points_to_projective_limbs(gen_points))
         tables = _tables_kernel(gen_dev)
         k_idx = list(range(n, 2 * n)) + [2 * n, 2 * n + 4]  # H_i ++ [P, S_G]
-        tables_t_rgp = tables_t_k = None
+        tables_t_all = tables_t_rgp = tables_t_k = None
         if _pallas_enabled():
             from ..ops import pallas_fb
 
-            tr = jax.jit(pallas_fb.transpose_planes)
-            tables_t_rgp = tr(tables[n:2 * n])
-            tables_t_k = tr(jnp.take(tables, jnp.asarray(k_idx), axis=0))
+            tables_t_all = jax.jit(pallas_fb.transpose_planes)(tables)
+            tables_t_rgp = tables_t_all[n:2 * n]
+            # H_i ++ P (contiguous n..2n) ++ S_G
+            tables_t_k = jnp.concatenate(
+                [tables_t_all[n:2 * n + 1],
+                 tables_t_all[2 * n + 4:2 * n + 5]], axis=0)
         return cls(
             bit_length=n,
             rounds=rpp.number_of_rounds,
@@ -266,6 +284,7 @@ class RangeVerifierParams:
                 ser.g1_to_bytes(p).hex().encode("ascii")
                 for p in rpp.left_generators),
             q_bytes=ser.g1_to_bytes(rpp.Q).hex().encode("ascii"),
+            tables_t_all=tables_t_all,
             tables_t_rgp=tables_t_rgp,
             tables_t_k=tables_t_k,
         )
@@ -586,6 +605,39 @@ def _derive_pass1_scalars(sc4, n: int):
             k_var)
 
 
+@functools.partial(jax.jit, static_argnames=("rounds",))
+def _round_digests(xy_m, inf, rounds: int):
+    """IPA round-challenge digests ON DEVICE: (B, nv, 2, 16) Montgomery
+    affine points + identity mask -> (B, rounds, 8) digest words of
+    H(hex(L_r) || '||' || hex(R_r)) (reference ipa.go:224-252 via
+    ipa_round_challenge). The L/R points ride the stage-1 upload, so the
+    host stops serializing/hashing 2*rounds points per proof."""
+    from ..ops import field
+    from ..ops import sha256 as dsha
+
+    B = xy_m.shape[0]
+    Lp = xy_m[:, 2:2 + rounds]
+    Rp = xy_m[:, 2 + rounds:2 + 2 * rounds]
+    li = inf[:, 2:2 + rounds]
+    ri = inf[:, 2 + rounds:2 + 2 * rounds]
+
+    def pbytes(p, m):
+        plain = field.from_mont(p, field.FP)
+        b = _limbs_to_bytes_dev(plain)
+        return jnp.where((m != 0)[..., None], jnp.zeros_like(b), b)
+
+    lb = _hex_ascii_dev(pbytes(Lp, li))
+    rb = _hex_ascii_dev(pbytes(Rp, ri))
+    sep = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(ser.SEPARATOR, dtype=np.uint8)),
+        (B, rounds, 2))
+    tail = jnp.broadcast_to(jnp.asarray(dsha.pad_tail(258)),
+                            (B, rounds, 62))
+    msg = jnp.concatenate([lb, sep, rb, tail], axis=-1)
+    return dsha.digest_padded(
+        msg.reshape(B * rounds, 320)).reshape(B, rounds, 8)
+
+
 _PASS1_FUSED_FNS: dict = {}
 
 
@@ -632,7 +684,8 @@ def _pass1_fused_fn(params):
             ec.msm_windowed(pts[:, :2], dc_sc))
         digests = xipa(_limbs_to_bytes_dev(ec.to_affine_batch(rgp_pts)),
                        _limbs_to_bytes_dev(ec.to_affine(k_pt)), ip_u8)
-        return digests, pts
+        rdig = _round_digests(xy, inf, params.rounds)
+        return digests, rdig, pts
 
     _PASS1_FUSED_FNS[key] = (run, nv, o_inf, o_ip)
     return _PASS1_FUSED_FNS[key]
@@ -1015,7 +1068,7 @@ class BatchRangeVerifier:
             if not exact and self.mesh is None:
                 acc = zero_acc if zero_acc is not None else [0] * n_fixed
                 acc, part = self._combined_chunk(
-                    proofs, commitments, ch, eqs_ch, acc, st[2])
+                    proofs, commitments, ch, eqs_ch, acc, st[3])
                 chunk_rlc.append((ch, acc, part))
 
         # ---- pass 2
@@ -1133,12 +1186,12 @@ class BatchRangeVerifier:
             packed[:, o_ip:] = np.ascontiguousarray(ip_np).view("<u4")
             pad_row = np.zeros(o_ip + 8, dtype=np.uint32)
             pad_row[o_inf:o_ip] = 1                        # identity points
-            digests_dev, pts_proj = run(
+            digests_dev, rdig_dev, pts_proj = run(
                 params.tables_t_rgp, params.tables_t_k,
                 jnp.asarray(_pad_rows(packed, b_bucket, pad_row)))
         else:
-            zero_sc2 = zero_sc
-            sc4 = self._put_rows(_pad_rows(sc4_np, b_bucket, zero_sc2))
+            rdig_dev = None
+            sc4 = self._put_rows(_pad_rows(sc4_np, b_bucket, zero_sc))
             xy = self._put_rows(_pad_rows(
                 proj[:, :, :2], b_bucket,
                 np.zeros((nv, 2, limbs.NLIMBS), dtype=np.uint32)))
@@ -1164,11 +1217,12 @@ class BatchRangeVerifier:
                 digests_dev = _xipa_device_fn(params)(
                     _affine_bytes_rows_kernel(rgp_pts),
                     _affine_bytes_kernel(k_pt), ip_dev)
-        try:
-            digests_dev.copy_to_host_async()
-        except (AttributeError, NotImplementedError):
-            pass
-        return transcripts, digests_dev, pts_proj
+        for arr in (digests_dev, rdig_dev):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError, TypeError):
+                pass
+        return transcripts, digests_dev, rdig_dev, pts_proj
 
     def _host_stage2(self, proofs, ch, st) -> dict:
         """Challenges (vectorized) + per-proof scalar expansion for one
@@ -1177,10 +1231,18 @@ class BatchRangeVerifier:
 
         params = self.params
         rr = params.rounds
-        transcripts, digests_dev, _pts = st
-        # round challenges depend only on proof bytes: hash them BEFORE
-        # blocking on the device transfer so they hide under it
-        rch = _round_challenges_batch(proofs, ch, rr)
+        transcripts, digests_dev, rdig_dev, _pts = st
+        if rdig_dev is None:
+            # XLA/mesh path: round challenges hashed on host (proof bytes
+            # only — run BEFORE blocking on the device transfer)
+            rch = _round_challenges_batch(proofs, ch, rr)
+        else:
+            rwords = np.asarray(rdig_dev)[:len(ch)]
+            flat = dsha.digest_words_to_ints(rwords.reshape(-1, 8))
+            rch = np.empty((len(ch), rr), dtype=object)
+            for row in range(len(ch)):
+                for r_i in range(rr):
+                    rch[row, r_i] = flat[row * rr + r_i] % R
         words = np.asarray(digests_dev)[:len(ch)]
         x_ipa = [v % R for v in dsha.digest_words_to_ints(words)]
         ch_packed_all = inv_packed_all = None
@@ -1331,62 +1393,114 @@ class BatchRangeVerifier:
         params = self.params
         n = params.bit_length
         rr = params.rounds
-        t_bucket = _next_pow2(2 * n + 2 * rr + 5)
         b_bucket = _bucket_rows(len(live))
         id_pt = limbs.point_to_projective_limbs(bn254.G1_IDENTITY)
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
+        native = _FRNATIVE is not None
+        fused = params.tables_t_all is not None
 
         eq1_pt_rows, eq1_sc_rows = [], []
         eq2_pt_rows, eq2_sc_rows = [], []
-        native = _FRNATIVE is not None
+        f1_sc_rows, f2_sc_rows = [], []
         for i in live:
             eq = equations[i]
             d = proofs[i].data
-            # eq1: [cg0, cg1, T1, T2, Com]
-            eq1_pt_rows.append([params.commitment_gen[0],
-                                params.commitment_gen[1],
-                                d.T1, d.T2, commitments[i]])
-            # eq2: G_i ++ H_i ++ [P, Q, D, C] ++ L_r ++ R_r
-            eq2_pt_rows.append(
-                params.left_gen + params.right_gen + [params.P, params.Q,
-                                                      d.D, d.C]
-                + proofs[i].ipa.L + proofs[i].ipa.R)
+            if fused:
+                # fixed generators ride the Pallas per-lane fixed-base MSM
+                # (tables index order: G.., H.., P, Q | cg0, cg1);
+                # only the per-proof points stay variable-base
+                eq1_pt_rows.append([d.T1, d.T2, commitments[i]])
+                eq2_pt_rows.append([d.D, d.C] + proofs[i].ipa.L
+                                   + proofs[i].ipa.R)
+            else:
+                # eq1: [cg0, cg1, T1, T2, Com]
+                eq1_pt_rows.append([params.commitment_gen[0],
+                                    params.commitment_gen[1],
+                                    d.T1, d.T2, commitments[i]])
+                # eq2: G_i ++ H_i ++ [P, Q, D, C] ++ L_r ++ R_r
+                eq2_pt_rows.append(
+                    params.left_gen + params.right_gen
+                    + [params.P, params.Q, d.D, d.C]
+                    + proofs[i].ipa.L + proofs[i].ipa.R)
             if native:
                 f, v = eq.fixed_packed, eq.var_packed
-                eq1_sc_rows.append(f[(2 * n + 2) * 32:(2 * n + 4) * 32]
-                                   + v[-3 * 32:])
-                eq2_sc_rows.append(f[:(2 * n + 2) * 32] + v[:2 * 32]
-                                   + v[2 * 32:(2 + 2 * rr) * 32])
+                if fused:
+                    f2_sc_rows.append(f[:(2 * n + 2) * 32])
+                    f1_sc_rows.append(f[(2 * n + 2) * 32:(2 * n + 4) * 32])
+                    eq1_sc_rows.append(v[-3 * 32:])
+                    eq2_sc_rows.append(v[:(2 + 2 * rr) * 32])
+                else:
+                    eq1_sc_rows.append(f[(2 * n + 2) * 32:(2 * n + 4) * 32]
+                                       + v[-3 * 32:])
+                    eq2_sc_rows.append(f[:(2 * n + 2) * 32] + v[:2 * 32]
+                                       + v[2 * 32:(2 + 2 * rr) * 32])
             else:
-                eq1_sc_rows.append([eq.fixed[2 * n + 2],
-                                    eq.fixed[2 * n + 3],
-                                    eq.var[-3], eq.var[-2], eq.var[-1]])
-                eq2_sc_rows.append(
-                    eq.fixed[: 2 * n + 2] + eq.var[:2]
-                    + eq.var[2 : 2 + 2 * rr])
+                if fused:
+                    f2_sc_rows.append(eq.fixed[:2 * n + 2])
+                    f1_sc_rows.append(eq.fixed[2 * n + 2:2 * n + 4])
+                    eq1_sc_rows.append([eq.var[-3], eq.var[-2],
+                                        eq.var[-1]])
+                    eq2_sc_rows.append(eq.var[:2 + 2 * rr])
+                else:
+                    eq1_sc_rows.append([eq.fixed[2 * n + 2],
+                                        eq.fixed[2 * n + 3],
+                                        eq.var[-3], eq.var[-2],
+                                        eq.var[-1]])
+                    eq2_sc_rows.append(
+                        eq.fixed[: 2 * n + 2] + eq.var[:2]
+                        + eq.var[2 : 2 + 2 * rr])
 
         eq1_pts_np = np.stack(
             [limbs.points_to_projective_limbs(rw) for rw in eq1_pt_rows])
         eq2_pts_np = np.stack(
             [limbs.points_to_projective_limbs(rw) for rw in eq2_pt_rows])
+        n_eq1 = eq1_pts_np.shape[1]
+        n_eq2 = eq2_pts_np.shape[1]
         if native:
             eq1_sc_np = limbs.packed_to_limbs(b"".join(eq1_sc_rows)).reshape(
-                len(live), 5, limbs.NLIMBS)
+                len(live), n_eq1, limbs.NLIMBS)
             eq2_sc_np = limbs.packed_to_limbs(b"".join(eq2_sc_rows)).reshape(
-                len(live), 2 * n + 2 * rr + 4, limbs.NLIMBS)
+                len(live), n_eq2, limbs.NLIMBS)
         else:
             eq1_sc_np = np.stack(
                 [limbs.scalars_to_limbs(rw) for rw in eq1_sc_rows])
             eq2_sc_np = np.stack(
                 [limbs.scalars_to_limbs(rw) for rw in eq2_sc_rows])
         eq1_pts_np, eq1_sc_np = _pad_terms(eq1_pts_np, eq1_sc_np, 8)
-        eq2_pts_np, eq2_sc_np = _pad_terms(eq2_pts_np, eq2_sc_np, t_bucket)
+        eq2_pts_np, eq2_sc_np = _pad_terms(
+            eq2_pts_np, eq2_sc_np, _next_pow2(n_eq2))
 
-        accept = np.asarray(_exact_pass_kernel(
-            jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
-            jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
-            jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),
-            jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))
+        if fused:
+            from ..ops import pallas_fb
+
+            if native:
+                f2_np = limbs.packed_to_limbs(b"".join(f2_sc_rows)).reshape(
+                    len(live), 2 * n + 2, limbs.NLIMBS)
+                f1_np = limbs.packed_to_limbs(b"".join(f1_sc_rows)).reshape(
+                    len(live), 2, limbs.NLIMBS)
+            else:
+                f2_np = np.stack(
+                    [limbs.scalars_to_limbs(rw) for rw in f2_sc_rows])
+                f1_np = np.stack(
+                    [limbs.scalars_to_limbs(rw) for rw in f1_sc_rows])
+            f2_pt = pallas_fb.fixed_base_msm_fused(
+                params.tables_t_all[:2 * n + 2],
+                jnp.asarray(_pad_rows(f2_np, b_bucket, zero_sc)))
+            f1_pt = pallas_fb.fixed_base_msm_fused(
+                params.tables_t_all[2 * n + 2:2 * n + 4],
+                jnp.asarray(_pad_rows(f1_np, b_bucket, zero_sc)))
+            accept = np.asarray(_exact_var_tail_kernel(
+                f1_pt, f2_pt,
+                jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
+                jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))
+        else:
+            accept = np.asarray(_exact_pass_kernel(
+                jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
+                jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))
         return accept[:len(live)]
 
     def verify_range_correctness(self, rc: rp.RangeCorrectness,

@@ -119,14 +119,17 @@ class TokenChaincode:
         spec = os.environ.get("FTS_PREWARM")
         zk = getattr(validator, "zk_verifier", None) or getattr(
             getattr(validator, "pp", None), "zk_verifier", None)
-        if spec and zk is not None and hasattr(zk, "prewarm"):
-            # numeric tokens select buckets; any boolean-ish value
-            # (FTS_PREWARM=1 / true / yes) means the default bucket
-            sizes = tuple(int(s) for s in spec.split(",")
-                          if s.strip().isdigit())
+        disabled = (spec or "").strip().lower() in ("", "0", "false", "no",
+                                                    "off")
+        if not disabled and zk is not None and hasattr(zk, "prewarm"):
+            # positive numeric tokens select buckets; any other truthy
+            # value (FTS_PREWARM=1 / true / yes) means the default bucket
+            sizes = tuple(v for v in (int(s) for s in spec.split(",")
+                                      if s.strip().isdigit()) if v > 0)
             elapsed = zk.prewarm(batch_sizes=sizes or (1,))
             logging.getLogger("fabric_token_sdk_tpu.tcc").info(
-                "pp-install prewarm: %.1fs (buckets %s)", elapsed, sizes)
+                "pp-install prewarm: %.1fs (buckets %s)", elapsed,
+                sizes or (1,))
 
     # ---- invoke("invoke") -------------------------------------------------
     def process_request(self, tx_id: str, request_raw: bytes) -> CommitEvent:

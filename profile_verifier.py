@@ -52,7 +52,7 @@ def main():
     t0 = time.perf_counter()
     st = v._dispatch_pass1(proofs, coms, ch)
     t_dispatch = time.perf_counter() - t0
-    transcripts, digests_dev, pts_dev = st
+    transcripts, digests_dev, _rdig, pts_dev = st
     t0 = time.perf_counter()
     jax.block_until_ready(digests_dev)
     t_pass1 = time.perf_counter() - t0

@@ -123,3 +123,38 @@ def test_derive_pass1_scalars_matches_host():
         assert limbs.limbs_to_int(np.asarray(kvar_d)[b, 0]) == \
             int.from_bytes(rows[b][96:128], "little")
         assert limbs.limbs_to_int(np.asarray(kvar_d)[b, 1]) == 1
+
+
+def test_round_digests_device_parity():
+    """Device round-challenge digests == rp.ipa_round_challenge, including
+    identity L/R points (zero-byte encodings)."""
+    import numpy as np
+
+    from fabric_token_sdk_tpu.crypto import bn254, rp
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.ops import limbs
+
+    rounds = 2
+    nv = 2 + 2 * rounds + 3
+    B = 3
+    rng = np.random.default_rng(5)
+    pts, proj_rows = [], []
+    for b in range(B):
+        row = [bn254.g1_mul(bn254.G1_GENERATOR, int(rng.integers(2, 1 << 30)))
+               for _ in range(nv)]
+        if b == 1:
+            row[3] = bn254.G1_IDENTITY      # an identity L point
+        pts.append(row)
+        proj_rows.append(limbs.points_to_projective_limbs(row))
+    proj = np.stack(proj_rows)
+    xy = jnp.asarray(proj[:, :, :2])
+    inf = jnp.asarray((proj[:, :, 2] == 0).all(-1).astype(np.uint8))
+    words = np.asarray(rv._round_digests(xy, inf, rounds))
+    from fabric_token_sdk_tpu.ops import sha256 as dsha
+
+    for b in range(B):
+        for r_i in range(rounds):
+            got = dsha.digest_words_to_ints(words[b, r_i][None])[0] % bn254.R
+            want = rp.ipa_round_challenge(pts[b][2 + r_i],
+                                          pts[b][2 + rounds + r_i])
+            assert got == want, (b, r_i)
