@@ -17,8 +17,9 @@ import time
 import numpy as np
 
 from ...crypto import issue_proof, rp, transfer_proof
-from ...crypto.bn254 import G1, g1_add, g1_neg
+from ...crypto.bn254 import G1
 from ...crypto.rp import ProofError
+from ...models.adjust import adjust_points
 
 logger = logging.getLogger("fabric_token_sdk_tpu.zkverifier")
 
@@ -56,6 +57,9 @@ class ZKVerifier:
         self._range.prewarm(batch_sizes=batch_sizes)
         if self._sigma is not None:
             self._sigma.prewarm(batch_sizes=batch_sizes)
+        from ...models import adjust as _adjust
+
+        _adjust.prewarm(batch_sizes=batch_sizes)
         return _time.perf_counter() - t0
 
     # ------------------------------------------------------------ transfer
@@ -78,8 +82,8 @@ class ZKVerifier:
         if len(inputs) != 1 or len(outputs) != 1:
             if proof.range_correctness is None:
                 raise ProofError("invalid transfer proof")
-            coms = [g1_add(o, g1_neg(proof.type_and_sum.commitment_to_type))
-                    for o in outputs]
+            ctt = proof.type_and_sum.commitment_to_type
+            coms = adjust_points(outputs, [ctt] * len(outputs))
             self._verify_range_batch(proof.range_correctness, coms)
 
     # --------------------------------------------------------------- issue
@@ -96,8 +100,8 @@ class ZKVerifier:
             self._verify_same_type(proof.same_type)
         except ProofError as e:
             raise ProofError(f"invalid issue proof: {e}") from e
-        coms = [g1_add(t, g1_neg(proof.same_type.commitment_to_type))
-                for t in commitments]
+        ctt = proof.same_type.commitment_to_type
+        coms = adjust_points(commitments, [ctt] * len(commitments))
         try:
             self._verify_range_batch(proof.range_correctness, coms)
         except ProofError as e:
@@ -160,8 +164,11 @@ class ZKVerifier:
         sigma_ok_i = {k: bool(st_acc[j])
                       for j, k in enumerate(sorted(i_proofs))}
 
-        # 3. cross-action range batch (one device call for the whole block)
-        range_proofs, range_coms, owners = [], [], []
+        # 3. cross-action range batch (one device call for the whole block).
+        # Commitment adjustments (out - com_type) batch through ONE device
+        # pass too — the host affine add costs ~0.5 ms each (Fermat
+        # inversion), seconds per 4k-action block.
+        range_proofs, raw_pts, raw_ctts, owners = [], [], [], []
         for k in sorted(t_proofs):
             p, (_, ins, outs) = t_proofs[k], transfers[k]
             if not sigma_ok_t[k]:
@@ -175,7 +182,8 @@ class ZKVerifier:
             ctt = p.type_and_sum.commitment_to_type
             for o, rp_proof in zip(outs, p.range_correctness.proofs):
                 range_proofs.append(rp_proof)
-                range_coms.append(g1_add(o, g1_neg(ctt)))
+                raw_pts.append(o)
+                raw_ctts.append(ctt)
                 owners.append(("t", k))
         for k in sorted(i_proofs):
             p, (_, coms) = i_proofs[k], issues[k]
@@ -188,9 +196,11 @@ class ZKVerifier:
             ctt = p.same_type.commitment_to_type
             for c, rp_proof in zip(coms, p.range_correctness.proofs):
                 range_proofs.append(rp_proof)
-                range_coms.append(g1_add(c, g1_neg(ctt)))
+                raw_pts.append(c)
+                raw_ctts.append(ctt)
                 owners.append(("i", k))
         if range_proofs:
+            range_coms = adjust_points(raw_pts, raw_ctts)
             accepts = self._range.verify(range_proofs, range_coms)
             for acc, (kind, k) in zip(accepts, owners):
                 if not acc:

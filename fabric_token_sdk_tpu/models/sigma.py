@@ -16,8 +16,12 @@ three Pedersen generators (q=ped[0], g=ped[1], h=ped[2]) plus ONE
 variable-point windowed multiplication — so a whole batch flattens into
 one (rows, 3)-scalar fixed-base MSM + one (rows, 1)-term windowed MSM +
 a single batched affine conversion (one Fermat inversion for all rows).
-Challenge re-derivation (SHA) stays on host; adjusted points A_i, S are
-host point ADDS only (no scalar muls — those all ride the device).
+Challenge re-derivation (SHA) stays on host. The adjusted points
+A_i = in_i - com_type and the per-action signed sum S also ride the
+device (one batched complete-add + a log2(K) tree fold inside the same
+jit program — round-5: the host bigint adds were ~1 s per 4k-action
+block and sat on the critical path); their affine bytes come back in the
+same single-inversion conversion as the row commitments.
 """
 
 from __future__ import annotations
@@ -30,10 +34,10 @@ import numpy as np
 
 from ..crypto import bn254
 from ..crypto import serialization as ser
-from ..crypto.bn254 import fr_neg, g1_add, g1_neg, hash_to_zr
+from ..crypto.bn254 import fr_neg, hash_to_zr
 from ..ops import ec, limbs
-from .batching import bucket_rows as _bucket_rows
-from .range_verifier import affine_batch_to_bytes
+from .batching import bucket_rows as _bucket_rows, next_pow2 as _next_pow2
+from .range_verifier import affine_batch_to_bytes, hex_ascii
 
 
 @jax.jit
@@ -55,6 +59,49 @@ def _sigma_rows_kernel(tables, fixed_sc, var_pts, var_sc):
     return ec.to_affine_batch(total[None])[0]                # (R, 2, 16)
 
 
+@jax.jit
+def _tas_block_kernel(tables, ptp, cttp, valid, out_slot, var_sel,
+                      fixed_sc, var_sc):
+    """The whole type-and-sum batch in ONE device program.
+
+    ptp:      (A, K, 3, 16) input+output points per action (inputs first,
+              identity/zero padded); cttp: (A, 3, 16) commitment_to_type.
+    valid:    (A, K) bool — real slots; out_slot: (A, K) bool — outputs
+              (negated in the sum fold).
+    var_sel:  (R,) int32 index into the pool [adj rows | sums | ctt].
+    fixed_sc: (R, 3, 16); var_sc: (R, 16).
+
+    Computes adj = pt - ctt (typeandsum.go:230-248's adjusted
+    commitments), the per-action signed sum S = sum(adj_in) - sum(adj_out)
+    via a log2(K) tree fold, then the Σ-row commitments, and converts
+    rows + adj + sums to affine in one batched inversion. Returns
+    (R + A*K + A, 64) u8 canonical mathlib G1 bytes (packed on device).
+    """
+    A, K = ptp.shape[0], ptp.shape[1]
+    neg_ctt = jnp.broadcast_to(ec.neg(cttp)[:, None], ptp.shape)
+    adj = ec.add(ptp, neg_ctt)                               # (A, K, 3, 16)
+    adj = jnp.where(valid[..., None, None], adj, ec.identity((A, K)))
+    signed = jnp.where(out_slot[..., None, None], ec.neg(adj), adj)
+    k = K
+    while k > 1:
+        half = k // 2
+        signed = ec.add(signed[:, :half], signed[:, half:k])
+        k = half
+    sums = signed[:, 0]                                      # (A, 3, 16)
+    adj_flat = adj.reshape(A * K, 3, limbs.NLIMBS)
+    pool = jnp.concatenate([adj_flat, sums, cttp], axis=0)
+    var_pts = jnp.take(pool, var_sel, axis=0)                # (R, 3, 16)
+    fixed = ec.fixed_base_msm(tables, fixed_sc)              # (R, 3, 16)
+    var = ec.msm_windowed(var_pts[:, None], var_sc[:, None])
+    total = ec.add(fixed, var)
+    allp = jnp.concatenate([total, adj_flat, sums], axis=0)
+    from .range_verifier import _limbs_to_bytes_dev
+
+    # bytes leave the device pre-packed: 64 B/point instead of 128 B of
+    # limbs, and no host-side conversion over the padded rows
+    return _limbs_to_bytes_dev(ec.to_affine_batch(allp[None])[0])
+
+
 @dataclass(frozen=True)
 class _Row:
     """One recomputed commitment: fixed scalars + var point + var scalar."""
@@ -74,12 +121,35 @@ class BatchSigmaVerifier:
         self.tables = _sigma_tables_kernel(jnp.asarray(gens))
 
     def prewarm(self, batch_sizes=(1,)) -> None:
-        """Compile _sigma_rows_kernel for the row buckets covering
-        `batch_sizes` (pp-install availability, tcc.go:90 semantics)."""
+        """Compile the Σ kernels for the row buckets covering
+        `batch_sizes` (pp-install availability, tcc.go:90 semantics):
+        the same-type row kernel and the type-and-sum block kernel at a
+        2-in/2-out action shape (the production transfer layout)."""
+        from types import SimpleNamespace
+
         g = bn254.G1_GENERATOR
         for b in batch_sizes:
             self._run_rows([_Row(fixed=(1, 1, 1), var_point=g,
                                  var_scalar=1)] * b)
+
+            def mk(n_in, n_out):
+                p = SimpleNamespace(
+                    type_=1, type_blinding_factor=1, commitment_to_type=g,
+                    equality_of_sum=1, challenge=1,
+                    input_values=[1] * n_in,
+                    input_blinding_factors=[1] * n_in)
+                return (p, [g] * n_in, [g] * n_out)
+
+            # _tas_block_kernel shapes are keyed on (A_b, K_b, R_b) with
+            # R data-dependent (sum n_in + 2A). Cover every combination a
+            # K<=4 block of b actions can produce: uniform 2-in/2-out
+            # (K4, R=4b), uniform ownership 1-in/1-out (K2, 3b), mixed
+            # mostly-1/1 (K4, ~3b), and 3-in/1-out heavy (K4, 5b).
+            # Actions with >4 in+out slots still compile on first sight.
+            self.verify_type_and_sum([mk(2, 2)] * b)
+            self.verify_type_and_sum([mk(1, 1)] * b)
+            self.verify_type_and_sum([mk(2, 2)] + [mk(1, 1)] * (b - 1))
+            self.verify_type_and_sum([mk(3, 1)] * b)
 
     # ------------------------------------------------------------ device
     def _run_rows(self, rows: list[_Row]) -> np.ndarray:
@@ -126,11 +196,15 @@ class BatchSigmaVerifier:
 
     # --------------------------------------------------- type-and-sum
     def verify_type_and_sum(self, items: list) -> np.ndarray:
-        """items: (TypeAndSumProof, inputs, outputs) triples -> accepts."""
+        """items: (TypeAndSumProof, inputs, outputs) triples -> accepts.
+
+        The adjusted commitments, their signed sum, and every Σ-row
+        commitment are computed in one device program
+        (_tas_block_kernel); the host only packs limbs, hexes the
+        returned byte rows, and re-derives the Fiat-Shamir challenges."""
         B = len(items)
         ok = np.zeros(B, dtype=bool)
-        rows: list[_Row] = []
-        meta = []  # (item idx, n_in, adj_inputs, adj_outputs, sum_)
+        live = []
         for i, (p, inputs, outputs) in enumerate(items):
             if (p is None or p.type_blinding_factor is None
                     or p.type_ is None or p.commitment_to_type is None
@@ -140,44 +214,86 @@ class BatchSigmaVerifier:
                     or len(p.input_blinding_factors) < len(inputs)
                     or any(v is None for v in p.input_values[:len(inputs)])):
                 continue
-            neg_c = fr_neg(p.challenge)
-            adj_in, adj_out = [], []
-            sum_ = bn254.G1_IDENTITY
-            for pt in inputs:
-                a = g1_add(pt, g1_neg(p.commitment_to_type))
-                adj_in.append(a)
-                sum_ = g1_add(sum_, a)
-            for pt in outputs:
-                a = g1_add(pt, g1_neg(p.commitment_to_type))
-                adj_out.append(a)
-                sum_ = g1_add(sum_, g1_neg(a))
-            for j in range(len(inputs)):
-                rows.append(_Row(
-                    fixed=(0, p.input_values[j],
-                           p.input_blinding_factors[j]),
-                    var_point=adj_in[j], var_scalar=neg_c))
-            rows.append(_Row(fixed=(0, 0, p.equality_of_sum),
-                             var_point=sum_, var_scalar=neg_c))
-            rows.append(_Row(fixed=(p.type_, 0, p.type_blinding_factor),
-                             var_point=p.commitment_to_type,
-                             var_scalar=neg_c))
-            meta.append((i, len(inputs), adj_in, adj_out, sum_))
-        if not meta:
+            live.append((i, p, inputs, outputs))
+        if not live:
             return ok
-        enc = self._run_rows(rows)
+        NL = limbs.NLIMBS
+        A = len(live)
+        A_b = _bucket_rows(A)
+        # K from a fixed bucket set so shapes stay compile-cacheable
+        # (prewarm covers 2 and 4; larger actions are rare)
+        K_b = max(2, _next_pow2(max(
+            len(ins) + len(outs) for _, _, ins, outs in live)))
+        R = sum(len(ins) for _, _, ins, _ in live) + 2 * A
+        R_b = _bucket_rows(R)
+        ptp = np.zeros((A_b, K_b, 3, NL), dtype=np.uint32)
+        valid = np.zeros((A_b, K_b), dtype=bool)
+        out_slot = np.zeros((A_b, K_b), dtype=bool)
+        fixed_i = np.zeros((R_b, 3), dtype=object)
+        var_sel = np.zeros((R_b,), dtype=np.int32)
+        var_act = np.zeros((R_b,), dtype=np.int32)  # row -> action index
+        # one batched native conversion for EVERY point (ctt first, then
+        # the per-action slot points) and one for every scalar
+        all_pts = []
+        meta = []  # (item idx, action idx, n_in, n_out, first row)
+        r = 0
+        for a, (i, p, inputs, outputs) in enumerate(live):
+            n_in, n_out = len(inputs), len(outputs)
+            all_pts.append(p.commitment_to_type)
+            for j, pt in enumerate(inputs + outputs):
+                all_pts.append(pt)
+                valid[a, j] = True
+                out_slot[a, j] = j >= n_in
+            meta.append((i, a, n_in, n_out, r))
+            for j in range(n_in):
+                fixed_i[r] = (0, p.input_values[j],
+                              p.input_blinding_factors[j])
+                var_sel[r] = a * K_b + j
+                var_act[r] = a
+                r += 1
+            fixed_i[r] = (0, 0, p.equality_of_sum)
+            var_sel[r] = A_b * K_b + a          # sums section
+            var_act[r] = a
+            r += 1
+            fixed_i[r] = (p.type_, 0, p.type_blinding_factor)
+            var_sel[r] = A_b * K_b + A_b + a    # ctt section
+            var_act[r] = a
+            r += 1
+        pts_l = limbs.points_to_projective_limbs(all_pts)  # (M, 3, 16)
+        cttp = np.zeros((A_b, 3, NL), dtype=np.uint32)
         cursor = 0
-        for i, n_in, adj_in, adj_out, sum_ in meta:
+        for a, (i, p, inputs, outputs) in enumerate(live):
+            k = len(inputs) + len(outputs)
+            cttp[a] = pts_l[cursor]
+            for j in range(k):
+                ptp[a, j] = pts_l[cursor + 1 + j]
+            cursor += 1 + k
+        fixed = limbs.scalars_to_limbs(
+            [int(v) for row in fixed_i[:r] for v in row]).reshape(r, 3, NL)
+        fixed = np.concatenate(
+            [fixed, np.zeros((R_b - r, 3, NL), dtype=np.uint32)])
+        negc_l = limbs.scalars_to_limbs(
+            [fr_neg(p.challenge) for _, p, _, _ in live])   # (A, 16)
+        var_sc = np.zeros((R_b, NL), dtype=np.uint32)
+        var_sc[:r] = negc_l[var_act[:r]]
+        enc = _tas_block_kernel(
+            self.tables, jnp.asarray(ptp), jnp.asarray(cttp),
+            jnp.asarray(valid), jnp.asarray(out_slot),
+            jnp.asarray(var_sel), jnp.asarray(fixed), jnp.asarray(var_sc))
+        hx = hex_ascii(np.asarray(enc))
+        adj0, sum0 = R_b, R_b + A_b * K_b
+        for i, a, n_in, n_out, r0 in meta:
             p = items[i][0]
-            in_hex = [bytes(enc[cursor + j]).hex().encode("ascii")
-                      for j in range(n_in)]
-            sum_hex = bytes(enc[cursor + n_in]).hex().encode("ascii")
-            type_hex = bytes(enc[cursor + n_in + 1]).hex().encode("ascii")
-            cursor += n_in + 2
+            in_hex = [hx[r0 + j].tobytes() for j in range(n_in)]
+            sum_hex = hx[r0 + n_in].tobytes()
+            type_hex = hx[r0 + n_in + 1].tobytes()
+            adj_hex = [hx[adj0 + a * K_b + j].tobytes()
+                       for j in range(n_in + n_out)]
             # transcript order per typeandsum.go:214,267
             transcript = ser.SEPARATOR.join(
-                in_hex + [type_hex, sum_hex]
-                + [ser.g1_to_bytes(q).hex().encode("ascii")
-                   for q in (adj_in + adj_out
-                             + [p.commitment_to_type, sum_])])
+                in_hex + [type_hex, sum_hex] + adj_hex
+                + [ser.g1_to_bytes(
+                    p.commitment_to_type).hex().encode("ascii"),
+                   hx[sum0 + a].tobytes()])
             ok[i] = hash_to_zr(transcript) == p.challenge
         return ok

@@ -44,29 +44,45 @@ MASK = L.LIMB_MASK  # python int: never captured as a device constant
 class TSpec(NamedTuple):
     """Field constants in transposed layout (limb axis leading, lane=1).
 
-    All arrays broadcast over the lane axis. `w_nprime`/`w_mod` are the
-    nibble-Toeplitz matrices of field._nibble_toeplitz TRANSPOSED to
-    (out_nibbles, 64) so the in-kernel contraction is a plain (M,K)x(K,LANE)
-    matmul. mod_int is a python int (jit-static).
+    All arrays broadcast over the lane axis. `w_nprime`/`w_mod` are
+    5-nibble-plane Toeplitz matrices (`_toeplitz_t`) accepting LAZY
+    (17-bit) limb operands, so the in-kernel contraction is a plain
+    (M,K)x(K,LANE) matmul. mod_int is a python int (jit-static).
     """
 
     mod: jnp.ndarray       # (N, 1) uint32
     nprime: jnp.ndarray    # (N, 1) uint32  (-mod^-1 mod 2^256, low limbs)
     r1: jnp.ndarray        # (N, 1) uint32  (Montgomery 1)
-    w_nprime: jnp.ndarray  # (4, N, 64)  int8: T_lo * N' mod 2^256
-    w_mod: jnp.ndarray     # (4, 2N, 64) int8: m * mod, full 2N limbs
+    w_nprime: jnp.ndarray  # (4, N, 5N)  int8: T_lo * N' mod 2^256
+    w_mod: jnp.ndarray     # (4, 2N, 5N) int8: m * mod, full 2N limbs
     mod_int: int
 
 
 def _toeplitz_t(const_limbs: tuple, out_cols: int) -> np.ndarray:
-    """(4, out_cols, 64) int8: W[k, l, i] = nibble (4l + k - i) of the
-    constant — four per-nibble-position Toeplitz matrices so the in-kernel
-    contraction is four plain matmuls with no strided slicing (Mosaic)."""
-    from . import field
+    """(4, out_cols, 5N) int8 Toeplitz planes for a LAZY-limb operand.
 
-    w = field._nibble_toeplitz(const_limbs, out_cols)   # (64, 4*out_cols)
-    return np.ascontiguousarray(
-        np.stack([w[:, k::4].T for k in range(4)]))
+    Input row r = 5i + k is nibble k of limb i, at bit position 4(4i + k)
+    — FIVE nibbles per limb so operands may carry up to 20-bit "lazy"
+    limbs (the k = 4 row overlaps limb i+1's nibble 0; for canonical
+    16-bit limbs it is simply zero). W[kk, l, r] = nibble (4l + kk - p(r))
+    of the constant with p(r) = 4i + k, so the in-kernel contraction is
+    four plain (out_cols, 5N) x (5N, LANE) matmuls recombined by shifts —
+    column sums of a * const at 16-bit granularity, truncated past
+    out_cols (drops only multiples of 2^(16*out_cols))."""
+    c = []
+    for limb in const_limbs:
+        for shift in (0, 4, 8, 12):
+            c.append((int(limb) >> shift) & 0xF)
+    w = np.zeros((4, out_cols, 5 * N), dtype=np.int8)
+    for r in range(5 * N):
+        i, k = divmod(r, 5)
+        p = 4 * i + k
+        for l in range(out_cols):
+            for kk in range(4):
+                j = 4 * l + kk - p
+                if 0 <= j < len(c):
+                    w[kk, l, r] = c[j]
+    return np.ascontiguousarray(w)
 
 
 def make_tspec(spec) -> TSpec:
@@ -122,20 +138,35 @@ def _lookahead(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return _shift_down(g, 1)
 
 
-def carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
-    """Lazy column sums (< 2^32) -> canonical 16-bit limbs, axis -2."""
+def _fit_limbs(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
     k = t.shape[-2]
     if k < out_limbs:
         z = jnp.zeros(t.shape[:-2] + (out_limbs - k, t.shape[-1]),
                       dtype=t.dtype)
-        t = jnp.concatenate([t, z], axis=-2)
-    else:
-        t = t[..., :out_limbs, :]
+        return jnp.concatenate([t, z], axis=-2)
+    return t[..., :out_limbs, :]
+
+
+def carry_propagate(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Lazy column sums (< 2^32) -> canonical 16-bit limbs, axis -2."""
+    t = _fit_limbs(t, out_limbs)
     v = (t & MASK) + _shift_down(t >> BITS, 1)
     v = (v & MASK) + _shift_down(v >> BITS, 1)
     g = v >> BITS                     # 0/1: v == 2^16 exactly
     p = (v == MASK).astype(jnp.uint32)
     return (v + _lookahead(g, p)) & MASK
+
+
+def lazy_limbs(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Lazy column sums -> LAZY limbs: ONE ripple pass, no lookahead.
+
+    Output limbs are bounded by 2^16 - 1 + (max column >> 16) — for the
+    < 2^27 columns mont_mul feeds this, < 2^16 + 2^11 (17 bits), inside
+    the 20-bit tolerance of the 5-nibble Toeplitz planes. Value is
+    congruent mod 2^(16*out_limbs) (top carry dropped), which is all the
+    Montgomery reduction needs from T_lo and m."""
+    t = _fit_limbs(t, out_limbs)
+    return (t & MASK) + _shift_down(t >> BITS, 1)
 
 
 def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray):
@@ -215,12 +246,14 @@ def _product_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _nibbles(a: jnp.ndarray) -> jnp.ndarray:
-    """(..., N, LANE) u32 canonical -> (..., 4N, LANE) int8 nibbles,
-    row 4i+k = (a[i] >> 4k) & 0xF (the field._nibble_toeplitz row order)."""
+    """(..., N, LANE) u32 limbs (canonical OR lazy < 2^20) ->
+    (..., 5N, LANE) int8 nibbles, row 5i+k = (a[i] >> 4k) & 0xF — the
+    `_toeplitz_t` row order; the fifth nibble carries the lazy overflow
+    (zero for canonical limbs)."""
     parts = []
     for i in range(N):
         row = a[..., i:i + 1, :].astype(jnp.int32)
-        for k in (0, 4, 8, 12):
+        for k in (0, 4, 8, 12, 16):
             parts.append((row >> k) & 0xF)
     return jnp.concatenate(parts, axis=-2).astype(jnp.int8)
 
@@ -228,12 +261,12 @@ def _nibbles(a: jnp.ndarray) -> jnp.ndarray:
 def _const_product_cols(a: jnp.ndarray, w_t: jnp.ndarray) -> jnp.ndarray:
     """Lazy columns of a * CONSTANT via the transposed nibble-Toeplitz dots.
 
-    a: (N, LANE) canonical; w_t: (4, out_cols, 64) int8 (TSpec layout).
-    Four (out_cols, 64) x (64, LANE) MXU matmuls in int32 accumulation
-    (one per output nibble position), folded with shifts. No batch dims:
-    the kernels call this on 2-D tiles.
+    a: (N, LANE) canonical or lazy (< 2^20 limbs); w_t: (4, out_cols, 5N)
+    int8 (TSpec layout). Four (out_cols, 5N) x (5N, LANE) MXU matmuls in
+    int32 accumulation (one per output nibble position), folded with
+    shifts. No batch dims: the kernels call this on 2-D tiles.
     """
-    nib = _nibbles(a)                                   # (64, LANE) i8
+    nib = _nibbles(a)                                   # (5N, LANE) i8
 
     def dot_k(k):
         c = jax.lax.dot_general(
@@ -248,28 +281,35 @@ def _const_product_cols(a: jnp.ndarray, w_t: jnp.ndarray) -> jnp.ndarray:
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
     """Montgomery product a*b*R^-1 mod m over (..., N, LANE) limbs.
 
-    Same separated reduction as field.mont_mul; the two constant-operand
-    products ride the nibble-Toeplitz MXU dot when the input is 2-D
-    (in-kernel tiles), else the schoolbook path (parity testing with
-    batch dims)."""
+    Same separated reduction as field.mont_mul. On the 2-D (in-kernel
+    tile) path the two constant-operand products ride the nibble-Toeplitz
+    MXU dot, and the two INNER carry resolutions are LAZY: T_lo and m
+    keep 17-bit limbs from a single ripple pass (the 5-nibble planes
+    tolerate them), so only the final sum resolves exactly. Bound: m_int
+    < 2^256 * (1 + 2^-5), hence res < mod * (mod/2^256 + 1.04) < 1.3*mod
+    for BN254's p, r ~ 0.19 * 2^256 — the single conditional subtract
+    still canonicalizes. The batch-dim path (parity testing) stays fully
+    exact schoolbook."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
     t_cols = _product_cols(a, b)
-    T = carry_propagate(t_cols, 2 * N + 1)
-    t_lo = T[..., :N, :]
     if a.ndim == 2:
-        m = carry_propagate(_const_product_cols(t_lo, ts.w_nprime), N)
+        t_lo = lazy_limbs(t_cols, N)          # == T mod 2^256, 17-bit lazy
+        m = lazy_limbs(_const_product_cols(t_lo, ts.w_nprime), N)
         u_cols = _const_product_cols(m, ts.w_mod)
+        s = carry_propagate(t_cols + u_cols, 2 * N + 1)
     else:
         # batch-dim path (parity tests): schoolbook against the limb consts.
         # m needs only the low N columns of t_lo * nprime.
+        T = carry_propagate(t_cols, 2 * N + 1)
+        t_lo = T[..., :N, :]
         np_b = jnp.broadcast_to(ts.nprime, t_lo.shape)
         m = carry_propagate(_product_cols(t_lo, np_b)[..., :N, :], N)
         u_cols = _product_cols(m, jnp.broadcast_to(ts.mod, m.shape))
-    z1 = jnp.zeros(T.shape[:-2] + (1, T.shape[-1]), dtype=jnp.uint32)
-    u_ext = jnp.concatenate([u_cols, z1], axis=-2)[..., :2 * N + 1, :]
-    s = carry_propagate(T + u_ext, 2 * N + 1)
+        z1 = jnp.zeros(T.shape[:-2] + (1, T.shape[-1]), dtype=jnp.uint32)
+        u_ext = jnp.concatenate([u_cols, z1], axis=-2)[..., :2 * N + 1, :]
+        s = carry_propagate(T + u_ext, 2 * N + 1)
     res = s[..., N:, :]
     return _cond_sub_mod(res, ts)
 

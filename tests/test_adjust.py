@@ -1,0 +1,48 @@
+"""Device-batched point adjustment vs the host oracle.
+
+models/adjust.py routes the verifiers' commitment adjustments
+(out - com_type; reference crypto/transfer/transfer.go:176-180,
+crypto/issue/verifier.go:50-53) through one device pass above a size
+threshold. The device branch (kernel + byte->G1 reconstruction without
+the on-curve check) must match the host affine add bit-for-bit,
+including the identity encoding.
+"""
+
+import secrets
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.models import adjust
+
+
+def _same(p, q):
+    return (p.inf and q.inf) or (not p.inf and not q.inf
+                                 and p.x == q.x and p.y == q.y)
+
+
+def _rand_pts(n):
+    return [bn254.g1_mul(bn254.G1_GENERATOR, secrets.randbelow(bn254.R))
+            for _ in range(n)]
+
+
+class TestAdjustPoints:
+    def test_device_path_parity(self):
+        n = adjust._HOST_THRESHOLD + 9      # force the device branch
+        pts, mns = _rand_pts(n), _rand_pts(n)
+        mns[3] = pts[3]                     # difference -> identity
+        mns[7] = bn254.G1_IDENTITY          # subtracting identity
+        got = adjust.adjust_points(pts, mns)
+        for i in range(n):
+            want = bn254.g1_add(pts[i], bn254.g1_neg(mns[i]))
+            assert _same(want, got[i]), i
+        assert got[3].inf
+
+    def test_host_path_parity(self):
+        n = adjust._HOST_THRESHOLD - 1
+        pts, mns = _rand_pts(n), _rand_pts(n)
+        got = adjust.adjust_points(pts, mns)
+        for i in range(n):
+            assert _same(bn254.g1_add(pts[i], bn254.g1_neg(mns[i])),
+                         got[i])
+
+    def test_empty(self):
+        assert adjust.adjust_points([], []) == []
