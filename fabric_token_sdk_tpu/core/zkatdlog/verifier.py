@@ -153,26 +153,16 @@ class ZKVerifier:
             except (ValueError, ProofError):
                 pass
 
-        # 2. Σ batch on device
-        ts_items = [(t_proofs[k].type_and_sum, transfers[k][1],
-                     transfers[k][2]) for k in sorted(t_proofs)]
-        st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
-        ts_acc = self._sigma.verify_type_and_sum(ts_items)
-        st_acc = self._sigma.verify_same_type(st_items)
-        sigma_ok_t = {k: bool(ts_acc[j])
-                      for j, k in enumerate(sorted(t_proofs))}
-        sigma_ok_i = {k: bool(st_acc[j])
-                      for j, k in enumerate(sorted(i_proofs))}
-
-        # 3. cross-action range batch (one device call for the whole block).
-        # Commitment adjustments (out - com_type) batch through ONE device
-        # pass too — the host affine add costs ~0.5 ms each (Fermat
-        # inversion), seconds per 4k-action block.
+        # 2. assemble the cross-action range batch for every structurally
+        # valid action (Σ verdicts are still pending — a Σ-failing action's
+        # range rows are verified too and simply ANDed away, which keeps
+        # all three device phases overlappable; honest blocks pay nothing
+        # extra). Structural range failures reject here.
+        sigma_ok_t = {k: True for k in t_proofs}
+        sigma_ok_i = {k: True for k in i_proofs}
         range_proofs, raw_pts, raw_ctts, owners = [], [], [], []
         for k in sorted(t_proofs):
             p, (_, ins, outs) = t_proofs[k], transfers[k]
-            if not sigma_ok_t[k]:
-                continue
             if len(ins) == 1 and len(outs) == 1:
                 continue  # ownership transfer: no range part
             if p.range_correctness is None \
@@ -187,8 +177,6 @@ class ZKVerifier:
                 owners.append(("t", k))
         for k in sorted(i_proofs):
             p, (_, coms) = i_proofs[k], issues[k]
-            if not sigma_ok_i[k]:
-                continue
             if p.range_correctness is None \
                     or len(p.range_correctness.proofs) != len(coms):
                 sigma_ok_i[k] = False
@@ -199,9 +187,31 @@ class ZKVerifier:
                 raw_pts.append(c)
                 raw_ctts.append(ctt)
                 owners.append(("i", k))
+
+        # 3. dispatch all three device phases back-to-back, collect in
+        # dependency order: the commitment adjustment first (it gates the
+        # range pass-1 marshal), the Σ verdicts last (nothing reads them
+        # until the final combine). Host challenge re-derivation for Σ
+        # overlaps the range pass's device tail.
+        adjust_collect = adjust_points_async(raw_pts, raw_ctts)
+        ts_items = [(t_proofs[k].type_and_sum, transfers[k][1],
+                     transfers[k][2]) for k in sorted(t_proofs)]
+        st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
+        ts_collect = self._sigma.verify_type_and_sum_async(ts_items)
+        st_collect = self._sigma.verify_same_type_async(st_items)
+
+        accepts = None
         if range_proofs:
-            range_coms = adjust_points(raw_pts, raw_ctts)
+            range_coms = adjust_collect()
             accepts = self._range.verify(range_proofs, range_coms)
+
+        ts_acc = ts_collect()
+        st_acc = st_collect()
+        for j, k in enumerate(sorted(t_proofs)):
+            sigma_ok_t[k] = sigma_ok_t[k] and bool(ts_acc[j])
+        for j, k in enumerate(sorted(i_proofs)):
+            sigma_ok_i[k] = sigma_ok_i[k] and bool(st_acc[j])
+        if accepts is not None:
             for acc, (kind, k) in zip(accepts, owners):
                 if not acc:
                     if kind == "t":

@@ -33,36 +33,54 @@ def _adjust_kernel(a, b):
     return ec.to_affine_batch(out[None])[0]
 
 
-def adjust_points(points: list, minus: list) -> list:
-    """Element-wise points[i] - minus[i] -> host G1 list.
+def adjust_points_async(points: list, minus: list):
+    """Dispatch the device adjustment and return a collect() closure.
 
-    One device pass for large batches; the host oracle path for small
-    ones (per-request latency: two bigint adds beat a tunnel dispatch).
+    The kernel call and the device->host copy go out immediately
+    (copy_to_host_async); the returned closure blocks only when the host
+    points are actually needed — callers overlap other dispatches (the
+    Σ batch, the range pass-1 marshal) with the transfer.
     """
     n = len(points)
     assert len(minus) == n
-    if n == 0:
-        return []
-    if n < _HOST_THRESHOLD:
-        return [g1_add(p, g1_neg(m)) for p, m in zip(points, minus)]
+    if n == 0 or n < _HOST_THRESHOLD:
+        out = [g1_add(p, g1_neg(m)) for p, m in zip(points, minus)]
+        return lambda: out
     nb = bucket_rows(n)
     arr_a = np.zeros((nb, 3, limbs.NLIMBS), dtype=np.uint32)
     arr_b = np.zeros((nb, 3, limbs.NLIMBS), dtype=np.uint32)
     arr_a[:n] = limbs.points_to_projective_limbs(list(points))
     arr_b[:n] = limbs.points_to_projective_limbs(list(minus))
     aff = _adjust_kernel(jnp.asarray(arr_a), jnp.asarray(arr_b))
-    enc = affine_batch_to_bytes(np.asarray(aff)[:n])
-    zero = b"\x00" * ser.G1_BYTES_LEN
-    out = []
-    for i in range(n):
-        raw = enc[i].tobytes()
-        if raw == zero:
-            out.append(bn254.G1_IDENTITY)
-        else:
-            # device output is on-curve by construction; skip the check
-            out.append(bn254.G1(int.from_bytes(raw[:32], "big"),
-                                int.from_bytes(raw[32:], "big")))
-    return out
+    try:
+        aff.copy_to_host_async()
+    except (AttributeError, NotImplementedError, TypeError):
+        pass
+
+    def collect() -> list:
+        enc = affine_batch_to_bytes(np.asarray(aff)[:n])
+        zero = b"\x00" * ser.G1_BYTES_LEN
+        out = []
+        for i in range(n):
+            raw = enc[i].tobytes()
+            if raw == zero:
+                out.append(bn254.G1_IDENTITY)
+            else:
+                # device output is on-curve by construction; skip the check
+                out.append(bn254.G1(int.from_bytes(raw[:32], "big"),
+                                    int.from_bytes(raw[32:], "big")))
+        return out
+
+    return collect
+
+
+def adjust_points(points: list, minus: list) -> list:
+    """Element-wise points[i] - minus[i] -> host G1 list.
+
+    One device pass for large batches; the host oracle path for small
+    ones (per-request latency: two bigint adds beat a tunnel dispatch).
+    """
+    return adjust_points_async(points, minus)()
 
 
 def prewarm(batch_sizes=(1024,)) -> None:

@@ -102,6 +102,14 @@ def _tas_block_kernel(tables, ptp, cttp, valid, out_slot, var_sel,
     return _limbs_to_bytes_dev(ec.to_affine_batch(allp[None])[0])
 
 
+def _start_host_copy(arr) -> None:
+    """Fire the device->host transfer without blocking (best-effort)."""
+    try:
+        arr.copy_to_host_async()
+    except (AttributeError, NotImplementedError, TypeError):
+        pass
+
+
 @dataclass(frozen=True)
 class _Row:
     """One recomputed commitment: fixed scalars + var point + var scalar."""
@@ -152,8 +160,11 @@ class BatchSigmaVerifier:
             self.verify_type_and_sum([mk(3, 1)] * b)
 
     # ------------------------------------------------------------ device
-    def _run_rows(self, rows: list[_Row]) -> np.ndarray:
-        """(R, 64)-byte affine encodings for every row, device-computed."""
+    def _run_rows_async(self, rows: list[_Row]):
+        """Dispatch the row kernel; returns collect() -> (R, 64) bytes.
+
+        The device->host copy is started immediately, so callers can
+        overlap further dispatches/marshal with the transfer."""
         r_bucket = _bucket_rows(max(1, len(rows)))
         fixed = np.zeros((r_bucket, 3, limbs.NLIMBS), dtype=np.uint32)
         var_sc = np.zeros((r_bucket, limbs.NLIMBS), dtype=np.uint32)
@@ -166,11 +177,16 @@ class BatchSigmaVerifier:
             var_sc[i] = limbs.scalars_to_limbs([row.var_scalar])[0]
         aff = _sigma_rows_kernel(self.tables, jnp.asarray(fixed),
                                  jnp.asarray(var_pts), jnp.asarray(var_sc))
-        return affine_batch_to_bytes(np.asarray(aff)[:len(rows)])
+        _start_host_copy(aff)
+        return lambda: affine_batch_to_bytes(np.asarray(aff)[:len(rows)])
+
+    def _run_rows(self, rows: list[_Row]) -> np.ndarray:
+        """(R, 64)-byte affine encodings for every row, device-computed."""
+        return self._run_rows_async(rows)()
 
     # ------------------------------------------------------- same-type
-    def verify_same_type(self, proofs: list) -> np.ndarray:
-        """Batch of issue SameTypeProof -> bool accept vector."""
+    def verify_same_type_async(self, proofs: list):
+        """Dispatch the same-type batch; returns collect() -> accepts."""
         B = len(proofs)
         ok = np.zeros(B, dtype=bool)
         rows, live = [], []
@@ -183,25 +199,37 @@ class BatchSigmaVerifier:
                              var_point=p.commitment_to_type,
                              var_scalar=fr_neg(p.challenge)))
         if not live:
+            return lambda: ok
+        handle = self._run_rows_async(rows)
+
+        def collect() -> np.ndarray:
+            enc = handle()
+            for row_i, i in enumerate(live):
+                p = proofs[i]
+                com_hex = bytes(enc[row_i]).hex().encode("ascii")
+                transcript = ser.SEPARATOR.join(
+                    [ser.g1_to_bytes(
+                        p.commitment_to_type).hex().encode("ascii"),
+                     com_hex])
+                ok[i] = hash_to_zr(transcript) == p.challenge
             return ok
-        enc = self._run_rows(rows)
-        for row_i, i in enumerate(live):
-            p = proofs[i]
-            com_hex = bytes(enc[row_i]).hex().encode("ascii")
-            transcript = ser.SEPARATOR.join(
-                [ser.g1_to_bytes(p.commitment_to_type).hex().encode("ascii"),
-                 com_hex])
-            ok[i] = hash_to_zr(transcript) == p.challenge
-        return ok
+
+        return collect
+
+    def verify_same_type(self, proofs: list) -> np.ndarray:
+        """Batch of issue SameTypeProof -> bool accept vector."""
+        return self.verify_same_type_async(proofs)()
 
     # --------------------------------------------------- type-and-sum
-    def verify_type_and_sum(self, items: list) -> np.ndarray:
-        """items: (TypeAndSumProof, inputs, outputs) triples -> accepts.
+    def verify_type_and_sum_async(self, items: list):
+        """Dispatch the type-and-sum batch; returns collect() -> accepts.
 
         The adjusted commitments, their signed sum, and every Σ-row
         commitment are computed in one device program
         (_tas_block_kernel); the host only packs limbs, hexes the
-        returned byte rows, and re-derives the Fiat-Shamir challenges."""
+        returned byte rows, and re-derives the Fiat-Shamir challenges.
+        Dispatch and challenge re-derivation are split so callers can
+        overlap other device/host work with the kernel + transfer."""
         B = len(items)
         ok = np.zeros(B, dtype=bool)
         live = []
@@ -216,7 +244,7 @@ class BatchSigmaVerifier:
                 continue
             live.append((i, p, inputs, outputs))
         if not live:
-            return ok
+            return lambda: ok
         NL = limbs.NLIMBS
         A = len(live)
         A_b = _bucket_rows(A)
@@ -280,20 +308,29 @@ class BatchSigmaVerifier:
             self.tables, jnp.asarray(ptp), jnp.asarray(cttp),
             jnp.asarray(valid), jnp.asarray(out_slot),
             jnp.asarray(var_sel), jnp.asarray(fixed), jnp.asarray(var_sc))
-        hx = hex_ascii(np.asarray(enc))
-        adj0, sum0 = R_b, R_b + A_b * K_b
-        for i, a, n_in, n_out, r0 in meta:
-            p = items[i][0]
-            in_hex = [hx[r0 + j].tobytes() for j in range(n_in)]
-            sum_hex = hx[r0 + n_in].tobytes()
-            type_hex = hx[r0 + n_in + 1].tobytes()
-            adj_hex = [hx[adj0 + a * K_b + j].tobytes()
-                       for j in range(n_in + n_out)]
-            # transcript order per typeandsum.go:214,267
-            transcript = ser.SEPARATOR.join(
-                in_hex + [type_hex, sum_hex] + adj_hex
-                + [ser.g1_to_bytes(
-                    p.commitment_to_type).hex().encode("ascii"),
-                   hx[sum0 + a].tobytes()])
-            ok[i] = hash_to_zr(transcript) == p.challenge
-        return ok
+        _start_host_copy(enc)
+
+        def collect() -> np.ndarray:
+            hx = hex_ascii(np.asarray(enc))
+            adj0, sum0 = R_b, R_b + A_b * K_b
+            for i, a, n_in, n_out, r0 in meta:
+                p = items[i][0]
+                in_hex = [hx[r0 + j].tobytes() for j in range(n_in)]
+                sum_hex = hx[r0 + n_in].tobytes()
+                type_hex = hx[r0 + n_in + 1].tobytes()
+                adj_hex = [hx[adj0 + a * K_b + j].tobytes()
+                           for j in range(n_in + n_out)]
+                # transcript order per typeandsum.go:214,267
+                transcript = ser.SEPARATOR.join(
+                    in_hex + [type_hex, sum_hex] + adj_hex
+                    + [ser.g1_to_bytes(
+                        p.commitment_to_type).hex().encode("ascii"),
+                       hx[sum0 + a].tobytes()])
+                ok[i] = hash_to_zr(transcript) == p.challenge
+            return ok
+
+        return collect
+
+    def verify_type_and_sum(self, items: list) -> np.ndarray:
+        """items: (TypeAndSumProof, inputs, outputs) triples -> accepts."""
+        return self.verify_type_and_sum_async(items)()

@@ -284,18 +284,22 @@ _VAR_KEEP = 128
 
 
 def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
-                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int,
+                    keep: int = _VAR_KEEP):
     """One term-block: 4-bit-window Horner over a VMEM multiple table.
 
     pts_ref:    (48, VAR_BLOCK) uint32 transposed projective points.
     digits_ref: (windows, 1, VAR_BLOCK) int32 — 4-bit digits, LSB-first
         window index on the LEADING axis (dynamic indexing inside the
         window loop must hit a non-tiled dim).
-    out_ref:    (1, 48, _VAR_KEEP) uint32 — this block's partial sum,
-        spread over _VAR_KEEP lanes (callers fold the lanes + blocks).
+    out_ref:    (1, 48, keep) uint32 — this block's partial sum, spread
+        over `keep` lanes (callers fold the lanes + blocks; with
+        keep = VAR_BLOCK // 2 and lanes laid out [term0 | term1] the
+        halving fold makes lane i the per-row pair sum — the
+        mul2_rows_fused grouping).
 
-    Per window (MSB-first): 16-entry masked select per lane, two halving
-    adds down to _VAR_KEEP lanes, then acc = 16*acc + partial. The whole
+    Per window (MSB-first): 16-entry masked select per lane, halving
+    adds down to `keep` lanes, then acc = 16*acc + partial. The whole
     walk — table build, selects, folds, doublings — stays in VMEM; the
     XLA path materializes each of these in HBM.
     """
@@ -319,7 +323,7 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         for e in range(1, 16):
             sel = jnp.where(d[None, :] == e, tbl[e], sel)
         lanes = bV
-        while lanes > _VAR_KEEP:                          # halving folds
+        while lanes > keep:                               # halving folds
             half = lanes // 2
             sel = tec.add(sel[..., :half], sel[..., half:lanes], cc)
             lanes = half
@@ -328,7 +332,7 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         return tec.add(acc, sel, cc)
 
     out_ref[0] = jax.lax.fori_loop(0, windows, body,
-                                   tec.identity(_VAR_KEEP, cc))
+                                   tec.identity(keep, cc))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -382,3 +386,67 @@ def msm_var_fused(points: jnp.ndarray, scalars: jnp.ndarray,
     flat = jnp.transpose(partials, (0, 2, 1)).reshape(
         nblocks * _VAR_KEEP, 3, N)
     return ec._tree_sum_shrink(flat)
+
+
+#: rows per grid block of the paired per-row mul (two term lanes per row).
+_PAIR_ROWS = VAR_BLOCK // 2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def mul2_rows_fused(points: jnp.ndarray, scalars: jnp.ndarray,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Per-row 2-term MSM: out[b] = sc[b,0]*pts[b,0] + sc[b,1]*pts[b,1].
+
+    points: (B, 2, 3, 16) Montgomery projective; scalars: (B, 2, 16)
+    plain limbs -> (B, 3, 16). Drop-in for ec.msm_windowed on a 2-term
+    axis, but the whole Horner walk runs in VMEM via _msm_var_kernel
+    with keep = rows-per-block: lanes are laid out [term0 rows | term1
+    rows] inside each block, so the kernel's halving fold lands lane i
+    on row i's pair sum. Replaces the XLA double-and-add chain (the
+    K-equation's x*D + C), which is dispatch-overhead-bound at chunk
+    shapes (measured 21.5 ms per 256-row chunk vs ~6 ms fused).
+    """
+    from jax.experimental import pallas as pl
+
+    from . import ec
+
+    B = points.shape[0]
+    pad = (-B) % _PAIR_ROWS
+    if pad:
+        points = jnp.concatenate([points, ec.identity((pad, 2))], axis=0)
+        scalars = jnp.concatenate(
+            [scalars, jnp.zeros((pad, 2, N), dtype=scalars.dtype)], axis=0)
+    Bp = B + pad
+    nblocks = Bp // _PAIR_ROWS
+    # (nblocks, 2, _PAIR_ROWS, 48): block-major, term-major inside a block
+    pts_b = jnp.transpose(
+        points.reshape(nblocks, _PAIR_ROWS, 2, 48), (0, 2, 1, 3))
+    pts_t = jnp.transpose(pts_b.reshape(nblocks * VAR_BLOCK, 48), (1, 0))
+    digits = ec.window_digits4(scalars)                   # (Bp, 2, W)
+    W = digits.shape[-1]
+    dig_b = jnp.transpose(
+        digits.reshape(nblocks, _PAIR_ROWS, 2, W), (3, 0, 2, 1))
+    digits_t = dig_b.reshape(W, 1, nblocks * VAR_BLOCK).astype(jnp.int32)
+
+    cc = tec.make_consts()
+    consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
+              cc.ts.w_mod, cc.b3)
+    const_specs = [
+        pl.BlockSpec(c.shape, lambda b, *, _nd=c.ndim: (0,) * _nd)
+        for c in consts
+    ]
+    out = pl.pallas_call(
+        functools.partial(_msm_var_kernel, windows=W, keep=_PAIR_ROWS),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((48, VAR_BLOCK), lambda b: (0, b)),
+            pl.BlockSpec((W, 1, VAR_BLOCK), lambda b: (0, 0, b)),
+            *const_specs,
+        ],
+        out_specs=pl.BlockSpec((1, 48, _PAIR_ROWS), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nblocks, 48, _PAIR_ROWS),
+                                       jnp.uint32),
+        interpret=interpret,
+    )(pts_t, digits_t, *consts)
+    flat = jnp.transpose(out, (0, 2, 1)).reshape(Bp, 3, N)
+    return flat[:B]

@@ -1,0 +1,80 @@
+"""Phase profile of ZKVerifier.verify_block at bench config-3 shapes.
+
+Times each stage of the block path separately (deserialize, sigma device
+pass, point adjustment, range batch) to locate the gap between the
+679 proofs/s block number and the 1,948 proofs/s pure-range headline.
+Run on the chip: python perf_block_profile.py
+"""
+import pickle
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+BENCH_DIR = Path(__file__).parent / "benchdata"
+BIT_LENGTH = 64
+BATCH = 1024
+
+from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+from fabric_token_sdk_tpu.core.zkatdlog import verifier as vmod
+from fabric_token_sdk_tpu.crypto import setup, transfer_proof, issue_proof
+from fabric_token_sdk_tpu.models.adjust import adjust_points
+
+
+def main():
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    blob = pickle.loads((BENCH_DIR / f"block_{BIT_LENGTH}.pkl").read_bytes())
+    base_t, base_i = blob["transfers"], blob["issues"]
+    slice_t = (base_t * (BATCH // 4 // len(base_t) + 1))[:BATCH // 4]
+    slice_i = (base_i * (BATCH // 4 // len(base_i) + 1))[:BATCH // 4]
+    zk = ZKVerifier(pp, device=True)
+
+    # warm-up (compiles everything)
+    t0 = time.perf_counter()
+    t_ok, i_ok = zk.verify_block(slice_t, slice_i)
+    assert t_ok.all() and i_ok.all()
+    print(f"warm-up {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    for rep in range(3):
+        t0 = time.perf_counter()
+        t_proofs = {k: transfer_proof.TransferProof.deserialize(raw)
+                    for k, (raw, _, _) in enumerate(slice_t)}
+        i_proofs = {k: issue_proof.IssueProof.deserialize(raw)
+                    for k, (raw, _) in enumerate(slice_i)}
+        t1 = time.perf_counter()
+        ts_items = [(t_proofs[k].type_and_sum, slice_t[k][1], slice_t[k][2])
+                    for k in sorted(t_proofs)]
+        st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
+        ts_acc = zk._sigma.verify_type_and_sum(ts_items)
+        st_acc = zk._sigma.verify_same_type(st_items)
+        assert all(ts_acc) and all(st_acc)
+        t2 = time.perf_counter()
+        range_proofs, raw_pts, raw_ctts = [], [], []
+        for k in sorted(t_proofs):
+            p, (_, ins, outs) = t_proofs[k], slice_t[k]
+            ctt = p.type_and_sum.commitment_to_type
+            for o, rpp in zip(outs, p.range_correctness.proofs):
+                range_proofs.append(rpp)
+                raw_pts.append(o)
+                raw_ctts.append(ctt)
+        for k in sorted(i_proofs):
+            p, (_, coms) = i_proofs[k], slice_i[k]
+            ctt = p.same_type.commitment_to_type
+            for c, rpp in zip(coms, p.range_correctness.proofs):
+                range_proofs.append(rpp)
+                raw_pts.append(c)
+                raw_ctts.append(ctt)
+        t3 = time.perf_counter()
+        range_coms = adjust_points(raw_pts, raw_ctts)
+        t4 = time.perf_counter()
+        accepts = zk._range.verify(range_proofs, range_coms)
+        assert accepts.all()
+        t5 = time.perf_counter()
+        print(f"rep{rep}: total {t5-t0:.3f}s | deser {t1-t0:.3f} "
+              f"sigma {t2-t1:.3f} assemble {t3-t2:.3f} "
+              f"adjust {t4-t3:.3f} range[{len(range_proofs)}] {t5-t4:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Steady-state device times of the production verify kernels at chunk
+shapes (B=256 bucket), with the ~100 ms tunnel sync cost measured and
+reported separately. Run on the chip.
+"""
+import sys
+import time
+
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+configure_jax_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bench import _load  # noqa: E402
+from fabric_token_sdk_tpu.models import range_verifier as rv  # noqa: E402
+
+
+def timeit(label, fn, iters=6):
+    fn()  # warm
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"  {label:>28}: {dt*1e3:7.1f} ms")
+    return dt
+
+
+def main():
+    pp, proofs, coms = _load()
+    reps = (1024 + len(proofs) - 1) // len(proofs)
+    proofs = (proofs * reps)[:1024]
+    coms = (coms * reps)[:1024]
+    v = rv.BatchRangeVerifier(pp)
+    out = v.verify(proofs, coms)
+    assert out.all()
+    params = v.params
+
+    # sync-only baseline
+    x = jnp.zeros((8,), dtype=jnp.uint32)
+    timeit("noop sync", lambda: jnp.sum(x))
+
+    ch = list(range(256))
+    st = v._dispatch_pass1(proofs, coms, ch)
+    transcripts, digests_dev, rdig_dev, pts_dev = st
+    jax.block_until_ready(digests_dev)
+
+    # rebuild the packed upload once, then rerun the fused program
+    run, nv_, o_inf, o_ip = rv._pass1_fused_fn(params)
+    # capture the packed array by re-marshalling (same code as dispatch)
+    import numpy as _np
+    packed = v._last_packed if hasattr(v, "_last_packed") else None
+    if packed is None:
+        # re-create via dispatch internals: cheat — time dispatch whole
+        pass
+
+    def full_pass1():
+        st2 = v._dispatch_pass1(proofs, coms, ch)
+        return st2[1]
+
+    timeit("dispatch+pass1 (256)", full_pass1, iters=4)
+
+    # combined chunk (var-MSM partial): host weight + dispatch + run
+    from fabric_token_sdk_tpu.ops import sha256 as dsha
+    eqs = v._host_stage2(proofs, ch, st)
+    n_fixed = 2 * params.bit_length + 5
+    acc0 = bytes(32 * n_fixed)
+
+    def comb():
+        _, part = v._combined_chunk(proofs, coms, ch, eqs, acc0, pts_dev)
+        return part
+
+    timeit("weight+var-MSM (256)", comb, iters=4)
+
+    acc, part = v._combined_chunk(proofs, coms, ch, eqs, acc0, pts_dev)
+    timeit("finalize", lambda: rv._finalize_kernel(
+        params.tables, jnp.asarray(rv.limbs.packed_to_limbs(acc)),
+        jnp.stack([part])), iters=4)
+
+    # pure kernel: pass-1 fused program with a FIXED packed input (no
+    # host marshal) — measures device compute + queue only
+    # marshal once using the internals of _dispatch_pass1:
+    import types
+    # time host marshal alone by subtracting: dispatch includes marshal.
+
+    print("reference: pipelined verify at B=1024:")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = v.verify(proofs, coms)
+        dt = time.perf_counter() - t0
+        print(f"  total {dt*1e3:.0f} ms ({1024/dt:.0f}/s) path={v.last_path}")
+
+
+if __name__ == "__main__":
+    main()

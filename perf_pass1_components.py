@@ -1,0 +1,105 @@
+"""Standalone device times of each sub-stage of the fused pass-1 program
+at chunk shape B=256, to find where the ~70 ms/chunk goes. Chip only.
+"""
+import time
+
+from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+configure_jax_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bench import _load  # noqa: E402
+from fabric_token_sdk_tpu.models import range_verifier as rv  # noqa: E402
+from fabric_token_sdk_tpu.ops import ec, limbs, pallas_fb  # noqa: E402
+
+B = 256
+
+
+def timeit(label, fn, iters=8):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"  {label:>28}: {dt*1e3:7.2f} ms")
+    return out
+
+
+def main():
+    pp, proofs, coms = _load()
+    reps = (B + len(proofs) - 1) // len(proofs)
+    proofs = (proofs * reps)[:B]
+    coms = (coms * reps)[:B]
+    v = rv.BatchRangeVerifier(pp)
+    params = v.params
+    n = params.bit_length
+    nv = 2 + 2 * params.rounds + 3
+
+    ch = list(range(B))
+    st = v._dispatch_pass1(proofs, coms, ch)
+    jax.block_until_ready(st[1])
+
+    # Build the same inputs the fused program sees
+    rng = np.random.default_rng(0)
+    sc4 = jnp.asarray(rng.integers(0, 2**16, (B, 4, 16), dtype=np.uint32))
+    allpts = []
+    for i in ch:
+        d = proofs[i].data
+        allpts += ([d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R
+                   + [d.T1, d.T2, coms[i]])
+    proj = limbs.points_to_projective_limbs(allpts).reshape(B, nv, 3, 16)
+    inf_np = (proj[:, :, 2] == 0).all(-1).astype(np.uint8)
+    xy = jnp.asarray(proj[:, :, :2])
+    inf = jnp.asarray(inf_np)
+    ip_u8 = jnp.asarray(rng.integers(0, 255, (B, 32), dtype=np.uint8))
+
+    derive = jax.jit(lambda s: rv._derive_pass1_scalars(s, n))
+    yinv, k_fixed, dc_sc = timeit("derive_pass1_scalars", lambda: derive(sc4))
+    pts = timeit("reconstruct_points", lambda: rv._reconstruct_points(xy, inf))
+
+    gather = jax.jit(lambda t, y: pallas_fb.fixed_base_gather_fused(t, y))
+    rgp_pts = timeit("rgp gather (pallas)",
+                     lambda: gather(params.tables_t_rgp, yinv))
+
+    kmsm = jax.jit(lambda t, s: pallas_fb.fixed_base_msm_fused(t, s))
+    k1 = timeit("K fixed MSM (pallas)",
+                lambda: kmsm(params.tables_t_k, k_fixed))
+    kvar = jax.jit(lambda p, s: ec.msm_windowed(p, s))
+    k2 = timeit("K var 2-term (xla)", lambda: kvar(pts[:, :2], dc_sc))
+
+    aff_b = jax.jit(lambda p: ec.to_affine_batch(p))
+    rgp_aff = timeit("to_affine_batch(rgp 64)", lambda: aff_b(rgp_pts))
+
+    tab = jax.jit(lambda p: rv._limbs_to_bytes_dev(ec.to_affine_batch(p)))
+    rgp_bytes = timeit("affine+bytes rgp", lambda: tab(rgp_pts))
+    k_pt = ec.add(k1, k2)
+    tak = jax.jit(lambda p: rv._limbs_to_bytes_dev(ec.to_affine(p)))
+    k_bytes = timeit("affine+bytes K", lambda: tak(k_pt))
+
+    xipa = rv._xipa_device_fn(params)
+    timeit("xipa SHA", lambda: xipa(rgp_bytes, k_bytes, ip_u8))
+
+    rdig = jax.jit(lambda a, b: rv._round_digests(a, b, params.rounds))
+    timeit("round digests SHA", lambda: rdig(xy, inf))
+
+    # whole fused program for comparison
+    run, nv_, o_inf, o_ip = rv._pass1_fused_fn(params)
+    packed = np.zeros((B, o_ip + 8), dtype=np.uint32)
+    packed[:, :64] = np.asarray(sc4).reshape(B, 64)
+    xyu16 = proj[:, :, :2].astype("<u2")
+    packed[:, 64:o_inf] = np.ascontiguousarray(
+        xyu16.reshape(B, -1)).view("<u4")
+    packed[:, o_inf:o_ip] = inf_np
+    packed[:, o_ip:] = np.ascontiguousarray(np.asarray(ip_u8)).view("<u4")
+    pk = jnp.asarray(packed)
+    timeit("FULL fused pass-1", lambda: run(
+        params.tables_t_rgp, params.tables_t_k, pk), iters=4)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Micro-profile of the host stage-2 path (phase-b + RLC weighting) for
+one 256-row chunk of the bench corpus — splits native-C compute from
+Python glue to size the batching win. Host-only (no device needed): uses
+host-computed challenges instead of device digests.
+"""
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from bench import _load
+from fabric_token_sdk_tpu.models import range_verifier as rv
+from fabric_token_sdk_tpu.ops import limbs
+from fabric_token_sdk_tpu.crypto import serialization as ser
+
+_FR = rv._FRNATIVE
+R = rv.R
+
+
+def main():
+    pp, proofs, coms = _load()
+    reps = (256 + len(proofs) - 1) // len(proofs)
+    proofs = (proofs * reps)[:256]
+    coms = (coms * reps)[:256]
+    params = rv._params_for(pp)
+    ch = list(range(256))
+    rr = params.rounds
+
+    # phase-a (not the target, but time it)
+    t0 = time.perf_counter()
+    xyz = rv._phase_a_challenges_batch(proofs, coms, ch)
+    transcripts = {i: rv._host_phase_a(proofs[i], coms[i], params,
+                                       xyz=xyz[row])
+                   for row, i in enumerate(ch)}
+    t1 = time.perf_counter()
+    print(f"phase-a total: {(t1-t0)*1e3:.1f} ms")
+
+    # challenges (host path)
+    t0 = time.perf_counter()
+    rch = rv._round_challenges_batch(proofs, ch, rr)
+    t1 = time.perf_counter()
+    print(f"round challenges (host sha): {(t1-t0)*1e3:.1f} ms")
+
+    # x_ipa: fake with fixed ints (value irrelevant for timing)
+    x_ipa = [12345678901234567890 + i for i in ch]
+
+    # --- stage-2 proper -------------------------------------------------
+    for rep in range(3):
+        t0 = time.perf_counter()
+        ch_packed_all = limbs.pack_scalars(
+            [rch[row, r] for row in range(len(ch)) for r in range(rr)])
+        t1 = time.perf_counter()
+        inv_packed_all = _FR.batch_inv(ch_packed_all)
+        t2 = time.perf_counter()
+
+        # per-proof phase_b: split glue (pack_scalars) from the C call
+        glue = 0.0
+        cc = 0.0
+        eqs = {}
+        for row, i in enumerate(ch):
+            ts = transcripts[i]
+            proof = proofs[i]
+            d = proof.data
+            ipa = proof.ipa
+            sl = slice(row * rr * 32, (row + 1) * rr * 32)
+            g0 = time.perf_counter()
+            scalars = limbs.pack_scalars(
+                [ipa.left, ipa.right, ts.z, ts.x, x_ipa[row],
+                 d.inner_product, d.tau, d.delta]) + ts.pol_eval_packed
+            g1 = time.perf_counter()
+            out = _FR.phase_b(64, rr, scalars, ts.yinv_packed,
+                              ch_packed_all[sl], inv_packed_all[sl])
+            g2 = time.perf_counter()
+            split = (2 * 64 + 5) * 32
+            eqs[i] = rv._ProofEquations(fixed=[], var=[],
+                                        fixed_packed=out[:split],
+                                        var_packed=out[split:])
+            glue += g1 - g0
+            cc += g2 - g1
+        t3 = time.perf_counter()
+
+        # weighting loop (as _weight_equations does)
+        import secrets
+        n = 64
+        n_eq2 = 2 + 2 * rr
+        n_fixed = 2 * n + 5
+        fixed_acc = bytes(32 * n_fixed)
+        zero32 = bytes(32)
+        w_t = am_t = mm_t = 0.0
+        var_sc_packed = []
+        for i in ch:
+            w0 = time.perf_counter()
+            w1 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
+            w2 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
+            eq = eqs[i]
+            weights = w2 * (2 * n + 2) + w1 * 2 + zero32
+            w1t = time.perf_counter()
+            fixed_acc = _FR.addmul_many(fixed_acc, eq.fixed_packed, weights)
+            w2t = time.perf_counter()
+            var_sc_packed.append(_FR.mul_many(
+                eq.var_packed, w2 * n_eq2 + w1 * 3))
+            w3t = time.perf_counter()
+            w_t += w1t - w0
+            am_t += w2t - w1t
+            mm_t += w3t - w2t
+        sc_blob = b"".join(var_sc_packed)
+        arr = limbs.packed_to_limbs(sc_blob)
+        t4 = time.perf_counter()
+
+        print(f"rep{rep}: stage2 {(t4-t0)*1e3:.1f} ms | "
+              f"pack-ch {(t1-t0)*1e3:.1f} inv {(t2-t1)*1e3:.1f} "
+              f"phase_b loop {(t3-t2)*1e3:.1f} (glue {glue*1e3:.1f}, "
+              f"C {cc*1e3:.1f}) weight {(t4-t3)*1e3:.1f} "
+              f"(rand+bytes {w_t*1e3:.1f}, addmul {am_t*1e3:.1f}, "
+              f"mul {mm_t*1e3:.1f})")
+
+
+if __name__ == "__main__":
+    main()

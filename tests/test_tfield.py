@@ -173,3 +173,22 @@ class TestFusedVarMSM:
             jnp.asarray(L.scalars_to_limbs(sc)), interpret=True))
         want = bn254.msm(pts[:-1], sc[:-1])
         assert _same(L.projective_limbs_to_point(got), want)
+
+    def test_mul2_rows_parity(self):
+        """Per-row paired mul (the K-equation's x*D + C) vs the host
+        oracle: includes an identity point, a zero scalar, and a
+        scalar-1 row; B pads to the kernel's row block."""
+        B = 5
+        pts = _rand_pts(2 * B)
+        pts[3] = bn254.G1_IDENTITY
+        sc = [secrets.randbelow(bn254.R) for _ in range(2 * B)]
+        sc[1] = 1
+        sc[4] = 0
+        proj = jnp.asarray(
+            L.points_to_projective_limbs(pts).reshape(B, 2, 3, 16))
+        sc_l = jnp.asarray(L.scalars_to_limbs(sc).reshape(B, 2, 16))
+        got = np.asarray(
+            pallas_fb.mul2_rows_fused(proj, sc_l, interpret=True))
+        for b in range(B):
+            want = bn254.msm(pts[2 * b:2 * b + 2], sc[2 * b:2 * b + 2])
+            assert _same(L.projective_limbs_to_point(got[b]), want), b
