@@ -277,6 +277,20 @@ def _bench_adversarial():
             "vs_baseline": round(BATCH / elapsed / TARGET_BASELINE, 4)}))
 
 
+def _write_obs_report() -> None:
+    """With BENCH_OBS_OUT=<path> set, dump the observability registry
+    (pipeline batch records, pad waste, compile counts, latency
+    percentiles) next to the headline JSON line after any bench mode."""
+    path = os.environ.get("BENCH_OBS_OUT")
+    if not path:
+        return
+    from fabric_token_sdk_tpu.obs import write_bench_report
+
+    write_bench_report(path, extra={"bench_batch": BATCH,
+                                    "bit_length": BIT_LENGTH})
+    print(f"bench: obs report written to {path}", file=sys.stderr)
+
+
 def main():
     if "--regen" in sys.argv:
         _regen()
@@ -354,4 +368,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        _write_obs_report()

@@ -19,13 +19,23 @@ import numpy as np
 from ...crypto import issue_proof, rp, transfer_proof
 from ...crypto.bn254 import G1
 from ...crypto.rp import ProofError
-from ...models.adjust import adjust_points
+from ...models.adjust import adjust_points, adjust_points_async
+from ...obs import GLOBAL as _METRICS
+from ...obs import TRACER as _TRACER
 
 logger = logging.getLogger("fabric_token_sdk_tpu.zkverifier")
 
-#: Count of device-reject / host-accept disagreements (should stay 0; tests
-#: assert it never moves on honest input). Exposed for metrics scraping.
-DEVICE_DISAGREEMENTS = 0
+
+def __getattr__(name: str):
+    # Back-compat for the old module-global disagreement count: the value
+    # now lives in the metrics registry (one source of truth, resettable
+    # via metrics.GLOBAL.reset() between tests).
+    if name == "DEVICE_DISAGREEMENTS":
+        # read-only peek: must not (re)register the family
+        return int(_METRICS.snapshot().get(
+            ("zk_device_oracle_disagreements_total", ()), 0))
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 class ZKVerifier:
@@ -66,6 +76,12 @@ class ZKVerifier:
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
                         outputs: list[G1]) -> None:
         """transfer.go:153-197 semantics; range part batched on device."""
+        with _TRACER.span("zk.verify_transfer", inputs=len(inputs),
+                          outputs=len(outputs)):
+            self._verify_transfer_inner(proof_raw, inputs, outputs)
+
+    def _verify_transfer_inner(self, proof_raw: bytes, inputs: list[G1],
+                               outputs: list[G1]) -> None:
         if self._range is None:
             transfer_proof.transfer_verify(proof_raw, inputs, outputs, self.pp)
             return
@@ -89,6 +105,11 @@ class ZKVerifier:
     # --------------------------------------------------------------- issue
     def verify_issue(self, proof_raw: bytes, commitments: list[G1]) -> None:
         """issue/verifier.go:32-57 semantics; range part batched on device."""
+        with _TRACER.span("zk.verify_issue", commitments=len(commitments)):
+            self._verify_issue_inner(proof_raw, commitments)
+
+    def _verify_issue_inner(self, proof_raw: bytes,
+                            commitments: list[G1]) -> None:
         if self._range is None:
             issue_proof.issue_verify(proof_raw, commitments, self.pp)
             return
@@ -120,6 +141,19 @@ class ZKVerifier:
         verification only happens on rejects (exact error reproduction is
         the per-action APIs' job; this is the throughput path).
         """
+        with _TRACER.span("zk.verify_block", transfers=len(transfers),
+                          issues=len(issues)) as blk_span:
+            t_ok, i_ok = self._verify_block_inner(transfers, issues,
+                                                  blk_span)
+        _METRICS.counter("zk_blocks_verified_total").add()
+        _METRICS.counter("zk_block_actions_total", status="accepted").add(
+            int(t_ok.sum()) + int(i_ok.sum()))
+        _METRICS.counter("zk_block_actions_total", status="rejected").add(
+            int((~t_ok).sum()) + int((~i_ok).sum()))
+        return t_ok, i_ok
+
+    def _verify_block_inner(self, transfers: list, issues: list,
+                            blk_span) -> "tuple":
         t_ok = np.zeros(len(transfers), dtype=bool)
         i_ok = np.zeros(len(issues), dtype=bool)
         if self._range is None or self._sigma is None:
@@ -140,18 +174,19 @@ class ZKVerifier:
         # 1. deserialize; structural failures stay rejected
         t_proofs: dict[int, object] = {}
         i_proofs: dict[int, object] = {}
-        for k, (raw, ins, outs) in enumerate(transfers):
-            try:
-                p = transfer_proof.TransferProof.deserialize(raw)
-                if p.type_and_sum is not None:
-                    t_proofs[k] = p
-            except (ValueError, ProofError):
-                pass
-        for k, (raw, coms) in enumerate(issues):
-            try:
-                i_proofs[k] = issue_proof.IssueProof.deserialize(raw)
-            except (ValueError, ProofError):
-                pass
+        with _TRACER.span("zk.deserialize"):
+            for k, (raw, ins, outs) in enumerate(transfers):
+                try:
+                    p = transfer_proof.TransferProof.deserialize(raw)
+                    if p.type_and_sum is not None:
+                        t_proofs[k] = p
+                except (ValueError, ProofError):
+                    pass
+            for k, (raw, coms) in enumerate(issues):
+                try:
+                    i_proofs[k] = issue_proof.IssueProof.deserialize(raw)
+                except (ValueError, ProofError):
+                    pass
 
         # 2. assemble the cross-action range batch for every structurally
         # valid action (Σ verdicts are still pending — a Σ-failing action's
@@ -193,20 +228,24 @@ class ZKVerifier:
         # range pass-1 marshal), the Σ verdicts last (nothing reads them
         # until the final combine). Host challenge re-derivation for Σ
         # overlaps the range pass's device tail.
-        adjust_collect = adjust_points_async(raw_pts, raw_ctts)
-        ts_items = [(t_proofs[k].type_and_sum, transfers[k][1],
-                     transfers[k][2]) for k in sorted(t_proofs)]
-        st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
-        ts_collect = self._sigma.verify_type_and_sum_async(ts_items)
-        st_collect = self._sigma.verify_same_type_async(st_items)
+        blk_span.set_attribute("range_rows", len(range_proofs))
+        with _TRACER.span("zk.dispatch"):
+            adjust_collect = adjust_points_async(raw_pts, raw_ctts)
+            ts_items = [(t_proofs[k].type_and_sum, transfers[k][1],
+                         transfers[k][2]) for k in sorted(t_proofs)]
+            st_items = [i_proofs[k].same_type for k in sorted(i_proofs)]
+            ts_collect = self._sigma.verify_type_and_sum_async(ts_items)
+            st_collect = self._sigma.verify_same_type_async(st_items)
 
         accepts = None
         if range_proofs:
-            range_coms = adjust_collect()
+            with _TRACER.span("zk.adjust_collect"):
+                range_coms = adjust_collect()
             accepts = self._range.verify(range_proofs, range_coms)
 
-        ts_acc = ts_collect()
-        st_acc = st_collect()
+        with _TRACER.span("zk.sigma_collect"):
+            ts_acc = ts_collect()
+            st_acc = st_collect()
         for j, k in enumerate(sorted(t_proofs)):
             sigma_ok_t[k] = sigma_ok_t[k] and bool(ts_acc[j])
         for j, k in enumerate(sorted(i_proofs)):
@@ -238,12 +277,11 @@ class ZKVerifier:
         if self._sigma is None:
             host_call()
             return
-        from ...services import metrics
-
         t0 = time.perf_counter()
-        acc = device_call()
-        metrics.GLOBAL.histogram("zk_sigma_verify_seconds",
-                                 kind=kind).observe(time.perf_counter() - t0)
+        with _TRACER.span("zk.sigma_verify", kind=kind):
+            acc = device_call()
+        _METRICS.histogram("zk_sigma_verify_seconds",
+                           kind=kind).observe(time.perf_counter() - t0)
         if bool(acc[0]):
             return
         host_call()
@@ -265,11 +303,10 @@ class ZKVerifier:
                 proof, self.pp.pedersen_generators))
 
     def _record_disagreement(self, what: str) -> None:
-        from ...services import metrics
-
-        global DEVICE_DISAGREEMENTS
-        DEVICE_DISAGREEMENTS += 1
-        metrics.GLOBAL.counter("zk_device_oracle_disagreements_total").add()
+        _METRICS.counter(
+            "zk_device_oracle_disagreements_total",
+            help="Device-reject/host-accept disagreements (kernel bug "
+                 "indicator; stays 0 on honest input)").add()
         logger.error(
             "device/oracle disagreement: device rejected a %s check the "
             "host oracle accepts (kernel bug?)", what)
@@ -278,17 +315,15 @@ class ZKVerifier:
                             commitments: list[G1]) -> None:
         """Device-batched RangeCorrectness with host fallback for the exact
         reference error (rangecorrectness.go:137-162 ordering)."""
-        from ...services import metrics
-
         if len(rc.proofs) != len(commitments):
             raise ProofError("invalid range proof")
         t0 = time.perf_counter()
         accepts = self._range.verify_range_correctness(rc, commitments)
-        metrics.GLOBAL.histogram(
+        _METRICS.histogram(
             "zk_range_batch_verify_seconds",
             path=self._range.last_path or "?").observe(
             time.perf_counter() - t0)
-        metrics.GLOBAL.counter("zk_range_proofs_verified_total").add(
+        _METRICS.counter("zk_range_proofs_verified_total").add(
             len(rc.proofs))
         if accepts.all():
             return

@@ -19,6 +19,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs import GLOBAL as _METRICS
+from ..obs import bench_snapshot
+
 
 @dataclass
 class TxProfile:
@@ -83,7 +86,15 @@ class LoadReport:
             "tx_per_sec": round(self.throughput(), 2),
             "p50_latency_s": round(self.percentile_latency(50), 4),
             "p95_latency_s": round(self.percentile_latency(95), 4),
+            "p99_latency_s": round(self.percentile_latency(99), 4),
         }
+
+    def bench_report(self, extra: dict | None = None) -> dict:
+        """Roll this run's report together with the process-global
+        observability registry (pipeline records, node counters) into one
+        BENCH-style dict."""
+        return bench_snapshot(extra={"txgen": self.summary(),
+                                     **(extra or {})})
 
 
 class LoadGenerator:
@@ -130,7 +141,11 @@ class LoadGenerator:
             err = "" if ok else ev.message
         except Exception as e:
             ok, err = False, type(e).__name__
-        return TxOutcome(op, ok, time.perf_counter() - t0, err)
+        dt = time.perf_counter() - t0
+        _METRICS.counter("txgen_ops_total", op=op,
+                         ok=str(ok).lower()).add()
+        _METRICS.histogram("txgen_op_seconds", op=op).observe(dt)
+        return TxOutcome(op, ok, dt, err)
 
     # ---------------------------------------------------------------- run
     def run(self, n_txs: int, parallelism: int = 1,
@@ -178,9 +193,14 @@ class LoadGenerator:
             tx = user.issue(self.issuer_name, user.name,
                             self.profile.token_type, hex(value))
             ev = user.execute(tx)
-            return TxOutcome("issue", ev.status == "VALID",
-                             time.perf_counter() - t0, ev.message
-                             if ev.status != "VALID" else "")
+            out = TxOutcome("issue", ev.status == "VALID",
+                            time.perf_counter() - t0, ev.message
+                            if ev.status != "VALID" else "")
         except Exception as e:
-            return TxOutcome("issue", False, time.perf_counter() - t0,
-                             type(e).__name__)
+            out = TxOutcome("issue", False, time.perf_counter() - t0,
+                            type(e).__name__)
+        _METRICS.counter("txgen_ops_total", op="issue",
+                         ok=str(out.ok).lower()).add()
+        _METRICS.histogram("txgen_op_seconds", op="issue").observe(
+            out.seconds)
+        return out

@@ -19,6 +19,7 @@ import numpy as np
 from ..crypto import bn254
 from ..crypto import serialization as ser
 from ..crypto.bn254 import g1_add, g1_neg
+from ..obs import GLOBAL as _METRICS
 from ..ops import ec, limbs
 from .batching import bucket_rows
 from .range_verifier import affine_batch_to_bytes
@@ -44,8 +45,11 @@ def adjust_points_async(points: list, minus: list):
     n = len(points)
     assert len(minus) == n
     if n == 0 or n < _HOST_THRESHOLD:
+        if n:
+            _METRICS.counter("adjust_points_total", path="host").add(n)
         out = [g1_add(p, g1_neg(m)) for p, m in zip(points, minus)]
         return lambda: out
+    _METRICS.counter("adjust_points_total", path="device").add(n)
     nb = bucket_rows(n)
     arr_a = np.zeros((nb, 3, limbs.NLIMBS), dtype=np.uint32)
     arr_b = np.zeros((nb, 3, limbs.NLIMBS), dtype=np.uint32)

@@ -41,6 +41,7 @@ import functools
 import hashlib
 import os
 import secrets
+import time
 from dataclasses import dataclass
 
 import jax
@@ -51,6 +52,9 @@ from ..crypto import bn254, rp
 from ..crypto import serialization as ser
 from ..crypto.bn254 import fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub
 from ..native import load_frmont
+from ..obs import RECORDS as _RECORDS
+from ..obs import TRACER as _TRACER
+from ..obs import BatchRecord, PhaseTimer
 from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows
 from .batching import next_pow2 as _next_pow2
@@ -1037,76 +1041,126 @@ class BatchRangeVerifier:
         the device's pass-1 of chunks k+1... and each chunk's weighted
         var-MSM partial is dispatched as soon as its scalars exist. The
         mesh path keeps one chunk (rows shard over devices instead).
+
+        Observability: each call produces one span tree (root
+        "range_verify" with host_prep / device_execute / result_fetch
+        children) and one obs.BatchRecord. Phase accounting respects the
+        pipeline: async dispatch + host challenge work is host_prep;
+        device_execute is measured at the blocking syncs where device
+        completion is actually awaited (the combined finalize / exact
+        collection — NOT an injected block_until_ready, which would
+        destroy the host/device overlap the chunk pipeline exists for).
         """
-        params = self.params
         B = len(proofs)
         if B == 0:
             return np.zeros(0, dtype=bool)
-        ok_structure = np.array(
-            [proofs[i] is not None and _structure_ok(proofs[i], params.rounds)
-             for i in range(B)])
-        live = [i for i in range(B) if ok_structure[i]]
+        pt = PhaseTimer()
+        t0 = time.perf_counter()
+        with _TRACER.span("range_verify", batch=B,
+                          bit_length=self.params.bit_length,
+                          exact=exact) as sp:
+            out = self._verify_instrumented(proofs, commitments, exact,
+                                            pt, sp)
+        a = sp.attributes
+        buckets = a.get("chunk_buckets", ())
+        _RECORDS.record(BatchRecord(
+            kind="range_verify", batch=B, live=a.get("live", 0),
+            bucket=max(buckets) if buckets else 0,
+            padded_rows=sum(buckets),
+            host_prep_s=pt.totals.get("host_prep", 0.0),
+            device_execute_s=pt.totals.get("device_execute", 0.0),
+            result_fetch_s=pt.totals.get("result_fetch", 0.0),
+            total_s=time.perf_counter() - t0,
+            path=self.last_path or "?", chunks=len(buckets),
+            cold_compile=_RECORDS.is_cold(
+                "range_verify",
+                (self.params.bit_length, exact, self._n_shard, buckets)),
+            attrs={"bit_length": self.params.bit_length}))
+        return out
+
+    def _verify_instrumented(self, proofs, commitments, exact,
+                             pt: PhaseTimer, sp) -> np.ndarray:
+        params = self.params
+        B = len(proofs)
+        with pt.phase("host_prep"):
+            ok_structure = np.array(
+                [proofs[i] is not None
+                 and _structure_ok(proofs[i], params.rounds)
+                 for i in range(B)])
+            live = [i for i in range(B) if ok_structure[i]]
+        sp.set_attribute("live", len(live))
         if not live:
             self.last_path = "structure-only"
+            sp.set_attribute("chunk_buckets", ())
             return ok_structure
 
         chunk = len(live) if self.mesh is not None else _CHUNK_ROWS
         chunks = [live[o:o + chunk] for o in range(0, len(live), chunk)]
+        sp.set_attribute(
+            "chunk_buckets", tuple(_bucket_rows(len(ch)) for ch in chunks))
 
-        # ---- stage 1: all chunks' pass-1 dispatched before any sync
-        stage1 = [self._dispatch_pass1(proofs, commitments, ch)
-                  for ch in chunks]
+        with pt.phase("host_prep"):
+            # ---- stage 1: all chunks' pass-1 dispatched before any sync
+            stage1 = [self._dispatch_pass1(proofs, commitments, ch)
+                      for ch in chunks]
 
-        # ---- stage 2: per chunk, sync bytes -> challenges -> equations;
-        # combined partial dispatched immediately (device keeps working).
-        # Each chunk keeps its OWN fixed accumulator so a rejecting batch
-        # can be bisected per chunk (adversarial floor: one bad proof
-        # costs an exact pass over its chunk, not the whole batch).
-        n_fixed = 2 * params.bit_length + 5
-        zero_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
-                    else None)
-        equations: dict[int, _ProofEquations] = {}
-        chunk_rlc: list = []    # (rows, fixed_acc_chunk, partial)
-        for ch, st in zip(chunks, stage1):
-            eqs_ch = self._host_stage2(proofs, ch, st)
-            equations.update(eqs_ch)
-            if not exact and self.mesh is None:
-                acc = zero_acc if zero_acc is not None else [0] * n_fixed
-                acc, part = self._combined_chunk(
-                    proofs, commitments, ch, eqs_ch, acc, st[3])
-                chunk_rlc.append((ch, acc, part))
+            # ---- stage 2: per chunk, sync bytes -> challenges ->
+            # equations; combined partial dispatched immediately (device
+            # keeps working). Each chunk keeps its OWN fixed accumulator
+            # so a rejecting batch can be bisected per chunk (adversarial
+            # floor: one bad proof costs an exact pass over its chunk,
+            # not the whole batch).
+            n_fixed = 2 * params.bit_length + 5
+            zero_acc = (bytes(32 * n_fixed) if _FRNATIVE is not None
+                        else None)
+            equations: dict[int, _ProofEquations] = {}
+            chunk_rlc: list = []    # (rows, fixed_acc_chunk, partial)
+            for ch, st in zip(chunks, stage1):
+                eqs_ch = self._host_stage2(proofs, ch, st)
+                equations.update(eqs_ch)
+                if not exact and self.mesh is None:
+                    acc = zero_acc if zero_acc is not None else [0] * n_fixed
+                    acc, part = self._combined_chunk(
+                        proofs, commitments, ch, eqs_ch, acc, st[3])
+                    chunk_rlc.append((ch, acc, part))
 
         # ---- pass 2
         bad_rows = live
         if not exact:
-            if self.mesh is not None:
-                ok = self._verify_combined(proofs, commitments, live,
-                                           equations)
-            else:
-                total = self._sum_fixed_accs([a for _, a, _ in chunk_rlc])
-                ok = self._combined_finalize(
-                    total, [p for _, _, p in chunk_rlc])
+            with pt.phase("device_execute", stage="combined"):
+                if self.mesh is not None:
+                    ok = self._verify_combined(proofs, commitments, live,
+                                               equations)
+                else:
+                    total = self._sum_fixed_accs(
+                        [a for _, a, _ in chunk_rlc])
+                    ok = self._combined_finalize(
+                        total, [p for _, _, p in chunk_rlc])
             if ok:
                 self.last_path = "combined"
-                return ok_structure
+                with pt.phase("result_fetch"):
+                    return ok_structure
             if self.mesh is None and len(chunk_rlc) > 1:
                 # bisect: re-check each chunk's RLC; exact only where it
                 # fails (a passing chunk RLC carries the same soundness
                 # as the whole-batch one: fresh per-proof weights)
-                bad_rows = []
-                for ch, acc, part in chunk_rlc:
-                    if not self._combined_finalize(acc, [part]):
-                        bad_rows.extend(ch)
+                with pt.phase("device_execute", stage="bisect"):
+                    bad_rows = []
+                    for ch, acc, part in chunk_rlc:
+                        if not self._combined_finalize(acc, [part]):
+                            bad_rows.extend(ch)
                 if not bad_rows:    # unreachable, kept for safety
                     bad_rows = live
-        accepts_bad = self._verify_exact(proofs, commitments, bad_rows,
-                                         equations)
+        with pt.phase("device_execute", stage="exact"):
+            accepts_bad = self._verify_exact(proofs, commitments, bad_rows,
+                                             equations)
         self.last_path = "exact"
-        out = ok_structure.copy()
-        bad_set = {i: row for row, i in enumerate(bad_rows)}
-        for i in live:
-            if i in bad_set:
-                out[i] = bool(accepts_bad[bad_set[i]])
+        with pt.phase("result_fetch"):
+            out = ok_structure.copy()
+            bad_set = {i: row for row, i in enumerate(bad_rows)}
+            for i in live:
+                if i in bad_set:
+                    out[i] = bool(accepts_bad[bad_set[i]])
         return out
 
     def _sum_fixed_accs(self, accs):

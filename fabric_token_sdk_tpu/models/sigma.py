@@ -35,6 +35,7 @@ import numpy as np
 from ..crypto import bn254
 from ..crypto import serialization as ser
 from ..crypto.bn254 import fr_neg, hash_to_zr
+from ..obs import GLOBAL as _METRICS
 from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows, next_pow2 as _next_pow2
 from .range_verifier import affine_batch_to_bytes, hex_ascii
@@ -200,6 +201,9 @@ class BatchSigmaVerifier:
                              var_scalar=fr_neg(p.challenge)))
         if not live:
             return lambda: ok
+        _METRICS.counter("sigma_dispatches_total", kind="same_type").add()
+        _METRICS.counter("sigma_rows_total",
+                         kind="same_type").add(len(live))
         handle = self._run_rows_async(rows)
 
         def collect() -> np.ndarray:
@@ -304,6 +308,11 @@ class BatchSigmaVerifier:
             [fr_neg(p.challenge) for _, p, _, _ in live])   # (A, 16)
         var_sc = np.zeros((R_b, NL), dtype=np.uint32)
         var_sc[:r] = negc_l[var_act[:r]]
+        _METRICS.counter("sigma_dispatches_total",
+                         kind="type_and_sum").add()
+        _METRICS.counter("sigma_rows_total", kind="type_and_sum").add(r)
+        _METRICS.counter("sigma_pad_rows_total",
+                         kind="type_and_sum").add(R_b - r)
         enc = _tas_block_kernel(
             self.tables, jnp.asarray(ptp), jnp.asarray(cttp),
             jnp.asarray(valid), jnp.asarray(out_slot),

@@ -1,0 +1,38 @@
+"""Unified observability plane: metrics, hierarchical tracing, pipeline
+records, exporters, and the bench reporter.
+
+Absorbs and extends the old ``services/metrics.py`` stub (which remains as
+a compatibility shim). One process-global registry (``GLOBAL``) and one
+process-global tracer (``TRACER``) are threaded through the verification
+pipeline — the models layer (BatchRangeVerifier / BatchSigmaVerifier /
+adjust), the zkatdlog verifier/validator, the node/ttx lifecycle, the
+selector, the DBs, and the chaincode — so a single request produces a
+span tree (exportable to Chrome/Perfetto trace-event JSON) plus counter
+and histogram families scrapeable in Prometheus exposition format.
+
+Layer map vs the reference SDK:
+  - obs/metrics.py  ~ token/core/common/metrics (label-namespaced provider)
+  - obs/tracing.py  ~ token/core/common/tracing (OpenTelemetry spans)
+  - obs/pipeline.py — TPU-native extension: per-batch device pipeline
+    records (bucket/pad-waste/phase split/compile detection)
+  - obs/export.py   — Chrome trace-event JSON (chrome://tracing, Perfetto)
+  - obs/report.py   — BENCH-style JSON snapshots for round-over-round
+    comparison (bench.py / harness/txgen.py)
+"""
+
+from .metrics import (Counter, Histogram, MetricsProvider, GLOBAL,
+                      escape_label_value, sanitize_label_name,
+                      sanitize_metric_name)
+from .tracing import Span, Tracer, TRACER
+from .pipeline import BatchRecord, PhaseTimer, PipelineRecorder, RECORDS
+from .export import spans_to_chrome_trace, write_chrome_trace
+from .report import bench_snapshot, write_bench_report
+
+__all__ = [
+    "Counter", "Histogram", "MetricsProvider", "GLOBAL",
+    "sanitize_metric_name", "sanitize_label_name", "escape_label_value",
+    "Span", "Tracer", "TRACER",
+    "BatchRecord", "PhaseTimer", "PipelineRecorder", "RECORDS",
+    "spans_to_chrome_trace", "write_chrome_trace",
+    "bench_snapshot", "write_bench_report",
+]

@@ -1,0 +1,83 @@
+"""Exporters: span trees -> Chrome/Perfetto trace-event JSON.
+
+The Trace Event Format (consumed by chrome://tracing and Perfetto's
+legacy-JSON importer) represents each span as a complete event
+(``ph: "X"``) with microsecond ``ts``/``dur``; span events become instant
+events (``ph: "i"``). Parent/child structure survives two ways: visually
+through ts/dur containment on one thread track, and exactly through the
+``trace_id``/``span_id``/``parent_id`` args on every event — the
+round-trip test reconstructs the tree from those.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .tracing import Span
+
+
+def _span_events(span: Span, pid: int, tid: int) -> list[dict]:
+    ts = span.start * 1e6
+    out = [{
+        "name": span.name,
+        "ph": "X",
+        "ts": ts,
+        "dur": (span.duration or 0.0) * 1e6,
+        "pid": pid,
+        "tid": tid,
+        "args": {
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            **span.attributes,
+        },
+    }]
+    for name, offset, attrs in span.events:
+        out.append({
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "ts": ts + offset * 1e6,
+            "pid": pid,
+            "tid": tid,
+            "args": {"span_id": span.span_id, **(attrs or {})},
+        })
+    for child in span.children:
+        out.extend(_span_events(child, pid, tid))
+    return out
+
+
+def spans_to_chrome_trace(spans: list[Span], process_name: str =
+                          "fabric_token_sdk_tpu") -> dict:
+    """Root spans (with their subtrees) -> a Trace Event Format dict.
+
+    Each root span gets its own thread track so concurrent requests do
+    not visually overlap.
+    """
+    pid = os.getpid()
+    events: list[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": process_name},
+    }]
+    for tid, root in enumerate(spans, start=1):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": f"trace_{root.trace_id}"},
+        })
+        events.extend(_span_events(root, pid, tid))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+_write_lock = threading.Lock()
+
+
+def write_chrome_trace(path: str, spans: list[Span],
+                       process_name: str = "fabric_token_sdk_tpu") -> str:
+    """Serialize root spans to `path` (atomic enough for one process)."""
+    doc = spans_to_chrome_trace(spans, process_name=process_name)
+    with _write_lock:
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+    return path

@@ -1,0 +1,234 @@
+"""Label-namespaced metrics registry with conformant Prometheus exposition.
+
+Behavioral mirror of the reference's observability stack:
+  - token/core/common/metrics/provider.go:26-75 — a metrics provider that
+    namespaces every instrument with TMS labels;
+  - token/core/zkatdlog/nogh/v1/metrics.go:14-40 — per-driver duration
+    histograms around zk issue/transfer.
+
+TPU-native additions over the old services/metrics.py stub:
+  - exposition-format conformance: ``# HELP``/``# TYPE`` lines, metric and
+    label name sanitization (span names contain dots, which are invalid
+    Prometheus identifiers), label-value escaping;
+  - bounded sample reservoirs on histograms so the bench reporter can
+    publish p50/p95/p99 without a separate latency store;
+  - ``reset()`` so test fixtures can stop GLOBAL state leaking between
+    tests.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary string onto a valid Prometheus metric name
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``). Span names contain dots; label-ish
+    suffixes may contain anything."""
+    out = _METRIC_NAME_RE.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def sanitize_label_name(name: str) -> str:
+    """Valid Prometheus label name (``[a-zA-Z_][a-zA-Z0-9_]*``)."""
+    out = _LABEL_NAME_RE.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value) -> str:
+    """Escape a label value per the exposition format: backslash, double
+    quote, and line feed must be escaped inside the quoted value."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+@dataclass
+class Counter:
+    value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self.value += delta
+
+
+#: Histogram bucket boundaries (seconds) tuned for proof verification:
+#: sub-ms host ops up to multi-second cold batches.
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                    30.0)
+
+#: Per-histogram sample reservoir size: enough for stable p99 estimates at
+#: bench scale while bounding memory for long-running nodes.
+_SAMPLE_KEEP = 4096
+
+
+@dataclass
+class Histogram:
+    buckets: tuple = _DEFAULT_BUCKETS
+    counts: list = None
+    total: float = 0.0
+    n: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _samples: deque = field(
+        default_factory=lambda: deque(maxlen=_SAMPLE_KEEP))
+
+    def __post_init__(self):
+        if self.counts is None:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            self.total += value
+            self.n += 1
+            self._samples.append(value)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Approximate percentile from the bounded sample reservoir (the
+        last ``_SAMPLE_KEEP`` observations). Exact while fewer than that
+        many samples have been observed."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return 0.0
+        idx = min(len(samples) - 1, int(p / 100.0 * len(samples)))
+        return samples[idx]
+
+
+def _key(name: str, labels: dict | None) -> tuple:
+    return (name, tuple(sorted((labels or {}).items())))
+
+
+class MetricsProvider:
+    """Label-namespaced metrics registry (metrics/provider.go:26-75)."""
+
+    def __init__(self, namespace_labels: dict | None = None):
+        self.namespace_labels = dict(namespace_labels or {})
+        self._counters: dict[tuple, Counter] = {}
+        self._histograms: dict[tuple, Histogram] = {}
+        self._help: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def with_labels(self, **labels) -> "MetricsProvider":
+        """Derived provider with extra namespace labels (TMS-id labelling
+        in the reference). Shares the registry AND its lock — parent and
+        children registering the same instrument concurrently must
+        serialize on one lock or increments race away."""
+        child = MetricsProvider({**self.namespace_labels, **labels})
+        child._counters = self._counters
+        child._histograms = self._histograms
+        child._help = self._help
+        child._lock = self._lock
+        return child
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        key = _key(name, {**self.namespace_labels, **labels})
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            if key not in self._counters:
+                self._counters[key] = Counter()
+            return self._counters[key]
+
+    def histogram(self, name: str, help: str = "", **labels) -> Histogram:
+        key = _key(name, {**self.namespace_labels, **labels})
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            if key not in self._histograms:
+                self._histograms[key] = Histogram()
+            return self._histograms[key]
+
+    def reset(self) -> None:
+        """Drop every registered instrument. Shared-registry children see
+        the reset too (they alias the same dicts). Test-fixture hook so
+        GLOBAL state cannot leak between tests."""
+        with self._lock:
+            self._counters.clear()
+            self._histograms.clear()
+            self._help.clear()
+
+    # ------------------------------------------------------------- scraping
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            for (name, labels), c in self._counters.items():
+                out[(name, labels)] = c.value
+            for (name, labels), h in self._histograms.items():
+                out[(name, labels)] = {"count": h.n, "sum": h.total,
+                                       "mean": h.mean}
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (what the reference's provider
+        ultimately serves), conformant: one ``# HELP``/``# TYPE`` block
+        per family, sanitized metric/label names, escaped label values."""
+        lines = []
+
+        def fmt_labels(labels):
+            if not labels:
+                return ""
+            inner = ",".join(
+                f'{sanitize_label_name(k)}="{escape_label_value(v)}"'
+                for k, v in labels)
+            return "{" + inner + "}"
+
+        def fmt_num(v) -> str:
+            if v == float("inf"):
+                return "+Inf"
+            return repr(float(v))
+
+        with self._lock:
+            by_family: dict[str, list] = {}
+            for (name, labels), c in self._counters.items():
+                by_family.setdefault(name, []).append(("counter", labels, c))
+            for (name, labels), h in self._histograms.items():
+                by_family.setdefault(name, []).append(
+                    ("histogram", labels, h))
+            for name in sorted(by_family):
+                fam = sanitize_metric_name(name)
+                kind = by_family[name][0][0]
+                help_text = self._help.get(name, "") or name
+                lines.append(f"# HELP {fam} "
+                             f"{escape_label_value(help_text)}")
+                lines.append(f"# TYPE {fam} {kind}")
+                for _, labels, inst in sorted(
+                        by_family[name], key=lambda t: t[1]):
+                    if isinstance(inst, Counter):
+                        lines.append(
+                            f"{fam}{fmt_labels(labels)} {inst.value}")
+                    else:
+                        cum = 0
+                        for bound, cnt in zip(inst.buckets, inst.counts):
+                            cum += cnt
+                            lbl = fmt_labels(
+                                labels + (("le", fmt_num(bound)),))
+                            lines.append(f"{fam}_bucket{lbl} {cum}")
+                        lines.append(
+                            f"{fam}_bucket"
+                            f"{fmt_labels(labels + (('le', '+Inf'),))} "
+                            f"{inst.n}")
+                        lines.append(
+                            f"{fam}_sum{fmt_labels(labels)} {inst.total}")
+                        lines.append(
+                            f"{fam}_count{fmt_labels(labels)} {inst.n}")
+        return "\n".join(lines) + "\n"
+
+
+#: Process-global default provider (sdk/dig singleton equivalent).
+GLOBAL = MetricsProvider()

@@ -1,0 +1,189 @@
+"""Per-batch device-pipeline records for the zkatdlog verification path.
+
+Every batched verify produces one ``BatchRecord``: batch size, the pow-2
+row bucket(s) the batch padded into, the pad-waste ratio, and the
+host-prep / device-execute / result-fetch wall split (device time is
+fenced at the pipeline's blocking sync — the combined-pass finalize /
+exact-pass collection, where ``block_until_ready`` semantics apply; host
+work dispatched asynchronously before the fence is charged to
+host_prep, which is exactly the overlap the pipeline buys).
+
+Compile-vs-steady-state detection: the first record for a given
+(kind, shape-bucket) key in this process is labelled ``cold_compile`` and
+kept OUT of the steady-state latency percentiles, so a prewarm or first
+verify cannot poison p99.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from .metrics import GLOBAL, MetricsProvider
+
+
+@dataclass
+class BatchRecord:
+    """One batched device verify through the pipeline."""
+
+    kind: str                 # "range_verify" | "sigma_tas" | ...
+    batch: int                # rows requested
+    live: int                 # structurally valid rows actually verified
+    bucket: int               # largest padded row bucket used
+    padded_rows: int          # total rows after bucket padding
+    host_prep_s: float = 0.0
+    device_execute_s: float = 0.0
+    result_fetch_s: float = 0.0
+    total_s: float = 0.0
+    path: str = ""            # combined | exact | structure-only | ...
+    chunks: int = 1
+    cold_compile: bool = False
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def pad_waste(self) -> float:
+        """Fraction of padded device rows that carry no real proof."""
+        if self.padded_rows <= 0:
+            return 0.0
+        return 1.0 - self.live / self.padded_rows
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "batch": self.batch, "live": self.live,
+            "bucket": self.bucket, "padded_rows": self.padded_rows,
+            "pad_waste": round(self.pad_waste, 4),
+            "host_prep_s": round(self.host_prep_s, 6),
+            "device_execute_s": round(self.device_execute_s, 6),
+            "result_fetch_s": round(self.result_fetch_s, 6),
+            "total_s": round(self.total_s, 6),
+            "path": self.path, "chunks": self.chunks,
+            "cold_compile": self.cold_compile, **self.attrs,
+        }
+
+
+class PhaseTimer:
+    """Accumulates named phase durations as child spans of the current
+    trace context. A phase may be entered several times (the reject path
+    re-enters device_execute for the bisect + exact passes); totals sum.
+    """
+
+    def __init__(self, tracer=None):
+        if tracer is None:
+            from .tracing import TRACER
+
+            tracer = TRACER
+        self.tracer = tracer
+        self.totals: dict[str, float] = defaultdict(float)
+
+    @contextmanager
+    def phase(self, name: str, **attributes):
+        with self.tracer.span(name, **attributes) as sp:
+            yield sp
+        self.totals[name] += sp.duration
+
+
+class PipelineRecorder:
+    """Bounded ring of BatchRecords + registry fan-out.
+
+    Metric families fed per record (stable interface for BENCH
+    comparisons, see ROADMAP):
+      - pipeline_batches_total{kind, state}   (state: cold|steady)
+      - pipeline_rows_total{kind} / pipeline_pad_rows_total{kind}
+      - pipeline_batch_seconds{kind, state}
+      - pipeline_steady_seconds{kind}     (steady-state only — the family
+        latency percentiles are computed from)
+      - pipeline_phase_seconds{kind, phase}
+      - pipeline_pad_waste_ratio{kind}
+    """
+
+    def __init__(self, provider: MetricsProvider | None = None,
+                 keep: int = 512):
+        self.provider = provider or GLOBAL
+        self.records: list[BatchRecord] = []
+        self._keep = keep
+        self._seen_shapes: set = set()
+        self._lock = threading.Lock()
+
+    def is_cold(self, kind: str, shape_key) -> bool:
+        """True (and marks seen) when this process has not run `kind` at
+        `shape_key` before — i.e. this batch likely pays XLA compiles."""
+        key = (kind, shape_key)
+        with self._lock:
+            if key in self._seen_shapes:
+                return False
+            self._seen_shapes.add(key)
+            return True
+
+    def record(self, rec: BatchRecord) -> BatchRecord:
+        with self._lock:
+            self.records.append(rec)
+            if len(self.records) > self._keep:
+                self.records.pop(0)
+        p = self.provider
+        state = "cold" if rec.cold_compile else "steady"
+        p.counter("pipeline_batches_total", kind=rec.kind, state=state).add()
+        p.counter("pipeline_rows_total", kind=rec.kind).add(rec.live)
+        p.counter("pipeline_pad_rows_total", kind=rec.kind).add(
+            max(0, rec.padded_rows - rec.live))
+        p.histogram("pipeline_batch_seconds", kind=rec.kind,
+                    state=state).observe(rec.total_s)
+        if not rec.cold_compile:
+            p.histogram("pipeline_steady_seconds",
+                        kind=rec.kind).observe(rec.total_s)
+        for phase, secs in (("host_prep", rec.host_prep_s),
+                            ("device_execute", rec.device_execute_s),
+                            ("result_fetch", rec.result_fetch_s)):
+            if secs:
+                p.histogram("pipeline_phase_seconds", kind=rec.kind,
+                            phase=phase).observe(secs)
+        p.histogram("pipeline_pad_waste_ratio",
+                    kind=rec.kind).observe(rec.pad_waste)
+        return rec
+
+    def last(self, kind: str | None = None) -> BatchRecord | None:
+        with self._lock:
+            for rec in reversed(self.records):
+                if kind is None or rec.kind == kind:
+                    return rec
+        return None
+
+    def summary(self) -> dict:
+        """Roll-up for the bench reporter."""
+        with self._lock:
+            recs = list(self.records)
+        steady = [r for r in recs if not r.cold_compile]
+        out: dict = {
+            "batches": len(recs),
+            "cold_compiles": sum(1 for r in recs if r.cold_compile),
+            "rows": sum(r.live for r in recs),
+            "padded_rows": sum(r.padded_rows for r in recs),
+        }
+        if out["padded_rows"]:
+            out["pad_waste"] = round(
+                1.0 - out["rows"] / out["padded_rows"], 4)
+        if steady:
+            lat = sorted(r.total_s for r in steady)
+
+            def pct(p):
+                return round(lat[min(len(lat) - 1,
+                                     int(p / 100.0 * len(lat)))], 6)
+
+            wall = sum(lat)
+            rows = sum(r.live for r in steady)
+            out["steady"] = {
+                "batches": len(steady),
+                "p50_s": pct(50), "p95_s": pct(95), "p99_s": pct(99),
+                "rows_per_sec": round(rows / wall, 2) if wall else 0.0,
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self.records.clear()
+            self._seen_shapes.clear()
+
+
+#: Process-global recorder the batched verifiers feed.
+RECORDS = PipelineRecorder()

@@ -1,0 +1,67 @@
+"""Bench reporter: roll the metrics registry + pipeline records into a
+BENCH-style JSON snapshot.
+
+Gives bench.py and harness/txgen.py one comparable artifact per round —
+throughput, latency percentiles (steady-state only), pad waste, compile
+counts — so every future perf PR is measurable against the previous
+round's snapshot instead of ad-hoc profiling scripts. The metric family
+names emitted here are a stable interface (see the ROADMAP open item).
+"""
+
+from __future__ import annotations
+
+import json
+import platform
+from typing import Any
+
+from .metrics import GLOBAL, MetricsProvider
+from .pipeline import RECORDS, PipelineRecorder
+
+
+def _labels_dict(labels: tuple) -> dict:
+    return {k: v for k, v in labels}
+
+
+def bench_snapshot(provider: MetricsProvider | None = None,
+                   recorder: PipelineRecorder | None = None,
+                   extra: dict | None = None) -> dict:
+    """One BENCH-style dict: counters, histogram stats (count/sum/mean +
+    p50/p95/p99 from the bounded reservoirs), and the pipeline roll-up."""
+    provider = provider or GLOBAL
+    recorder = recorder or RECORDS
+    counters: dict[str, list] = {}
+    histograms: dict[str, list] = {}
+    with provider._lock:
+        counter_items = list(provider._counters.items())
+        hist_items = list(provider._histograms.items())
+    for (name, labels), c in counter_items:
+        counters.setdefault(name, []).append(
+            {"labels": _labels_dict(labels), "value": c.value})
+    for (name, labels), h in hist_items:
+        histograms.setdefault(name, []).append({
+            "labels": _labels_dict(labels),
+            "count": h.n, "sum": round(h.total, 6),
+            "mean": round(h.mean, 6),
+            "p50": round(h.percentile(50), 6),
+            "p95": round(h.percentile(95), 6),
+            "p99": round(h.percentile(99), 6),
+        })
+    out: dict[str, Any] = {
+        "schema": "fts-obs-bench-v1",
+        "host": platform.node(),
+        "counters": counters,
+        "histograms": histograms,
+        "pipeline": recorder.summary(),
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def write_bench_report(path: str, provider: MetricsProvider | None = None,
+                       recorder: PipelineRecorder | None = None,
+                       extra: dict | None = None) -> str:
+    snap = bench_snapshot(provider=provider, recorder=recorder, extra=extra)
+    with open(path, "w") as fh:
+        json.dump(snap, fh, indent=1, sort_keys=True)
+    return path

@@ -1,0 +1,161 @@
+"""Hierarchical span tracer with device-profiler coupling.
+
+Behavioral mirror of token/core/common/tracing/tracing.go:18-26 — spans
+threaded through validator/auditor calls (OpenTelemetry in the reference)
+— upgraded from the old flat ``Tracer.finished`` list to a real tree:
+every span carries a trace-id / span-id / parent-id, attributes, and
+events; nesting is tracked with a contextvar so layers that never see
+each other (node -> chaincode -> validator -> batch verifier) still
+produce one connected tree per request.
+
+Exporters: Chrome/Perfetto trace-event JSON (obs/export.py) and optional
+JAX profiler coupling — with ``profile_dir`` set each ROOT span wraps the
+work in jax.profiler.start_trace/stop_trace so xprof captures the device
+timeline (SURVEY.md §5), and with ``annotate_device=True`` every span
+also enters a jax.profiler.TraceAnnotation so host spans line up with
+device ops in the xprof view.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from .metrics import GLOBAL, MetricsProvider, sanitize_metric_name
+
+_ids = itertools.count(1)
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "fts_current_span", default=None)
+
+
+def _next_id() -> int:
+    return next(_ids)
+
+
+@dataclass
+class Span:
+    name: str
+    start: float                      # perf_counter, phase arithmetic
+    trace_id: int = 0
+    span_id: int = 0
+    parent_id: int | None = None
+    attributes: dict = field(default_factory=dict)
+    events: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+    duration: float | None = None
+
+    def add_event(self, name: str, **attributes) -> None:
+        """tracing span AddEvent (audit/auditor.go:143-171 pattern)."""
+        self.events.append((name, time.perf_counter() - self.start,
+                            attributes or None))
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def walk(self):
+        """Depth-first iteration over this span and its descendants."""
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+
+class Tracer:
+    """Span tracer: tree-building spans, durations into histograms,
+    optional JAX device-trace coupling.
+
+    ``finished`` keeps the last ``keep_spans`` COMPLETED spans (flat,
+    oldest first) for cheap "what just ran" inspection; ``roots`` keeps
+    the last completed ROOT spans with their full child trees — the unit
+    the Chrome-trace exporter consumes.
+    """
+
+    def __init__(self, provider: MetricsProvider | None = None,
+                 profile_dir: str | None = None, keep_spans: int = 256,
+                 annotate_device: bool = False):
+        self.provider = provider or GLOBAL
+        self.profile_dir = profile_dir
+        self.annotate_device = annotate_device
+        self.finished: list[Span] = []
+        self.roots: list[Span] = []
+        self._keep = keep_spans
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        parent: Span | None = _CURRENT.get()
+        sp = Span(name=name, start=time.perf_counter(),
+                  span_id=_next_id(),
+                  trace_id=(parent.trace_id if parent is not None
+                            else _next_id()),
+                  parent_id=(parent.span_id if parent is not None
+                             else None),
+                  attributes=dict(attributes))
+        if parent is not None:
+            parent.children.append(sp)
+        token = _CURRENT.set(sp)
+        profiling = False
+        annotation = None
+        if self.profile_dir is not None and parent is None:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                profiling = True
+            except RuntimeError:
+                pass  # a trace is already running
+        if self.annotate_device:
+            try:
+                import jax
+
+                annotation = jax.profiler.TraceAnnotation(name)
+                annotation.__enter__()
+            except Exception:
+                annotation = None
+        try:
+            yield sp
+        finally:
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+            if profiling:
+                import jax
+
+                jax.profiler.stop_trace()
+            _CURRENT.reset(token)
+            sp.duration = time.perf_counter() - sp.start
+            self.provider.histogram(
+                sanitize_metric_name(f"span_{name}_seconds")).observe(
+                sp.duration)
+            with self._lock:
+                self.finished.append(sp)
+                if len(self.finished) > self._keep:
+                    self.finished.pop(0)
+                if parent is None:
+                    self.roots.append(sp)
+                    if len(self.roots) > self._keep:
+                        self.roots.pop(0)
+
+    def current(self) -> Span | None:
+        """The innermost open span on this execution context, if any."""
+        return _CURRENT.get()
+
+    def last_root(self, name: str | None = None) -> Span | None:
+        """Most recent completed root span (optionally by name)."""
+        with self._lock:
+            for sp in reversed(self.roots):
+                if name is None or sp.name == name:
+                    return sp
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self.finished.clear()
+            self.roots.clear()
+
+
+#: Process-global default tracer: the one the verification pipeline
+#: (models / core / services layers) threads its spans through.
+TRACER = Tracer()

@@ -12,16 +12,36 @@ column for range/balance queries (precision <= 64 fits SQLite INTEGER).
 
 from __future__ import annotations
 
+import functools
 import sqlite3
 import threading
 import time
 from dataclasses import dataclass
 
+from ...obs import GLOBAL as _METRICS
 from ...token.model import ID, UnspentToken
 
 
 class DBError(Exception):
     pass
+
+
+def _timed(fn):
+    """Per-method latency histogram ``db_<method>_seconds{db=<Class>}``
+    on the store methods the ttx hot path hits (token ingest, selection
+    scans, status flips, lock takes)."""
+    name = f"db_{fn.__name__}_seconds"
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            _METRICS.histogram(name, db=type(self).__name__).observe(
+                time.perf_counter() - t0)
+
+    return wrapper
 
 
 class TxStatus:
@@ -74,6 +94,7 @@ class TokenDB(_Base):
         ON tokens (is_deleted, token_type);
     """
 
+    @_timed
     def store_token(self, token_id: ID, owner_raw: bytes, token_type: str,
                     quantity_hex: str, owners: list[str],
                     ledger_format: str = "", ledger_token: bytes = b"",
@@ -94,6 +115,7 @@ class TokenDB(_Base):
                     " VALUES (?,?,?)", (token_id.tx_id, token_id.index, wid))
             self.conn.commit()
 
+    @_timed
     def delete_token(self, token_id: ID, spent_by: str) -> None:
         with self._mu:
             self.conn.execute(
@@ -110,6 +132,7 @@ class TokenDB(_Base):
                 (token_id.tx_id, token_id.index, wallet_id)).fetchone()
         return row is not None
 
+    @_timed
     def unspent_tokens(self, wallet_id: str | None = None,
                        token_type: str | None = None) -> list[UnspentToken]:
         q = ("SELECT t.tx_id, t.idx, t.owner_raw, t.token_type, t.quantity "
@@ -220,6 +243,7 @@ class TransactionDB(_Base):
     );
     """
 
+    @_timed
     def add_transaction(self, rec: TxRecord) -> None:
         with self._mu:
             self.conn.execute(
@@ -246,6 +270,7 @@ class TransactionDB(_Base):
                 (tx_id,)).fetchone()
         return row[0] if row else None
 
+    @_timed
     def set_status(self, tx_id: str, status: str, message: str = "") -> None:
         with self._mu:
             self.conn.execute(
@@ -382,6 +407,7 @@ class TokenLockDB(_Base):
     );
     """
 
+    @_timed
     def lock(self, token_id: ID, consumer_tx_id: str) -> bool:
         """Returns True if the lock was acquired. Re-entrant for the SAME
         consumer (sherdlock lease semantics)."""

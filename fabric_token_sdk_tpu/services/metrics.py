@@ -1,205 +1,34 @@
-"""Metrics + tracing: observability for the verification hot path.
+"""Compatibility shim: the metrics/tracing stack lives in
+``fabric_token_sdk_tpu.obs`` now.
 
-Behavioral mirror of the reference's observability stack:
-  - token/core/common/metrics/provider.go:26-75 — a metrics provider that
-    namespaces every instrument with TMS labels;
-  - token/core/zkatdlog/nogh/v1/metrics.go:14-40 — per-driver duration
-    histograms around zk issue/transfer;
-  - token/core/common/tracing/tracing.go:18-26 — spans threaded through
-    validator/auditor calls (OpenTelemetry in the reference).
-
-TPU-native equivalent: in-process counters/histograms (scrapeable as a
-dict, printable as Prometheus text format) plus a span tracer that can
-optionally drive the JAX profiler for device-level traces
-(jax.profiler.start_trace / TraceAnnotation) — SURVEY.md §5 "JAX profiler +
-xprof traces per batch, span per validator call".
+Every name exported here aliases the obs implementation — including the
+process-global ``GLOBAL`` provider and ``TRACER``, which are the SAME
+objects as ``obs.GLOBAL`` / ``obs.TRACER``, so old importers and the new
+pipeline instrumentation share one registry and one span tree.
 """
 
 from __future__ import annotations
 
-import bisect
-import threading
-import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
+from ..obs.metrics import (  # noqa: F401
+    GLOBAL,
+    Counter,
+    Histogram,
+    MetricsProvider,
+    escape_label_value,
+    sanitize_label_name,
+    sanitize_metric_name,
+)
+from ..obs.tracing import TRACER, Span, Tracer  # noqa: F401
 
-
-@dataclass
-class Counter:
-    value: float = 0.0
-    _lock: threading.Lock = field(default_factory=threading.Lock)
-
-    def add(self, delta: float = 1.0) -> None:
-        with self._lock:
-            self.value += delta
-
-
-#: Histogram bucket boundaries (seconds) tuned for proof verification:
-#: sub-ms host ops up to multi-second cold batches.
-_DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
-                    30.0)
-
-
-@dataclass
-class Histogram:
-    buckets: tuple = _DEFAULT_BUCKETS
-    counts: list = None
-    total: float = 0.0
-    n: int = 0
-    _lock: threading.Lock = field(default_factory=threading.Lock)
-
-    def __post_init__(self):
-        if self.counts is None:
-            self.counts = [0] * (len(self.buckets) + 1)
-
-    def observe(self, value: float) -> None:
-        with self._lock:
-            self.counts[bisect.bisect_left(self.buckets, value)] += 1
-            self.total += value
-            self.n += 1
-
-    @property
-    def mean(self) -> float:
-        return self.total / self.n if self.n else 0.0
-
-
-def _key(name: str, labels: dict | None) -> tuple:
-    return (name, tuple(sorted((labels or {}).items())))
-
-
-class MetricsProvider:
-    """Label-namespaced metrics registry (metrics/provider.go:26-75)."""
-
-    def __init__(self, namespace_labels: dict | None = None):
-        self.namespace_labels = dict(namespace_labels or {})
-        self._counters: dict[tuple, Counter] = {}
-        self._histograms: dict[tuple, Histogram] = {}
-        self._lock = threading.Lock()
-
-    def with_labels(self, **labels) -> "MetricsProvider":
-        """Derived provider with extra namespace labels (TMS-id labelling
-        in the reference). Shares the registry AND its lock — parent and
-        children registering the same instrument concurrently must
-        serialize on one lock or increments race away."""
-        child = MetricsProvider({**self.namespace_labels, **labels})
-        child._counters = self._counters
-        child._histograms = self._histograms
-        child._lock = self._lock
-        return child
-
-    def counter(self, name: str, **labels) -> Counter:
-        key = _key(name, {**self.namespace_labels, **labels})
-        with self._lock:
-            if key not in self._counters:
-                self._counters[key] = Counter()
-            return self._counters[key]
-
-    def histogram(self, name: str, **labels) -> Histogram:
-        key = _key(name, {**self.namespace_labels, **labels})
-        with self._lock:
-            if key not in self._histograms:
-                self._histograms[key] = Histogram()
-            return self._histograms[key]
-
-    # ------------------------------------------------------------- scraping
-    def snapshot(self) -> dict:
-        out = {}
-        with self._lock:
-            for (name, labels), c in self._counters.items():
-                out[(name, labels)] = c.value
-            for (name, labels), h in self._histograms.items():
-                out[(name, labels)] = {"count": h.n, "sum": h.total,
-                                       "mean": h.mean}
-        return out
-
-    def prometheus_text(self) -> str:
-        """Prometheus exposition format (what the reference's provider
-        ultimately serves)."""
-        lines = []
-
-        def fmt_labels(labels):
-            if not labels:
-                return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
-            return "{" + inner + "}"
-
-        with self._lock:
-            for (name, labels), c in sorted(self._counters.items()):
-                lines.append(f"{name}{fmt_labels(labels)} {c.value}")
-            for (name, labels), h in sorted(self._histograms.items()):
-                cum = 0
-                for bound, cnt in zip(h.buckets, h.counts):
-                    cum += cnt
-                    lbl = fmt_labels(labels + (("le", bound),))
-                    lines.append(f"{name}_bucket{lbl} {cum}")
-                lines.append(
-                    f'{name}_bucket{fmt_labels(labels + (("le", "+Inf"),))} '
-                    f"{h.n}")
-                lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
-                lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
-        return "\n".join(lines) + "\n"
-
-
-#: Process-global default provider (sdk/dig singleton equivalent).
-GLOBAL = MetricsProvider()
-
-
-@dataclass
-class Span:
-    name: str
-    start: float
-    attributes: dict = field(default_factory=dict)
-    events: list = field(default_factory=list)
-    duration: float | None = None
-
-    def add_event(self, name: str) -> None:
-        """tracing span AddEvent (audit/auditor.go:143-171 pattern)."""
-        self.events.append((name, time.perf_counter() - self.start))
-
-    def set_attribute(self, key: str, value) -> None:
-        self.attributes[key] = value
-
-
-class Tracer:
-    """Span tracer: durations into a histogram, optional JAX device trace.
-
-    With profile_dir set, each top-level span wraps the work in
-    jax.profiler.start_trace/stop_trace so xprof captures the device
-    timeline for that span (SURVEY.md §5).
-    """
-
-    def __init__(self, provider: MetricsProvider | None = None,
-                 profile_dir: str | None = None, keep_spans: int = 256):
-        self.provider = provider or GLOBAL
-        self.profile_dir = profile_dir
-        self.finished: list[Span] = []
-        self._keep = keep_spans
-        self._lock = threading.Lock()
-
-    @contextmanager
-    def span(self, name: str, **attributes):
-        sp = Span(name=name, start=time.perf_counter(),
-                  attributes=dict(attributes))
-        profiling = False
-        if self.profile_dir is not None:
-            import jax
-
-            try:
-                jax.profiler.start_trace(self.profile_dir)
-                profiling = True
-            except RuntimeError:
-                pass  # a trace is already running (nested span)
-        try:
-            yield sp
-        finally:
-            if profiling:
-                import jax
-
-                jax.profiler.stop_trace()
-            sp.duration = time.perf_counter() - sp.start
-            self.provider.histogram(f"span_{name}_seconds").observe(
-                sp.duration)
-            with self._lock:
-                self.finished.append(sp)
-                if len(self.finished) > self._keep:
-                    self.finished.pop(0)
+__all__ = [
+    "Counter",
+    "Histogram",
+    "MetricsProvider",
+    "GLOBAL",
+    "Span",
+    "Tracer",
+    "TRACER",
+    "sanitize_metric_name",
+    "sanitize_label_name",
+    "escape_label_value",
+]

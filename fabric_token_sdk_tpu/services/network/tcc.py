@@ -18,6 +18,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ...obs import GLOBAL as _METRICS
+from ...obs import TRACER as _TRACER
 from ...token.model import ID
 from .rws import KeyTranslator, MemoryRWSet, Translator, TranslatorError
 
@@ -136,16 +138,26 @@ class TokenChaincode:
         """Validate + translate + commit one token request (tcc.go:220-255).
 
         Instrumented with the span/histogram pair the reference threads
-        through its validator service (tracing.go:18-26, v1/metrics.go)."""
-        from .. import metrics
-
+        through its validator service (tracing.go:18-26, v1/metrics.go):
+        one "tcc.process_request" span with validate/translate/commit
+        children, phase histograms per stage, and outcome counters.
+        ``tcc_requests_total`` stays a single unlabelled family (the
+        steady scrape-delta interface); statuses land in the separate
+        ``tcc_request_status_total{status}`` family."""
         t0 = time.perf_counter()
+        ev = None
         try:
-            return self._process_request(tx_id, request_raw)
+            with _TRACER.span("tcc.process_request", tx_id=tx_id) as sp:
+                ev = self._process_request(tx_id, request_raw)
+                sp.set_attribute("status", ev.status)
+            return ev
         finally:
-            metrics.GLOBAL.histogram("tcc_process_request_seconds").observe(
+            _METRICS.histogram("tcc_process_request_seconds").observe(
                 time.perf_counter() - t0)
-            metrics.GLOBAL.counter("tcc_requests_total").add()
+            _METRICS.counter("tcc_requests_total").add()
+            _METRICS.counter(
+                "tcc_request_status_total",
+                status=(ev.status if ev is not None else "ERROR")).add()
 
     def _process_request(self, tx_id: str,
                          request_raw: bytes) -> CommitEvent:
@@ -156,24 +168,41 @@ class TokenChaincode:
             return rws.get_state(self.keys.output_key(token_id.tx_id,
                                                       token_id.index))
 
+        t0 = time.perf_counter()
         try:
-            actions, _attrs = self.validator.verify_token_request_from_raw(
-                get_state, tx_id, request_raw)
+            with _TRACER.span("tcc.validate"):
+                actions, _attrs = \
+                    self.validator.verify_token_request_from_raw(
+                        get_state, tx_id, request_raw)
         except Exception as e:
             ev = CommitEvent(tx_id, "INVALID", f"validation failed: {e}")
             self.ledger._emit(ev)
             return ev
+        finally:
+            _METRICS.histogram("tcc_validate_seconds").observe(
+                time.perf_counter() - t0)
+        t1 = time.perf_counter()
         try:
-            translator.add_public_params_dependency()
-            for action in actions:
-                translator.write(action)
-            translator.commit_token_request(request_raw)
+            with _TRACER.span("tcc.translate"):
+                translator.add_public_params_dependency()
+                for action in actions:
+                    translator.write(action)
+                translator.commit_token_request(request_raw)
         except TranslatorError as e:
             ev = CommitEvent(tx_id, "INVALID", f"translation failed: {e}")
             self.ledger._emit(ev)
             return ev
+        finally:
+            _METRICS.histogram("tcc_translate_seconds").observe(
+                time.perf_counter() - t1)
         n_outputs = sum(len(a.get_outputs()) for a in actions)
-        return self.ledger.commit(tx_id, rws, n_outputs=n_outputs)
+        t2 = time.perf_counter()
+        try:
+            with _TRACER.span("tcc.commit"):
+                return self.ledger.commit(tx_id, rws, n_outputs=n_outputs)
+        finally:
+            _METRICS.histogram("tcc_commit_seconds").observe(
+                time.perf_counter() - t2)
 
     # ---- queries (tcc.go:126-143) ----------------------------------------
     def query_public_params(self) -> bytes | None:

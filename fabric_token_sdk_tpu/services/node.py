@@ -16,6 +16,8 @@ from collections import OrderedDict
 
 from ..core.fabtoken.driver import FabTokenDriverService, OutputSpec
 from ..driver import TokenRequest
+from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
 from ..token import quantity as q
 from ..token.model import ID
 from .db.sqldb import IdentityDB, TokenDB, TokenLockDB, TransactionDB, \
@@ -82,6 +84,10 @@ class TokenNode:
                                           precision=precision)
         self.tokens = Tokens(self.tokendb, self._ownership,
                              extractor=self.driver.extract_outputs)
+        # node-labelled view of the process-global registry: every family
+        # this node touches carries a node="<name>" label, and
+        # prometheus_text() serves the shared registry per node
+        self.metrics = _METRICS.with_labels(node=name)
         bus.register(name, self)
         chaincode.ledger.add_finality_listener(self._on_commit)
         # txs this node assembled or endorsed: refresh ttxdb on finality
@@ -134,6 +140,13 @@ class TokenNode:
             sig_service=self.keys)
         self._tms[tmsid] = tms
         return tms
+
+    def prometheus_text(self) -> str:
+        """This node's scrape endpoint body (what an FSC node's operations
+        port would serve). The registry is process-global; per-node series
+        are distinguished by the node="<name>" label this node's
+        instruments carry."""
+        return self.metrics.prometheus_text()
 
     # ------------------------------------------------------------------ util
     def _ownership(self, owner_raw: bytes) -> list[str]:
@@ -418,14 +431,35 @@ class TokenNode:
 
     def execute(self, tx: Transaction):
         """collect endorsements -> order -> wait finality (SURVEY §3.1)."""
-        collect_endorsements(tx, self.bus, self.auditor_name)
-        self._watched[tx.tx_id] = tx.request
-        self.ttxdb.add_token_request(tx.tx_id, tx.request.to_bytes())
-        for rec in tx.records:
-            self.ttxdb.add_transaction(rec)
-        ev = ordering_and_finality(tx, self.cc)
-        if ev.status != "VALID":
-            self.selector.unselect(tx.tx_id)
+        t0 = time.perf_counter()
+        with _TRACER.span("ttx.execute", node=self.name,
+                          tx_id=tx.tx_id) as sp:
+            with _TRACER.span("ttx.collect_endorsements"):
+                collect_endorsements(tx, self.bus, self.auditor_name)
+            self.metrics.histogram(
+                "ttx_collect_endorsements_seconds").observe(
+                time.perf_counter() - t0)
+            self._watched[tx.tx_id] = tx.request
+            self.ttxdb.add_token_request(tx.tx_id, tx.request.to_bytes())
+            for rec in tx.records:
+                self.ttxdb.add_transaction(rec)
+            t1 = time.perf_counter()
+            with _TRACER.span("ttx.ordering_and_finality"):
+                ev = ordering_and_finality(tx, self.cc)
+            self.metrics.histogram(
+                "ttx_ordering_finality_seconds").observe(
+                time.perf_counter() - t1)
+            if ev.status != "VALID":
+                self.selector.unselect(tx.tx_id)
+            sp.set_attribute("status", ev.status)
+        self.metrics.counter(
+            "ttx_executions_total",
+            help="ttx lifecycle outcomes per node",
+            status=ev.status).add()
+        self.metrics.histogram(
+            "ttx_execute_seconds",
+            help="end-to-end ttx latency: endorse -> order -> finality"
+        ).observe(time.perf_counter() - t0)
         return ev
 
     # ------------------------------------------------- finality (vault sync)
@@ -435,6 +469,18 @@ class TokenNode:
         Every node observes every commit; it ingests outputs owned by it
         (for commitment drivers: outputs it holds an opening for).
         """
+        t0 = time.perf_counter()
+        try:
+            self._on_commit_inner(ev)
+        finally:
+            self.metrics.counter("ttx_commits_total",
+                                 status=ev.status).add()
+            self.metrics.histogram(
+                "ttx_commit_ingest_seconds",
+                help="finality listener: vault sync per observed commit"
+            ).observe(time.perf_counter() - t0)
+
+    def _on_commit_inner(self, ev) -> None:
         if ev.status != "VALID":
             self.ttxdb.set_status(ev.tx_id, TxStatus.DELETED, ev.message)
             self._pending_openings.pop(ev.tx_id, None)

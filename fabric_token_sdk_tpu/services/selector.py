@@ -14,6 +14,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 
+from ..obs import GLOBAL as _METRICS
 from ..token import quantity as q
 from ..token.model import ID, UnspentToken
 from .db.sqldb import TokenDB, TokenLockDB
@@ -49,8 +50,11 @@ class SherdLockSelector:
     def select(self, wallet_id: str, token_type: str, amount_hex: str,
                consumer_tx_id: str) -> Selection:
         """Lock enough tokens to cover `amount`; all-or-nothing."""
+        t0 = time.perf_counter()
         target = q.to_quantity(amount_hex, self.precision).value
         for attempt in range(self.retries):
+            if attempt:
+                _METRICS.counter("selector_retries_total").add()
             picked: list[UnspentToken] = []
             total = 0
             for tok in self.tokendb.unspent_tokens(wallet_id, token_type):
@@ -60,12 +64,23 @@ class SherdLockSelector:
                     picked.append(tok)
                     total += int(tok.quantity, 16)
             if total >= target:
+                _METRICS.histogram(
+                    "selector_select_seconds",
+                    help="token selection + locking latency").observe(
+                    time.perf_counter() - t0)
+                _METRICS.counter("selector_tokens_locked_total").add(
+                    len(picked))
                 return Selection(tokens=picked, sum=total)
             # not enough: release and retry after lease eviction/backoff
             self.lockdb.unlock_by_consumer(consumer_tx_id)
             self.lockdb.evict_expired(self.lease_seconds)
             if attempt < self.retries - 1:
                 time.sleep(self.backoff * (2 ** attempt))
+        _METRICS.counter("selector_insufficient_funds_total").add()
+        _METRICS.histogram(
+            "selector_select_seconds",
+            help="token selection + locking latency").observe(
+            time.perf_counter() - t0)
         raise InsufficientFunds(
             f"insufficient funds, only [{total}] tokens of type [{token_type}] "
             f"are available, but [{target}] were requested and "

@@ -1,0 +1,191 @@
+"""Unified perf profiler for the batched verification pipeline, driven by
+the obs span tracer.
+
+Replaces the four one-off profile scripts (perf_block_profile,
+perf_kernels_profile, perf_pass1_components, perf_stage2_profile) and
+profile_verifier: every mode runs the PRODUCTION code paths under
+obs.TRACER and reports from the span tree + pipeline records instead of
+hand-inserted timers, so the profile and the shipped instrumentation can
+never drift apart.
+
+Modes (--mode):
+  range    end-to-end BatchRangeVerifier.verify at --batch, pipelined;
+           prints the per-phase split from the span tree and the
+           BatchRecord (pad waste, bucket, cold/steady).
+  block    ZKVerifier.verify_block at bench config-3 shapes; prints the
+           zk.* child-span breakdown (deserialize / dispatch / adjust /
+           range phases / sigma collect).
+  barrier  barriered per-phase verify of ONE chunk: each device stage
+           fenced with block_until_ready so stages sum honestly. The gap
+           vs the pipelined wall time is the host/device overlap the
+           pipeline buys. This is the only mode that injects fences —
+           production spans never do.
+
+Output: human-readable table on stderr, one JSON document on stdout.
+--trace <path> additionally writes the span tree as Chrome trace-event
+JSON (chrome://tracing, Perfetto). --xprof <dir> couples root spans to
+jax.profiler.start_trace for device-level xprof timelines.
+
+Run on the chip: python perf_profile.py --mode range --batch 1024
+CPU smoke: JAX_PLATFORMS=cpu python perf_profile.py --batch 8 --reps 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _tree_lines(span, depth=0, out=None):
+    out = out if out is not None else []
+    out.append("  " * depth
+               + f"{span.name:<28s} {span.duration * 1e3:9.2f} ms  "
+               + " ".join(f"{k}={v}" for k, v in span.attributes.items()))
+    for ch in span.children:
+        _tree_lines(ch, depth + 1, out)
+    return out
+
+
+def _report(tracer, root_name: str, records, wall_s: float, n_rows: int,
+            trace_path: str | None) -> dict:
+    from fabric_token_sdk_tpu.obs import spans_to_chrome_trace
+
+    root = tracer.last_root(root_name)
+    doc: dict = {"wall_s": round(wall_s, 4),
+                 "rows_per_sec": round(n_rows / wall_s, 2) if wall_s else 0}
+    if root is not None:
+        print("\n".join(_tree_lines(root)), file=sys.stderr)
+        doc["span_tree"] = {
+            s.name: round((s.duration or 0) * 1e3, 3) for s in root.walk()}
+    rec = records.last()
+    if rec is not None:
+        doc["last_batch"] = rec.to_dict()
+    doc["pipeline"] = records.summary()
+    if trace_path and tracer.roots:
+        from fabric_token_sdk_tpu.obs import write_chrome_trace
+
+        write_chrome_trace(trace_path, tracer.roots)
+        print(f"chrome trace written to {trace_path}", file=sys.stderr)
+    return doc
+
+
+def _load_corpus(batch: int):
+    import bench
+
+    pp, proofs, coms = bench._load()
+    reps = (batch + len(proofs) - 1) // len(proofs)
+    return pp, (proofs * reps)[:batch], (coms * reps)[:batch]
+
+
+def _mode_range(args, tracer, records) -> dict:
+    from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
+
+    pp, proofs, coms = _load_corpus(args.batch)
+    verifier = BatchRangeVerifier(pp)
+    print("warm-up verify (compiles)", file=sys.stderr)
+    assert verifier.verify(proofs, coms).all()
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        assert verifier.verify(proofs, coms).all()
+    wall = time.perf_counter() - t0
+    return _report(tracer, "range_verify", records, wall,
+                   args.reps * args.batch, args.trace)
+
+
+def _mode_block(args, tracer, records) -> dict:
+    import pickle
+
+    import bench
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.crypto import setup
+
+    pp = setup.PublicParams.deserialize(
+        (bench.BENCH_DIR / "pp.json").read_bytes())
+    blob = pickle.loads(
+        (bench.BENCH_DIR / f"block_{bench.BIT_LENGTH}.pkl").read_bytes())
+    base_t, base_i = blob["transfers"], blob["issues"]
+    n = max(1, args.batch // 4)
+    slice_t = (base_t * (n // len(base_t) + 1))[:n]
+    slice_i = (base_i * (n // len(base_i) + 1))[:n]
+    zk = ZKVerifier(pp, device=True)
+    print("warm-up block (compiles)", file=sys.stderr)
+    t_ok, i_ok = zk.verify_block(slice_t, slice_i)
+    assert t_ok.all() and i_ok.all()
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        t_ok, i_ok = zk.verify_block(slice_t, slice_i)
+        assert t_ok.all() and i_ok.all()
+    wall = time.perf_counter() - t0
+    # 2 range proofs per action
+    return _report(tracer, "zk.verify_block", records, wall,
+                   args.reps * 2 * (len(slice_t) + len(slice_i)), args.trace)
+
+
+def _mode_barrier(args, tracer, records) -> dict:
+    """One chunk with every device stage fenced: honest per-stage sums.
+
+    Uses the production verify() but with the batch capped to one chunk
+    and jax.block_until_ready forced between the span-visible phases via
+    a barriered wrapper around the pass-1 dispatch.
+    """
+    import jax
+
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+
+    batch = min(args.batch, rv._CHUNK_ROWS)
+    pp, proofs, coms = _load_corpus(batch)
+    verifier = rv.BatchRangeVerifier(pp)
+    print("warm-up verify (compiles)", file=sys.stderr)
+    assert verifier.verify(proofs, coms).all()
+
+    dispatch = verifier._dispatch_pass1
+
+    def fenced_dispatch(pfs, cms, ch):
+        out = dispatch(pfs, cms, ch)
+        jax.block_until_ready([x for x in out if hasattr(x, "dtype")])
+        return out
+
+    verifier._dispatch_pass1 = fenced_dispatch
+    try:
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            assert verifier.verify(proofs, coms).all()
+        wall = time.perf_counter() - t0
+    finally:
+        verifier._dispatch_pass1 = dispatch
+    doc = _report(tracer, "range_verify", records, wall,
+                  args.reps * batch, args.trace)
+    doc["note"] = ("barriered: pass-1 fenced before host stage-2; "
+                   "phase sums exceed the pipelined wall time by the "
+                   "host/device overlap")
+    return doc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("range", "block", "barrier"),
+                    default="range")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--trace", help="write Chrome trace-event JSON here")
+    ap.add_argument("--xprof", help="jax.profiler trace dir for root spans")
+    args = ap.parse_args()
+
+    from fabric_token_sdk_tpu.obs import RECORDS, TRACER
+    from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
+
+    configure_jax_cache()
+    if args.xprof:
+        TRACER.profile_dir = args.xprof
+    mode = {"range": _mode_range, "block": _mode_block,
+            "barrier": _mode_barrier}[args.mode]
+    doc = mode(args, TRACER, RECORDS)
+    doc["mode"] = args.mode
+    doc["batch"] = args.batch
+    print(json.dumps(doc, indent=1))
+
+
+if __name__ == "__main__":
+    main()

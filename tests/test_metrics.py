@@ -91,3 +91,195 @@ def test_hot_path_instrumented_end_to_end():
     after = [v for k, v in metrics.GLOBAL.snapshot().items()
              if k[0] == "tcc_requests_total"]
     assert after and after[0] == (before[0] if before else 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# obs subsystem: exposition conformance, span-tree export, shared-lock
+# semantics, registry reset (PR: observability)
+# ---------------------------------------------------------------------------
+
+import json
+import re
+import time
+
+
+def test_with_labels_concurrent_shared_lock():
+    """Parent and label-derived children hammer the SAME series from many
+    threads; the shared registry lock must make every increment stick."""
+    p = MetricsProvider()
+    children = [p.with_labels(node=f"n{i % 2}") for i in range(4)]
+
+    def worker(child):
+        node = child.namespace_labels["node"]
+        for _ in range(2000):
+            child.counter("shared_total").add()
+            # same series reached through the parent with explicit labels
+            p.counter("shared_total", node=node).add()
+
+    threads = [threading.Thread(target=worker, args=(c,)) for c in children]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = p.snapshot()
+    per_node = {k[1]: v for k, v in snap.items() if k[0] == "shared_total"}
+    # 2 children per node label x 2000 iterations x 2 increment routes
+    assert list(per_node.values()) == [8000.0, 8000.0]
+
+
+def test_prometheus_exposition_conformance():
+    """HELP/TYPE blocks, sanitized names (span names contain dots),
+    escaped label values, +Inf bucket — the format a real Prometheus
+    scraper accepts."""
+    p = MetricsProvider()
+    p.counter("zk.sigma verify-total", kind="type_and_sum").add(2)
+    p.histogram("span_zk.verify_block_seconds").observe(0.01)
+    p.counter("esc", path='C:\\dir "x"\nend').add()
+    text = p.prometheus_text()
+
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? \S+$')
+    typed = set()
+    helped = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split(" ", 3)[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert parts[3] in ("counter", "histogram")
+            typed.add(parts[2])
+            continue
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert base in typed or m.group(1) in typed, \
+            f"sample before its TYPE: {line!r}"
+    assert typed == helped
+    # dots/spaces/dashes sanitized out of family names
+    assert "zk_sigma_verify_total" in typed
+    assert "span_zk_verify_block_seconds" in typed
+    # label escaping: backslash, quote, newline
+    assert r'path="C:\\dir \"x\"\nend"' in text
+    # histogram terminal bucket
+    assert 'le="+Inf"' in text
+
+
+def test_chrome_trace_round_trip_preserves_nesting():
+    """Span tree -> Chrome trace-event JSON -> parse -> the tree
+    reconstructs exactly from the parent_id args."""
+    from fabric_token_sdk_tpu.obs import spans_to_chrome_trace
+
+    tr = Tracer(provider=MetricsProvider())
+    with tr.span("root", kind="test"):
+        with tr.span("child_a") as a:
+            a.add_event("marker", detail=1)
+            with tr.span("leaf"):
+                pass
+        with tr.span("child_b"):
+            pass
+    doc = json.loads(json.dumps(spans_to_chrome_trace(tr.roots)))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 for e in xs)
+    children: dict = {}
+    for e in xs:
+        children.setdefault(e["args"]["parent_id"], []).append(e["name"])
+    ids = {e["name"]: e["args"]["span_id"] for e in xs}
+    assert children[None] == ["root"]
+    assert sorted(children[ids["root"]]) == ["child_a", "child_b"]
+    assert children[ids["child_a"]] == ["leaf"]
+    # one trace id across the whole tree
+    assert len({e["args"]["trace_id"] for e in xs}) == 1
+    # the instant event rides its owning span's id
+    inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert [e["name"] for e in inst] == ["marker"]
+    assert inst[0]["args"]["span_id"] == ids["child_a"]
+    # ts/dur containment: children inside the root's window
+    root = next(e for e in xs if e["name"] == "root")
+    for e in xs:
+        assert e["ts"] >= root["ts"] - 1
+        assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1
+
+
+def test_tracer_nesting_via_contextvar_across_helpers():
+    """Layers that never see each other's span objects still produce one
+    connected tree (the node -> tcc -> validator -> batch path)."""
+    tr = Tracer(provider=MetricsProvider())
+
+    def inner_layer():
+        with tr.span("inner"):
+            pass
+
+    with tr.span("outer") as outer:
+        inner_layer()
+    assert [c.name for c in outer.children] == ["inner"]
+    assert tr.last_root("outer") is outer
+    assert outer.children[0].parent_id == outer.span_id
+
+
+def test_global_reset_isolates_state():
+    from fabric_token_sdk_tpu.core.zkatdlog import verifier
+    from fabric_token_sdk_tpu.services import metrics
+
+    metrics.GLOBAL.counter("zk_device_oracle_disagreements_total").add(2)
+    assert verifier.DEVICE_DISAGREEMENTS == 2
+    metrics.GLOBAL.reset()
+    assert verifier.DEVICE_DISAGREEMENTS == 0
+    assert not [k for k in metrics.GLOBAL.snapshot()
+                if k[0] == "zk_device_oracle_disagreements_total"]
+
+
+def test_span_overhead_is_negligible():
+    """Acceptance bound: tracing must stay far below the per-batch work
+    it wraps. Bound is generous (500us/span) vs the observed ~2us so a
+    loaded CI host cannot flake it."""
+    tr = Tracer(provider=MetricsProvider(), keep_spans=8)
+    n = 1000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tr.span("overhead_probe"):
+            pass
+    per_span = (time.perf_counter() - t0) / n
+    assert per_span < 5e-4
+
+
+def test_histogram_percentiles_from_reservoir():
+    p = MetricsProvider()
+    h = p.histogram("lat")
+    for i in range(1, 101):
+        h.observe(i / 1000.0)
+    assert abs(h.percentile(50) - 0.050) <= 0.002
+    assert abs(h.percentile(99) - 0.099) <= 0.002
+
+
+def test_bench_snapshot_rolls_up_registry():
+    from fabric_token_sdk_tpu.obs import bench_snapshot
+    from fabric_token_sdk_tpu.obs.pipeline import (BatchRecord,
+                                                   PipelineRecorder)
+
+    p = MetricsProvider()
+    rec = PipelineRecorder(provider=p)
+    cold = rec.is_cold("range_verify", (16, 256))
+    assert cold and not rec.is_cold("range_verify", (16, 256))
+    rec.record(BatchRecord(kind="range_verify", batch=100, live=90,
+                           bucket=128, padded_rows=128, total_s=0.5,
+                           host_prep_s=0.2, device_execute_s=0.25,
+                           result_fetch_s=0.05, path="combined",
+                           cold_compile=True))
+    rec.record(BatchRecord(kind="range_verify", batch=100, live=100,
+                           bucket=128, padded_rows=128, total_s=0.1,
+                           path="combined"))
+    snap = bench_snapshot(provider=p, recorder=rec)
+    assert snap["pipeline"]["batches"] == 2
+    assert snap["pipeline"]["cold_compiles"] == 1
+    # steady-state stats exclude the cold batch
+    assert snap["pipeline"]["steady"]["batches"] == 1
+    assert snap["pipeline"]["steady"]["p50_s"] == 0.1
+    states = {d["labels"]["state"]
+              for d in snap["counters"]["pipeline_batches_total"]}
+    assert states == {"cold", "steady"}
+    hist = snap["histograms"]["pipeline_steady_seconds"][0]
+    assert hist["count"] == 1 and hist["p50"] == 0.1

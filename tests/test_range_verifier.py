@@ -96,3 +96,40 @@ def test_batch_roundtrip_through_serialization(pp):
         coms.append(com)
     got = BatchRangeVerifier(pp).verify(proofs, coms)
     assert got.all()
+
+
+def test_verify_emits_span_tree_and_batch_record(pp):
+    """Acceptance for the observability PR: one verify() call must leave
+    an exportable span tree with the host_prep / device_execute /
+    result_fetch phase children plus a pipeline BatchRecord."""
+    from fabric_token_sdk_tpu.obs import RECORDS, TRACER, \
+        spans_to_chrome_trace
+
+    proofs, coms = [], []
+    for v in [2, 9, 31]:
+        pf, com = _prove_one(pp, v)
+        proofs.append(pf)
+        coms.append(com)
+    TRACER.clear()
+    RECORDS.reset()
+    assert BatchRangeVerifier(pp).verify(proofs, coms).all()
+
+    root = TRACER.last_root("range_verify")
+    assert root is not None and root.duration > 0
+    phases = {c.name for c in root.children}
+    assert {"host_prep", "device_execute", "result_fetch"} <= phases
+    # phase durations nest inside the root wall time
+    assert sum(c.duration for c in root.children) <= root.duration * 1.05
+    # exportable: Chrome trace events for the whole tree
+    events = spans_to_chrome_trace(TRACER.roots)["traceEvents"]
+    assert {e["name"] for e in events if e["ph"] == "X"} >= phases
+
+    rec = RECORDS.last("range_verify")
+    assert rec is not None
+    assert rec.live == 3 and rec.batch == 3
+    assert rec.padded_rows >= rec.bucket >= rec.live
+    assert 0.0 <= rec.pad_waste < 1.0
+    assert rec.cold_compile  # fresh recorder: first sighting of the shape
+    assert rec.total_s > 0 and rec.host_prep_s >= 0
+    s = RECORDS.summary()
+    assert s["batches"] == 1 and s["cold_compiles"] == 1
