@@ -277,6 +277,179 @@ def _bench_adversarial():
             "vs_baseline": round(BATCH / elapsed / TARGET_BASELINE, 4)}))
 
 
+def _bench_serve():
+    """BENCH_MODE=serve: open-loop arrival bench through the serve/
+    frontend on one chip. A seeded Poisson arrival schedule (default
+    2,500 req/s for 30 s) submits individual range-proof requests to the
+    VerificationService; the bucket scheduler assembles batches under the
+    deadline policy. Prewarm wall is reported separately from steady
+    state; the tail carries p50/p99, deadline-miss and shed counts.
+    Before the run, a mixed clean/forged spot batch asserts the service's
+    demuxed verdicts are bit-identical to the direct batched call."""
+    import asyncio
+    import copy
+
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.harness.txgen import open_loop_arrivals
+    from fabric_token_sdk_tpu.serve import (STATUS_DEADLINE_MISS, STATUS_OK,
+                                            ServeConfig, VerificationService)
+
+    pp, proofs, coms = _load()
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2500"))
+    duration = float(os.environ.get("BENCH_SERVE_SECONDS", "30"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", "16,128,256,512,1024").split(","))
+    cfg = ServeConfig(
+        buckets=buckets,
+        max_wait_s=float(os.environ.get("BENCH_SERVE_WAIT", "0.025")),
+        default_deadline_s=float(os.environ.get("BENCH_SERVE_DEADLINE",
+                                                "2.0")))
+    zk = ZKVerifier(pp, device=True)
+    svc = VerificationService(zk, config=cfg)
+    n = len(proofs)
+
+    async def run():
+        print(f"serve bench: prewarming {len(cfg.buckets)} buckets",
+              file=sys.stderr)
+        prewarm_s = await svc.start()
+        print(f"serve bench: prewarm in {prewarm_s:.1f}s "
+              f"{ {b: round(s, 2) for b, s in svc.prewarm.compile_s.items()} }",
+              file=sys.stderr)
+        forged = copy.deepcopy(proofs[0])
+        forged.data.tau = (forged.data.tau + 1) % (1 << 250)
+        spot_p = [forged] + proofs[:7]
+        spot_c = [coms[0]] + coms[:7]
+        direct = zk._range.verify(spot_p, spot_c)
+        got = await asyncio.gather(*[
+            svc.submit_range(p, c) for p, c in zip(spot_p, spot_c)])
+        assert [r.accepted for r in got] == [bool(x) for x in direct], \
+            "serve verdicts diverge from the direct batched path"
+        arrivals = open_loop_arrivals(rate, duration, seed=11)
+        print(f"serve bench: open loop, {len(arrivals)} arrivals over "
+              f"{duration:.0f}s", file=sys.stderr)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+
+        async def one(i, offset):
+            delay = t0 + offset - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return await svc.submit_range(proofs[i % n], coms[i % n])
+
+        results = await asyncio.gather(
+            *[one(i, off) for i, off in enumerate(arrivals)])
+        elapsed = loop.time() - t0
+        await svc.stop()
+        return prewarm_s, results, elapsed
+
+    prewarm_s, results, elapsed = asyncio.run(run())
+    ok = [r for r in results if r.status == STATUS_OK]
+    misses = sum(r.status == STATUS_DEADLINE_MISS for r in results)
+    shed = len(results) - len(ok) - misses
+    assert all(r.accepted for r in ok), "serve bench corpus rejected"
+    lat = sorted(r.total_s for r in ok) or [0.0]
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    fill = [r.batch_rows / r.bucket for r in ok if r.bucket] or [0.0]
+    print(json.dumps({
+        "metric": f"serve_prewarm_wall_seconds_{BIT_LENGTH}bit",
+        "value": round(prewarm_s, 2),
+        "unit": f"s ({len(cfg.buckets)} buckets, reported separately "
+                "from steady state)",
+    }))
+    value = len(ok) / elapsed
+    print(json.dumps({
+        "metric": f"serve_openloop_req_per_sec_{BIT_LENGTH}bit",
+        "value": round(value, 2),
+        "unit": (f"req/s served (arrival {rate:.0f}/s x {duration:.0f}s; "
+                 f"p50 {p50 * 1e3:.1f}ms p99 {p99 * 1e3:.1f}ms; "
+                 f"deadline_miss {misses} shed {shed}; "
+                 f"mean fill {sum(fill) / len(fill):.2f})"),
+        "vs_baseline": round(value / TARGET_BASELINE, 4),
+    }))
+
+
+def _bench_htlc():
+    """BENCH_MODE=htlc — BASELINE config 4: an HTLC claim batch. Each
+    swap claim pairs the host-side interop checks (script validation +
+    hash-preimage comparison, the ownership leg of the script-owned
+    token) with the claim transfer's device work (Σ + range proofs),
+    routed through the serve scheduler's interactive lane — the lane
+    HTLC traffic takes in production, since a claim races a deadline.
+    Both TMS legs share one in-process pp (single-network stand-in for
+    the cross-network swap)."""
+    import asyncio
+    import hashlib
+    import pickle
+
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.crypto import setup
+    from fabric_token_sdk_tpu.serve import (LANE_INTERACTIVE, ServeConfig,
+                                            VerificationService)
+    from fabric_token_sdk_tpu.services.interop import htlc
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    blob = pickle.loads((BENCH_DIR / f"block_{BIT_LENGTH}.pkl").read_bytes())
+    base_t = blob["transfers"]
+    total = int(os.environ.get("BENCH_HTLC", "512"))
+    claims = (base_t * (total // len(base_t) + 1))[:total]
+    # one script per claim; SHA256 preimage, hex-encoded image (the
+    # reference's default claim framing)
+    swaps = []
+    for i in range(total):
+        preimage = i.to_bytes(8, "big")
+        info = htlc.HashInfo(
+            hash=hashlib.sha256(preimage).hexdigest().encode())
+        swaps.append((htlc.Script(sender=b"alice", recipient=b"bob",
+                                  deadline=time.time() + 3600,
+                                  hash_info=info), preimage))
+    # action buckets 16/64: 64 transfers x 2 outputs = 128 range rows,
+    # the same device bucket the 64-action prewarm compiles
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_HTLC_BUCKETS", "16,64").split(","))
+    cfg = ServeConfig(buckets=buckets, max_wait_s=0.01, prewarm_block=True)
+    zk = ZKVerifier(pp, device=True)
+    svc = VerificationService(zk, config=cfg)
+
+    async def run():
+        print(f"htlc bench: prewarming {len(cfg.buckets)} buckets "
+              "(block path)", file=sys.stderr)
+        prewarm_s = await svc.start()
+        print(f"htlc bench: prewarm in {prewarm_s:.1f}s", file=sys.stderr)
+        t0 = time.perf_counter()
+
+        async def claim_one(i):
+            script, preimage = swaps[i]
+            script.validate(time_reference=time.time())
+            script.hash_info.compare(script.hash_info.image(preimage))
+            raw, ins, outs = claims[i]
+            return await svc.submit_transfer(raw, ins, outs,
+                                             lane=LANE_INTERACTIVE)
+
+        results = await asyncio.gather(
+            *[claim_one(i) for i in range(total)])
+        elapsed = time.perf_counter() - t0
+        await svc.stop()
+        return prewarm_s, results, elapsed
+
+    prewarm_s, results, elapsed = asyncio.run(run())
+    assert all(r.ok and r.accepted for r in results), \
+        "HTLC claim batch failed verification"
+    n_proofs = total * 2  # 2 outputs -> 2 range proofs per claim
+    print(json.dumps({
+        "metric": f"htlc_prewarm_wall_seconds_{BIT_LENGTH}bit",
+        "value": round(prewarm_s, 2),
+        "unit": f"s ({len(cfg.buckets)} buckets incl block path)",
+    }))
+    print(json.dumps({
+        "metric": f"config4_htlc_claims_per_sec_{BIT_LENGTH}bit",
+        "value": round(total / elapsed, 2),
+        "unit": (f"claims/s ({round(n_proofs / elapsed, 1)} proofs/s, "
+                 f"{total} claims, interactive lane)"),
+        "vs_baseline": round(n_proofs / elapsed / TARGET_BASELINE, 4),
+    }))
+
+
 def _write_obs_report() -> None:
     """With BENCH_OBS_OUT=<path> set, dump the observability registry
     (pipeline batch records, pad waste, compile counts, latency
@@ -316,6 +489,16 @@ def main():
 
     if mode == "adversarial":
         _bench_adversarial()
+        return
+
+    if mode == "serve":
+        _bench_serve()
+        return
+
+    if mode == "htlc":
+        if not (BENCH_DIR / f"block_{BIT_LENGTH}.pkl").exists():
+            _regen_block()
+        _bench_htlc()
         return
 
     from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier

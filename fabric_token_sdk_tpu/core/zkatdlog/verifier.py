@@ -61,16 +61,30 @@ class ZKVerifier:
         backend."""
         if self._range is None:
             return 0.0
+        return sum(self.prewarm_shapes(batch_sizes).values())
+
+    def prewarm_shapes(self, batch_sizes=(1,),
+                       include_block: bool = True) -> dict:
+        """Per-shape variant of ``prewarm``: returns ``{batch_size:
+        elapsed_seconds}``. With ``include_block`` the Σ-row and adjust
+        kernels compile alongside each range bucket; without it only the
+        range backend warms (range-only serving frontends)."""
+        if self._range is None:
+            return {b: 0.0 for b in batch_sizes}
         import time as _time
 
-        t0 = _time.perf_counter()
-        self._range.prewarm(batch_sizes=batch_sizes)
-        if self._sigma is not None:
-            self._sigma.prewarm(batch_sizes=batch_sizes)
         from ...models import adjust as _adjust
 
-        _adjust.prewarm(batch_sizes=batch_sizes)
-        return _time.perf_counter() - t0
+        out = {}
+        for b in batch_sizes:
+            t0 = _time.perf_counter()
+            self._range.prewarm(batch_sizes=(b,))
+            if include_block:
+                if self._sigma is not None:
+                    self._sigma.prewarm(batch_sizes=(b,))
+                _adjust.prewarm(batch_sizes=(b,))
+            out[b] = _time.perf_counter() - t0
+        return out
 
     # ------------------------------------------------------------ transfer
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
@@ -226,8 +240,10 @@ class ZKVerifier:
         # 3. dispatch all three device phases back-to-back, collect in
         # dependency order: the commitment adjustment first (it gates the
         # range pass-1 marshal), the Σ verdicts last (nothing reads them
-        # until the final combine). Host challenge re-derivation for Σ
-        # overlaps the range pass's device tail.
+        # until the final combine). Only the Σ kernel execution and its
+        # async D2H copy overlap the range pass: the Σ host challenge
+        # re-derivation lives in the collect() closures, which run after
+        # self._range.verify has blocked to completion.
         blk_span.set_attribute("range_rows", len(range_proofs))
         with _TRACER.span("zk.dispatch"):
             adjust_collect = adjust_points_async(raw_pts, raw_ctts)

@@ -23,6 +23,28 @@ from ..obs import GLOBAL as _METRICS
 from ..obs import bench_snapshot
 
 
+def open_loop_arrivals(rate_hz: float, duration_s: float,
+                       seed: int = 0) -> list[float]:
+    """Deterministic open-loop arrival schedule: Poisson-process offsets
+    (seconds from t0, ascending) at ``rate_hz`` for ``duration_s``.
+
+    Open loop means the schedule is fixed before the run: a slow server
+    does not slow the arrival process down, so queueing/shedding behaviour
+    under overload is actually exercised (closed-loop generators
+    self-throttle and hide it). Seeded, so a bench replays the identical
+    arrival sequence run-over-run (the txgen determinism contract).
+    """
+    if rate_hz <= 0 or duration_s <= 0:
+        return []
+    rng = random.Random(seed)
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(rate_hz)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
 @dataclass
 class TxProfile:
     """The transaction-mix model (txgen model.go equivalents): weights of

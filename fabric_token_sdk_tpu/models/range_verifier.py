@@ -1013,9 +1013,14 @@ class BatchRangeVerifier:
         story for validators: call once after table build; first REAL
         verify then runs at steady-state latency (VERDICT r2 weak #7).
         """
+        return sum(self.prewarm_shapes(batch_sizes).values())
+
+    def prewarm_shapes(self, batch_sizes=(1,)) -> dict:
+        """Per-shape variant of ``prewarm``: returns ``{batch_size:
+        elapsed_seconds}`` so callers (the serve/ prewarm manager) can
+        account each compiled executable separately."""
         import time as _time
 
-        t0 = _time.perf_counter()
         params = self.params
         g = bn254.G1_GENERATOR
         fake = rp.RangeProof(
@@ -1023,9 +1028,12 @@ class BatchRangeVerifier:
                                    tau=1, delta=1),
             ipa=rp.IPA(left=1, right=1,
                        L=[g] * params.rounds, R=[g] * params.rounds))
+        out = {}
         for b in batch_sizes:
+            t0 = _time.perf_counter()
             self.verify([fake] * b, [g] * b)
-        return _time.perf_counter() - t0
+            out[b] = _time.perf_counter() - t0
+        return out
 
     def verify(self, proofs: list[rp.RangeProof], commitments: list,
                exact: bool = False) -> np.ndarray:

@@ -20,7 +20,7 @@ Layer map vs the reference SDK:
     comparison (bench.py / harness/txgen.py)
 """
 
-from .metrics import (Counter, Histogram, MetricsProvider, GLOBAL,
+from .metrics import (Counter, Gauge, Histogram, MetricsProvider, GLOBAL,
                       escape_label_value, sanitize_label_name,
                       sanitize_metric_name)
 from .tracing import Span, Tracer, TRACER
@@ -29,7 +29,7 @@ from .export import spans_to_chrome_trace, write_chrome_trace
 from .report import bench_snapshot, write_bench_report
 
 __all__ = [
-    "Counter", "Histogram", "MetricsProvider", "GLOBAL",
+    "Counter", "Gauge", "Histogram", "MetricsProvider", "GLOBAL",
     "sanitize_metric_name", "sanitize_label_name", "escape_label_value",
     "Span", "Tracer", "TRACER",
     "BatchRecord", "PhaseTimer", "PipelineRecorder", "RECORDS",

@@ -63,6 +63,20 @@ class Counter:
             self.value += delta
 
 
+@dataclass
+class Gauge:
+    value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self.value += delta
+
+
 #: Histogram bucket boundaries (seconds) tuned for proof verification:
 #: sub-ms host ops up to multi-second cold batches.
 _DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
@@ -120,6 +134,7 @@ class MetricsProvider:
     def __init__(self, namespace_labels: dict | None = None):
         self.namespace_labels = dict(namespace_labels or {})
         self._counters: dict[tuple, Counter] = {}
+        self._gauges: dict[tuple, Gauge] = {}
         self._histograms: dict[tuple, Histogram] = {}
         self._help: dict[str, str] = {}
         self._lock = threading.Lock()
@@ -131,6 +146,7 @@ class MetricsProvider:
         serialize on one lock or increments race away."""
         child = MetricsProvider({**self.namespace_labels, **labels})
         child._counters = self._counters
+        child._gauges = self._gauges
         child._histograms = self._histograms
         child._help = self._help
         child._lock = self._lock
@@ -144,6 +160,15 @@ class MetricsProvider:
             if key not in self._counters:
                 self._counters[key] = Counter()
             return self._counters[key]
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        key = _key(name, {**self.namespace_labels, **labels})
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            if key not in self._gauges:
+                self._gauges[key] = Gauge()
+            return self._gauges[key]
 
     def histogram(self, name: str, help: str = "", **labels) -> Histogram:
         key = _key(name, {**self.namespace_labels, **labels})
@@ -160,6 +185,7 @@ class MetricsProvider:
         GLOBAL state cannot leak between tests."""
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._histograms.clear()
             self._help.clear()
 
@@ -169,6 +195,8 @@ class MetricsProvider:
         with self._lock:
             for (name, labels), c in self._counters.items():
                 out[(name, labels)] = c.value
+            for (name, labels), g in self._gauges.items():
+                out[(name, labels)] = g.value
             for (name, labels), h in self._histograms.items():
                 out[(name, labels)] = {"count": h.n, "sum": h.total,
                                        "mean": h.mean}
@@ -197,6 +225,8 @@ class MetricsProvider:
             by_family: dict[str, list] = {}
             for (name, labels), c in self._counters.items():
                 by_family.setdefault(name, []).append(("counter", labels, c))
+            for (name, labels), g in self._gauges.items():
+                by_family.setdefault(name, []).append(("gauge", labels, g))
             for (name, labels), h in self._histograms.items():
                 by_family.setdefault(name, []).append(
                     ("histogram", labels, h))
@@ -209,7 +239,7 @@ class MetricsProvider:
                 lines.append(f"# TYPE {fam} {kind}")
                 for _, labels, inst in sorted(
                         by_family[name], key=lambda t: t[1]):
-                    if isinstance(inst, Counter):
+                    if isinstance(inst, (Counter, Gauge)):
                         lines.append(
                             f"{fam}{fmt_labels(labels)} {inst.value}")
                     else:

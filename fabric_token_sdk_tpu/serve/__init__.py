@@ -1,0 +1,43 @@
+"""serve/ — continuous-batching verification service.
+
+Inference-serving techniques applied to ZK verification: an async
+frontend accepts individual proof/action verification requests, an
+admission controller bounds the queues, a deadline-aware scheduler
+assembles pow-2-bucketed batches (priority lanes, max-wait and deadline
+triggers), a deterministic prewarm manager compiles every emittable
+bucket shape at startup, and the dispatcher demultiplexes per-request
+verdicts bit-identically to the unbatched path. See README "Serving".
+"""
+
+from .admission import AdmissionController
+from .config import LANE_BULK, LANE_INTERACTIVE, LANES, ServeConfig
+from .prewarm import PrewarmManager
+from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
+                      STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
+                      STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
+                      VerifyRequest, VerifyResult)
+from .scheduler import GROUPS, BucketScheduler
+from .service import VerificationService
+
+__all__ = [
+    "AdmissionController",
+    "ACTION_KINDS",
+    "BucketScheduler",
+    "GROUPS",
+    "KIND_ISSUE",
+    "KIND_RANGE",
+    "KIND_TRANSFER",
+    "LANE_BULK",
+    "LANE_INTERACTIVE",
+    "LANES",
+    "PrewarmManager",
+    "ServeConfig",
+    "STATUS_DEADLINE_MISS",
+    "STATUS_ERROR",
+    "STATUS_OK",
+    "STATUS_SHED_DEADLINE",
+    "STATUS_SHED_QUEUE_FULL",
+    "VerificationService",
+    "VerifyRequest",
+    "VerifyResult",
+]

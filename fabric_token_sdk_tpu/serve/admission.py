@@ -1,0 +1,53 @@
+"""Admission control: bounded queues + load shedding + deadline triage.
+
+Sits in front of the bucket scheduler. Every decision is counted in the
+``serve_*`` family so overload shows up as shed counters and queue-depth
+gauges, never as unbounded memory growth or hung callers:
+
+  - a lane at ``queue_capacity`` sheds new arrivals
+    (``serve_shed_total{reason="queue_full"}``);
+  - a request whose remaining deadline is already below the service
+    estimate is shed on arrival (``reason="deadline"``) rather than
+    queued to miss deterministically.
+
+Admission never blocks: the verdict is immediate and the caller's future
+resolves with a terminal status.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs import GLOBAL as _METRICS
+from .config import ServeConfig
+from .request import (STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
+                      VerifyRequest)
+
+
+class AdmissionController:
+    """Stateless policy over the scheduler's queue depths."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+
+    def admit(self, req: VerifyRequest, lane_depth: int) -> str | None:
+        """None admits; otherwise the terminal shed status.
+
+        ``lane_depth`` is the current depth of the request's lane queue.
+        """
+        now = time.perf_counter()
+        if lane_depth >= self.config.queue_capacity:
+            _METRICS.counter(
+                "serve_shed_total",
+                help="Requests refused at admission, by reason",
+                reason="queue_full", lane=req.lane).add()
+            return STATUS_SHED_QUEUE_FULL
+        if req.deadline - now < self.config.service_estimate_s:
+            _METRICS.counter("serve_shed_total", reason="deadline",
+                             lane=req.lane).add()
+            return STATUS_SHED_DEADLINE
+        _METRICS.counter(
+            "serve_requests_total",
+            help="Admitted verification requests",
+            kind=req.kind, lane=req.lane).add()
+        return None

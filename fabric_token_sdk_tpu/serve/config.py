@@ -1,0 +1,83 @@
+"""Serving-policy configuration for the continuous-batching frontend.
+
+One ``ServeConfig`` fixes every knob the scheduler, admission controller,
+and prewarm manager consult, so a deployment's batching behaviour — and
+therefore the exact set of device kernel shapes it can ever request — is
+a single declarative object. The prewarm manager compiles precisely
+``buckets``; the scheduler can emit no other shape. That closed-world
+property is what turns the ad-hoc warm-up story (321.7 s measured in the
+round-5 driver bench) into a bounded, observable startup phase.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..models.batching import B_BUCKETS
+
+#: Priority lane for interactive / latency-sensitive traffic (HTLC claims,
+#: user-facing validates): drained before ``LANE_BULK`` at every batch
+#: assembly, so a backlog of bulk re-verification cannot starve it.
+LANE_INTERACTIVE = "interactive"
+#: Default lane for throughput traffic (auditor re-verify, backlog replay).
+LANE_BULK = "bulk"
+
+LANES = (LANE_INTERACTIVE, LANE_BULK)
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Batch-assembly and admission policy.
+
+    buckets: ascending batch-size buckets the scheduler may emit; a batch
+        never exceeds ``max(buckets)`` rows and its fill ratio is reported
+        against the smallest covering bucket. Defaults to the shared
+        device bucket ladder (models/batching.py) up to 1024 — the
+        measured single-chip throughput peak; 2048 is deliberately NOT
+        emitted by default (round-5 bench: 2,045/s at 1024 vs 1,381/s at
+        2048 — the regression the serve_* metrics exist to observe).
+    max_wait_s: ceiling on how long the oldest queued request may wait
+        before its batch is dispatched regardless of fill.
+    min_batch: smallest batch dispatched on a max-wait/deadline trigger
+        (full buckets dispatch immediately; a due request always
+        dispatches even below min_batch — requests are never held past
+        their dispatch-by time to satisfy min_batch).
+    queue_capacity: per-lane bound; past it the admission controller
+        sheds with ``shed_queue_full`` instead of growing the queue.
+    default_deadline_s: per-request deadline when the caller gives none.
+    service_estimate_s: rough per-batch service time used for two
+        decisions: admission sheds a request whose remaining deadline is
+        below it (it cannot possibly be served in time), and the
+        scheduler dispatches a batch early when waiting longer would
+        push a member past ``deadline - service_estimate_s``.
+    prewarm_block: also compile the block path (Σ + adjust kernels) at
+        startup; range-only services skip it to keep prewarm minimal.
+    """
+
+    buckets: tuple = tuple(b for b in B_BUCKETS if b <= 1024)
+    max_wait_s: float = 0.025
+    min_batch: int = 1
+    queue_capacity: int = 8192
+    default_deadline_s: float = 2.0
+    service_estimate_s: float = 0.0
+    prewarm_block: bool = False
+    lanes: tuple = LANES
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("ServeConfig.buckets must be non-empty")
+        if tuple(sorted(self.buckets)) != tuple(self.buckets):
+            raise ValueError("ServeConfig.buckets must be ascending")
+        if self.min_batch > self.max_batch:
+            raise ValueError("min_batch exceeds max(buckets)")
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket covering ``n`` rows."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch

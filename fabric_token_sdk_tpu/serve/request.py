@@ -1,0 +1,86 @@
+"""Request/result types for the verification service.
+
+A request is one unit the unbatched APIs accept today — a single range
+proof + commitment, or a single transfer/issue action — wrapped with the
+serving envelope (lane, absolute deadline, enqueue timestamp, completion
+future). The service's contract is that the ``accepted`` verdict it
+demultiplexes back is bit-identical to what the direct
+``BatchRangeVerifier.verify`` / ``ZKVerifier.verify_block`` call on the
+same payload would return.
+
+Statuses reject-with-status instead of hanging: a request that cannot be
+served (queue full, impossible deadline, deadline expired while queued)
+completes with a terminal status and ``accepted=None``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+#: Verdict delivered within the deadline.
+STATUS_OK = "ok"
+#: Admission refused: the lane queue is at capacity.
+STATUS_SHED_QUEUE_FULL = "shed_queue_full"
+#: Admission refused: remaining deadline below the service estimate.
+STATUS_SHED_DEADLINE = "shed_deadline"
+#: Deadline expired while queued (never dispatched) or during service;
+#: ``accepted`` carries the verdict when service did complete.
+STATUS_DEADLINE_MISS = "deadline_miss"
+#: The backend raised; ``error`` carries the message.
+STATUS_ERROR = "error"
+
+#: Range-proof request kind: payload is (proof, commitment).
+KIND_RANGE = "range"
+#: Transfer-action kind: payload is (proof_raw, inputs, outputs).
+KIND_TRANSFER = "transfer"
+#: Issue-action kind: payload is (proof_raw, commitments).
+KIND_ISSUE = "issue"
+
+#: Kinds that batch together into one ``verify_block`` call.
+ACTION_KINDS = (KIND_TRANSFER, KIND_ISSUE)
+
+_req_ids = itertools.count(1)
+
+
+@dataclass
+class VerifyResult:
+    """What the submitter's future resolves to."""
+
+    status: str
+    accepted: bool | None = None
+    error: str = ""
+    wait_s: float = 0.0       # enqueue -> dispatch (0 when never dispatched)
+    total_s: float = 0.0      # enqueue -> completion
+    bucket: int = 0           # scheduler bucket the serving batch filled
+    batch_rows: int = 0       # live rows in the serving batch
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+@dataclass
+class VerifyRequest:
+    """One queued verification unit."""
+
+    kind: str                 # KIND_RANGE | KIND_TRANSFER | KIND_ISSUE
+    payload: tuple
+    lane: str
+    deadline: float           # absolute time.perf_counter() instant
+    enqueue_t: float = field(default_factory=time.perf_counter)
+    future: object = None     # asyncio.Future set by the service
+    req_id: int = field(default_factory=lambda: next(_req_ids))
+
+    @property
+    def group(self) -> str:
+        """Batching group: range rows and block actions never mix."""
+        return KIND_RANGE if self.kind == KIND_RANGE else "action"
+
+    def dispatch_by(self, max_wait_s: float, service_estimate_s: float) -> float:
+        """Latest instant this request should leave the queue: its
+        max-wait horizon, pulled earlier if the deadline (minus the
+        service estimate) is tighter."""
+        return min(self.enqueue_t + max_wait_s,
+                   self.deadline - service_estimate_s)

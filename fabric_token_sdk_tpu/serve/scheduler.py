@@ -1,0 +1,152 @@
+"""Deadline-aware pow-2 bucket scheduler (continuous batch assembly).
+
+The scheduling unit is a (group, lane) FIFO: range rows and block
+actions never mix into one device call (they take different backend
+paths), and within a group the interactive lane drains before bulk so
+adversarial/bulk backlog cannot starve latency-sensitive traffic.
+
+Dispatch policy per group — evaluated continuously by the service loop:
+
+  - FULL:     queued rows reach ``max(buckets)`` -> dispatch a full
+              bucket immediately (throughput mode);
+  - WAIT-DUE: the oldest request has waited ``max_wait_s`` and at least
+              ``min_batch`` rows are queued -> dispatch everything;
+  - DEADLINE: the oldest request's ``deadline - service_estimate_s``
+              instant has passed -> dispatch everything queued even
+              below ``min_batch`` (a request is never held into a
+              guaranteed miss to improve batch fill);
+  - otherwise wait until ``next_event()``.
+
+Deadline expiry is handled here too: ``expire()`` removes requests whose
+deadline passed while queued so they complete with ``deadline_miss``
+instead of occupying batch rows a verdict can no longer use.
+
+All state is single-threaded by construction: only the service's event
+loop touches the queues (the device call runs in an executor thread but
+never sees the scheduler).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..obs import GLOBAL as _METRICS
+from .config import ServeConfig
+from .request import KIND_RANGE, VerifyRequest
+
+#: Batching groups, in priority order at assembly time: action batches
+#: carry interactive HTLC/validate traffic more often than bulk ranges.
+GROUPS = ("action", KIND_RANGE)
+
+
+class BucketScheduler:
+    """Per-(group, lane) queues + the batch assembly decision."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self._queues: dict[tuple, deque] = {
+            (g, lane): deque() for g in GROUPS for lane in config.lanes}
+
+    # ------------------------------------------------------------- queues
+    def push(self, req: VerifyRequest) -> None:
+        self._queues[(req.group, req.lane)].append(req)
+        self._gauge(req.lane)
+
+    def lane_depth(self, lane: str) -> int:
+        return sum(len(q) for (g, ln), q in self._queues.items()
+                   if ln == lane)
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _gauge(self, lane: str) -> None:
+        _METRICS.gauge(
+            "serve_queue_depth",
+            help="Queued requests per lane (admitted, not yet dispatched)",
+            lane=lane).set(self.lane_depth(lane))
+
+    # ------------------------------------------------------------- expiry
+    def expire(self, now: float | None = None) -> list[VerifyRequest]:
+        """Pop every queued request whose deadline has already passed."""
+        now = time.perf_counter() if now is None else now
+        out: list[VerifyRequest] = []
+        for (g, lane), q in self._queues.items():
+            if not q or all(r.deadline > now for r in q):
+                continue
+            out.extend(r for r in q if r.deadline <= now)
+            live = [r for r in q if r.deadline > now]
+            q.clear()
+            q.extend(live)
+            self._gauge(lane)
+        return out
+
+    # ----------------------------------------------------------- assembly
+    def _group_rows(self, group: str) -> int:
+        return sum(len(self._queues[(group, lane)])
+                   for lane in self.config.lanes)
+
+    def _due_instants(self, group: str) -> tuple[float, float] | None:
+        """(wait_due, deadline_due) over the group's queue heads, or
+        None when the group is empty. wait_due is the max-wait horizon
+        (gated by min_batch at decision time); deadline_due is the
+        instant deadline pressure forces dispatch regardless of fill."""
+        cfg = self.config
+        heads = [q[0] for lane in cfg.lanes
+                 for q in (self._queues[(group, lane)],) if q]
+        if not heads:
+            return None
+        return (min(r.enqueue_t + cfg.max_wait_s for r in heads),
+                min(r.deadline - cfg.service_estimate_s for r in heads))
+
+    def next_event(self, now: float | None = None) -> float | None:
+        """Earliest future instant a dispatch or expiry becomes due, or
+        None when nothing is queued (the service sleeps until a push)."""
+        instants = []
+        for g in GROUPS:
+            due = self._due_instants(g)
+            if due is None:
+                continue
+            wait_due, deadline_due = due
+            if self._group_rows(g) >= self.config.min_batch:
+                instants.append(min(wait_due, deadline_due))
+            else:
+                instants.append(deadline_due)
+        for q in self._queues.values():
+            if q:
+                instants.append(min(r.deadline for r in q))
+        return min(instants) if instants else None
+
+    def assemble(self, now: float | None = None) -> list[VerifyRequest]:
+        """Pop the next due batch (possibly empty when nothing is due).
+
+        Priority lanes drain first; the batch never exceeds
+        ``max(buckets)`` rows and never mixes groups.
+        """
+        now = time.perf_counter() if now is None else now
+        cfg = self.config
+        for group in GROUPS:
+            rows = self._group_rows(group)
+            if rows == 0:
+                continue
+            wait_due, deadline_due = self._due_instants(group)
+            full = rows >= cfg.max_batch
+            waited = rows >= cfg.min_batch and now >= wait_due
+            forced = now >= deadline_due
+            if not (full or waited or forced):
+                continue
+            batch: list[VerifyRequest] = []
+            for lane in cfg.lanes:           # interactive first
+                q = self._queues[(group, lane)]
+                while q and len(batch) < cfg.max_batch:
+                    batch.append(q.popleft())
+                self._gauge(lane)
+            bucket = cfg.bucket_for(len(batch))
+            _METRICS.histogram(
+                "serve_batch_fill_ratio",
+                help="Live rows / covering bucket, per dispatched batch",
+                group=group).observe(len(batch) / bucket)
+            _METRICS.histogram("serve_batch_rows", group=group).observe(
+                len(batch))
+            return batch
+        return []

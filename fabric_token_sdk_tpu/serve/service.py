@@ -1,0 +1,257 @@
+"""Async verification frontend: continuous batching over the ZK backends.
+
+``VerificationService`` accepts individual verification requests
+(``submit_range`` / ``submit_transfer`` / ``submit_issue``), assembles
+them into pow-2-bucketed batches under the ``ServeConfig`` policy, runs
+each batch through the SAME entry points the unbatched path uses
+(``BatchRangeVerifier.verify`` for range rows, ``ZKVerifier.verify_block``
+for transfer/issue actions), and demultiplexes the per-row verdicts back
+to each caller's future — bit-identically to what a direct call on the
+same payload would return.
+
+Threading model: all scheduler/queue state lives on the event loop; the
+blocking device call runs on a dedicated single-thread executor via
+``run_in_executor``, so exactly one batch is in flight at a time and
+arrivals keep queueing while the device works (continuous batching).
+Futures resolve on the event loop after the executor returns — no
+cross-thread future writes.
+
+Every stage is observable: admission counts, queue-depth gauges,
+wait/dispatch histograms, shed/deadline-miss counters (all under the
+stable ``serve_*`` family), plus a ``serve.dispatch`` span per device
+batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
+from .admission import AdmissionController
+from .config import LANE_BULK, ServeConfig
+from .prewarm import PrewarmManager
+from .request import (KIND_ISSUE, KIND_RANGE, KIND_TRANSFER, STATUS_DEADLINE_MISS,
+                      STATUS_ERROR, STATUS_OK, VerifyRequest, VerifyResult)
+from .scheduler import BucketScheduler
+
+
+class VerificationService:
+    """Continuous-batching frontend over a ``ZKVerifier``.
+
+    Lifecycle::
+
+        svc = VerificationService(zk=zk, config=ServeConfig(...))
+        prewarm_s = await svc.start()      # compiles every bucket shape
+        res = await svc.submit_range(proof, com, deadline_s=0.5)
+        assert res.ok and res.accepted
+        await svc.stop()                   # drains, then stops the loop
+    """
+
+    def __init__(self, zk, config: ServeConfig | None = None):
+        self.zk = zk
+        self.config = config or ServeConfig()
+        self.scheduler = BucketScheduler(self.config)
+        self.admission = AdmissionController(self.config)
+        self.prewarm = PrewarmManager(zk, self.config)
+        self.prewarm_s: float | None = None
+        self.first_dispatch_t: float | None = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-dispatch")
+        self._task: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        self._running = False
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self, prewarm: bool = True) -> float:
+        """Prewarm every configured bucket, then start the dispatch loop.
+
+        Returns the prewarm wall seconds (0.0 when ``prewarm=False``) so
+        callers can report startup cost separately from steady state.
+        """
+        if self._running:
+            return self.prewarm_s or 0.0
+        loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        if prewarm:
+            self.prewarm_s = await loop.run_in_executor(
+                self._executor, self.prewarm.run)
+        self._running = True
+        self._task = asyncio.create_task(self._dispatch_loop())
+        return self.prewarm_s or 0.0
+
+    async def stop(self, drain: bool = True) -> None:
+        """Stop the dispatch loop; with ``drain`` every queued request is
+        served (or expires) first, without it the queued requests complete
+        with ``error``."""
+        if not self._running:
+            return
+        self._running = False
+        if not drain:
+            for req in self._drain_queues():
+                self._resolve(req, VerifyResult(
+                    status=STATUS_ERROR, error="service stopped"))
+        self._wake.set()
+        await self._task
+        self._task = None
+
+    def _drain_queues(self) -> list[VerifyRequest]:
+        out = []
+        for q in self.scheduler._queues.values():
+            out.extend(q)
+            q.clear()
+        return out
+
+    # ------------------------------------------------------------- submit
+    async def submit_range(self, proof, commitment, *, deadline_s=None,
+                           lane: str = LANE_BULK) -> VerifyResult:
+        """Verify one range proof against its commitment."""
+        return await self._submit(KIND_RANGE, (proof, commitment),
+                                  deadline_s, lane)
+
+    async def submit_transfer(self, proof_raw, inputs, outputs, *,
+                              deadline_s=None,
+                              lane: str = LANE_BULK) -> VerifyResult:
+        """Verify one transfer action (serialized proof + token vectors)."""
+        return await self._submit(KIND_TRANSFER, (proof_raw, inputs, outputs),
+                                  deadline_s, lane)
+
+    async def submit_issue(self, proof_raw, outputs, *, deadline_s=None,
+                           lane: str = LANE_BULK) -> VerifyResult:
+        """Verify one issue action (serialized proof + output tokens)."""
+        return await self._submit(KIND_ISSUE, (proof_raw, outputs),
+                                  deadline_s, lane)
+
+    async def _submit(self, kind, payload, deadline_s, lane) -> VerifyResult:
+        if not self._running:
+            raise RuntimeError("VerificationService is not started")
+        now = time.perf_counter()
+        deadline_s = (self.config.default_deadline_s
+                      if deadline_s is None else deadline_s)
+        req = VerifyRequest(kind=kind, payload=payload, lane=lane,
+                            deadline=now + deadline_s, enqueue_t=now,
+                            future=asyncio.get_running_loop().create_future())
+        shed = self.admission.admit(req, self.scheduler.lane_depth(lane))
+        if shed is not None:
+            return VerifyResult(status=shed)
+        self.scheduler.push(req)
+        self._wake.set()
+        return await req.future
+
+    # ------------------------------------------------------ dispatch loop
+    async def _dispatch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            now = time.perf_counter()
+            for req in self.scheduler.expire(now):
+                self._complete_expired(req, now)
+            batch = self.scheduler.assemble(now)
+            if batch:
+                if self.first_dispatch_t is None:
+                    self.first_dispatch_t = now
+                try:
+                    verdicts = await loop.run_in_executor(
+                        self._executor, self._run_batch, batch)
+                except Exception as exc:  # noqa: BLE001 — fail the batch, not the loop
+                    msg = f"{type(exc).__name__}: {exc}"
+                    for req in batch:
+                        self._resolve(req, VerifyResult(
+                            status=STATUS_ERROR, error=msg))
+                else:
+                    self._demux(batch, verdicts, dispatch_t=now)
+                continue
+            if not self._running and self.scheduler.depth() == 0:
+                return
+            nxt = self.scheduler.next_event(time.perf_counter())
+            self._wake.clear()
+            # Re-check after clear: a push between assemble() and clear()
+            # would otherwise sleep through its max-wait window.
+            if self.scheduler.depth() and nxt is None:
+                continue
+            try:
+                if nxt is None:
+                    await self._wake.wait()
+                else:
+                    delay = max(0.0, nxt - time.perf_counter())
+                    await asyncio.wait_for(self._wake.wait(), delay)
+            except asyncio.TimeoutError:
+                pass
+
+    # ----------------------------------------------------- device batches
+    def _run_batch(self, batch: list[VerifyRequest]) -> np.ndarray:
+        """Runs on the executor thread: one blocking device call.
+
+        Returns a bool vector aligned with ``batch`` order.
+        """
+        group = batch[0].group
+        t0 = time.perf_counter()
+        with _TRACER.span("serve.dispatch", group=group, rows=len(batch),
+                          bucket=self.config.bucket_for(len(batch))):
+            if group == KIND_RANGE:
+                proofs = [r.payload[0] for r in batch]
+                coms = [r.payload[1] for r in batch]
+                verdicts = np.asarray(
+                    self.zk._range.verify(proofs, coms), dtype=bool)
+            else:
+                transfers, issues, slots = [], [], []
+                for r in batch:
+                    if r.kind == KIND_TRANSFER:
+                        slots.append((0, len(transfers)))
+                        transfers.append(r.payload)
+                    else:
+                        slots.append((1, len(issues)))
+                        issues.append(r.payload)
+                t_ok, i_ok = self.zk.verify_block(transfers, issues)
+                t_ok = np.asarray(t_ok, dtype=bool).reshape(-1)
+                i_ok = np.asarray(i_ok, dtype=bool).reshape(-1)
+                verdicts = np.asarray(
+                    [(i_ok if which else t_ok)[idx] for which, idx in slots],
+                    dtype=bool)
+        _METRICS.counter("serve_batches_total",
+                         help="Device batches dispatched",
+                         group=group).add()
+        _METRICS.histogram("serve_dispatch_seconds",
+                           help="Blocking device-call wall per batch",
+                           group=group).observe(time.perf_counter() - t0)
+        return verdicts
+
+    # -------------------------------------------------------- completion
+    def _demux(self, batch, verdicts, dispatch_t: float) -> None:
+        now = time.perf_counter()
+        rows = len(batch)
+        bucket = self.config.bucket_for(rows)
+        for req, acc in zip(batch, verdicts):
+            miss = now > req.deadline
+            status = STATUS_DEADLINE_MISS if miss else STATUS_OK
+            if miss:
+                _METRICS.counter(
+                    "serve_deadline_miss_total",
+                    help="Requests whose deadline passed, by where",
+                    where="served").add()
+            _METRICS.histogram(
+                "serve_wait_seconds",
+                help="Enqueue -> dispatch wait per request",
+                lane=req.lane).observe(dispatch_t - req.enqueue_t)
+            self._resolve(req, VerifyResult(
+                status=status, accepted=bool(acc),
+                wait_s=dispatch_t - req.enqueue_t,
+                total_s=now - req.enqueue_t,
+                bucket=bucket, batch_rows=rows))
+
+    def _complete_expired(self, req: VerifyRequest, now: float) -> None:
+        _METRICS.counter("serve_deadline_miss_total",
+                         where="queued").add()
+        self._resolve(req, VerifyResult(
+            status=STATUS_DEADLINE_MISS,
+            total_s=now - req.enqueue_t))
+
+    def _resolve(self, req: VerifyRequest, result: VerifyResult) -> None:
+        _METRICS.counter("serve_results_total",
+                         help="Completed requests by terminal status",
+                         status=result.status).add()
+        if req.future is not None and not req.future.done():
+            req.future.set_result(result)

@@ -141,6 +141,25 @@ class TokenNode:
         self._tms[tmsid] = tms
         return tms
 
+    def verification_frontend(self, config=None):
+        """The continuous-batching verification service (serve/) over this
+        node's validator ZK backend. One cached instance per node — the
+        service owns the device dispatch queue, so every caller must share
+        it. Raises for drivers without a device ZK backend (fabtoken).
+        The caller starts/stops it (``await svc.start()``)."""
+        if getattr(self, "_serve", None) is not None:
+            return self._serve
+        zk = getattr(getattr(self.cc.validator, "pp", None),
+                     "zk_verifier", None)
+        if zk is None or zk._range is None:
+            raise RuntimeError(
+                f"node [{self.name}]: validator has no device ZK backend "
+                "to serve")
+        from ..serve import VerificationService
+
+        self._serve = VerificationService(zk, config=config)
+        return self._serve
+
     def prometheus_text(self) -> str:
         """This node's scrape endpoint body (what an FSC node's operations
         port would serve). The registry is process-global; per-node series

@@ -68,6 +68,7 @@ _HEAVY_MODULES = {
     "test_ops_windowed.py",
     "test_parallel.py",
     "test_sigma_device.py",
+    "test_serve_smoke.py",
 }
 #: Modules whose parametrized variants each load their OWN big kernel set
 #: (multibit: 16/32/64-bit tables+executables) — one process per TEST,

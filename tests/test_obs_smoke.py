@@ -117,3 +117,51 @@ def test_node_scoped_exposition_and_bench_report(net):
     assert "ttx_executions_total" in doc["counters"]
     lat = doc["histograms"]["ttx_execute_seconds"][0]
     assert lat["count"] > 0 and lat["p95"] >= lat["p50"] > 0
+
+
+# serve/ frontend families — stable interface like the layers above
+# (ROADMAP stable-metric-names; also asserted device-side in
+# tests/test_serve_smoke.py, which runs without cryptography)
+EXPECTED_SERVE_FAMILIES = (
+    "serve_requests_total",
+    "serve_results_total",
+    "serve_batches_total",
+    "serve_queue_depth",
+    "serve_batch_fill_ratio",
+    "serve_batch_rows",
+    "serve_wait_seconds",
+    "serve_dispatch_seconds",
+)
+
+
+def test_serve_family_stable_names():
+    import asyncio
+
+    import numpy as np
+
+    from fabric_token_sdk_tpu.serve import ServeConfig, VerificationService
+
+    class _FakeRange:
+        def verify(self, proofs, commitments):
+            return np.ones(len(proofs), dtype=bool)
+
+    class _FakeZK:
+        _range = _FakeRange()
+
+    GLOBAL.reset()
+    svc = VerificationService(
+        _FakeZK(), config=ServeConfig(buckets=(4,), max_wait_s=0.001))
+
+    async def run():
+        await svc.start(prewarm=False)
+        out = await asyncio.gather(*[
+            svc.submit_range(object(), object()) for _ in range(6)])
+        await svc.stop()
+        return out
+
+    results = asyncio.run(run())
+    assert all(r.ok for r in results)
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_SERVE_FAMILIES:
+        assert fam in text, f"serve family silent: {fam}"
+    assert "# TYPE serve_queue_depth gauge" in text

@@ -1,0 +1,194 @@
+"""Scheduler/admission policy of the serve/ frontend — pure logic.
+
+Every test runs against a fake ZK backend (or the bare BucketScheduler),
+so this module exercises scheduling decisions — deadline expiry,
+load shedding, lane priority, dispatch triggers — with no device work.
+The bit-identity tests against a REAL verifier live in
+tests/test_serve_smoke.py.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+
+from fabric_token_sdk_tpu.serve import (LANE_BULK, LANE_INTERACTIVE,
+                                        STATUS_DEADLINE_MISS, STATUS_OK,
+                                        STATUS_SHED_DEADLINE,
+                                        STATUS_SHED_QUEUE_FULL,
+                                        BucketScheduler, ServeConfig,
+                                        VerificationService, VerifyRequest)
+from fabric_token_sdk_tpu.serve.request import (KIND_ISSUE, KIND_RANGE,
+                                                KIND_TRANSFER)
+
+
+class _FakeRange:
+    def verify(self, proofs, commitments):
+        return np.ones(len(proofs), dtype=bool)
+
+
+class _FakeZK:
+    """Accept-everything backend: policy tests need scheduling, not ZK."""
+
+    def __init__(self):
+        self._range = _FakeRange()
+
+    def verify_block(self, transfers, issues):
+        return (np.ones(len(transfers), dtype=bool),
+                np.ones(len(issues), dtype=bool))
+
+    def prewarm_shapes(self, batch_sizes=(1,), include_block=True):
+        return {b: 0.0 for b in batch_sizes}
+
+
+def test_deadline_expiry_rejects_with_status_not_hang():
+    # min_batch=2 and one lone request: the wait trigger can never fire,
+    # so the request must complete via deadline expiry — promptly, with a
+    # terminal status, not by hanging until some batch fills.
+    cfg = ServeConfig(buckets=(4,), min_batch=2, max_wait_s=30.0)
+    svc = VerificationService(_FakeZK(), config=cfg)
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await asyncio.wait_for(
+            svc.submit_range(object(), object(), deadline_s=0.05),
+            timeout=5.0)
+        await svc.stop()
+        return res
+
+    res = asyncio.run(run())
+    assert res.status == STATUS_DEADLINE_MISS
+    assert res.accepted is None
+
+
+def test_load_shed_when_queue_full():
+    cfg = ServeConfig(buckets=(4,), min_batch=3, max_wait_s=30.0,
+                      queue_capacity=2)
+    svc = VerificationService(_FakeZK(), config=cfg)
+
+    async def run():
+        await svc.start(prewarm=False)
+        held = [asyncio.create_task(
+            svc.submit_range(object(), object(), deadline_s=0.3))
+            for _ in range(2)]
+        await asyncio.sleep(0.05)  # let both enqueue (below min_batch)
+        res3 = await svc.submit_range(object(), object(), deadline_s=0.3)
+        first_two = await asyncio.gather(*held)
+        await svc.stop()
+        return res3, first_two
+
+    res3, first_two = asyncio.run(run())
+    assert res3.status == STATUS_SHED_QUEUE_FULL
+    # the queued pair still completes with a terminal status
+    assert all(r.status in (STATUS_OK, STATUS_DEADLINE_MISS)
+               for r in first_two)
+
+
+def test_admission_sheds_impossible_deadline():
+    cfg = ServeConfig(buckets=(4,), service_estimate_s=0.5)
+    svc = VerificationService(_FakeZK(), config=cfg)
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await svc.submit_range(object(), object(), deadline_s=0.1)
+        await svc.stop()
+        return res
+
+    res = asyncio.run(run())
+    assert res.status == STATUS_SHED_DEADLINE
+
+
+def test_full_bucket_dispatches_without_waiting():
+    cfg = ServeConfig(buckets=(2, 4), max_wait_s=30.0)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    for i in range(4):
+        sched.push(VerifyRequest(kind=KIND_RANGE, payload=(i,),
+                                 lane=LANE_BULK, deadline=now + 60,
+                                 enqueue_t=now))
+    batch = sched.assemble(now)  # nobody waited, but the bucket is full
+    assert len(batch) == 4
+    assert sched.depth() == 0
+
+
+def test_below_min_batch_not_dispatched_until_deadline_pressure():
+    cfg = ServeConfig(buckets=(4,), min_batch=2, max_wait_s=0.001,
+                      service_estimate_s=0.05)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    sched.push(VerifyRequest(kind=KIND_RANGE, payload=(0,), lane=LANE_BULK,
+                             deadline=now + 1.0, enqueue_t=now))
+    # max-wait elapsed but rows < min_batch: held
+    assert sched.assemble(now + 0.01) == []
+    # deadline pressure (deadline - service_estimate passed): dispatched
+    # even below min_batch rather than held into a guaranteed miss
+    batch = sched.assemble(now + 0.96)
+    assert len(batch) == 1
+
+
+def test_interactive_lane_drains_first():
+    cfg = ServeConfig(buckets=(8,))
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    reqs = []
+    for i, lane in enumerate([LANE_BULK, LANE_BULK, LANE_INTERACTIVE]):
+        r = VerifyRequest(kind=KIND_RANGE, payload=(i,), lane=lane,
+                          deadline=now + 10, enqueue_t=now)
+        reqs.append(r)
+        sched.push(r)
+    batch = sched.assemble(now + 1.0)  # max-wait trigger
+    assert [r.lane for r in batch] == [LANE_INTERACTIVE, LANE_BULK,
+                                       LANE_BULK]
+    assert batch[0] is reqs[2]
+
+
+def test_groups_never_mix_and_actions_demux():
+    # transfers + issues batch together (one verify_block); range rows
+    # never ride an action batch
+    cfg = ServeConfig(buckets=(8,), max_wait_s=0.001)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    for kind in (KIND_RANGE, KIND_TRANSFER, KIND_ISSUE, KIND_RANGE):
+        sched.push(VerifyRequest(kind=kind, payload=(kind,), lane=LANE_BULK,
+                                 deadline=now + 10, enqueue_t=now))
+    first = sched.assemble(now + 0.01)
+    second = sched.assemble(now + 0.01)
+    groups = {tuple(sorted({r.group for r in b})) for b in (first, second)}
+    assert groups == {("action",), (KIND_RANGE,)}
+    assert len(first) + len(second) == 4
+
+    # the action batch demuxes per-kind through verify_block
+    class _CountingZK(_FakeZK):
+        def verify_block(self, transfers, issues):
+            t = np.array([True] * len(transfers), dtype=bool)
+            i = np.array([False] * len(issues), dtype=bool)  # reject issues
+            return t, i
+
+    svc = VerificationService(_CountingZK(), config=cfg)
+
+    async def run():
+        await svc.start(prewarm=False)
+        res_t, res_i = await asyncio.gather(
+            svc.submit_transfer(b"raw", [], []),
+            svc.submit_issue(b"raw", []))
+        await svc.stop()
+        return res_t, res_i
+
+    res_t, res_i = asyncio.run(run())
+    assert res_t.status == STATUS_OK and res_t.accepted is True
+    assert res_i.status == STATUS_OK and res_i.accepted is False
+
+
+def test_expired_requests_never_occupy_batch_rows():
+    cfg = ServeConfig(buckets=(4,), max_wait_s=30.0, min_batch=4)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    dead = VerifyRequest(kind=KIND_RANGE, payload=("dead",), lane=LANE_BULK,
+                         deadline=now - 0.01, enqueue_t=now - 1.0)
+    live = VerifyRequest(kind=KIND_RANGE, payload=("live",), lane=LANE_BULK,
+                         deadline=now + 10, enqueue_t=now)
+    sched.push(dead)
+    sched.push(live)
+    expired = sched.expire(now)
+    assert expired == [dead]
+    assert sched.depth() == 1

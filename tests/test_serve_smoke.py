@@ -1,0 +1,123 @@
+"""Tier-1 CPU smoke for the serve/ frontend against a REAL verifier:
+boot with tiny buckets, drive ~32 concurrent asyncio requests, and
+assert (a) prewarm populated every configured bucket BEFORE the first
+dispatch, (b) the demuxed verdicts are bit-identical to the direct
+batched call — single-request batch, max-batch, and mixed
+accept/reject — and (c) the stable ``serve_*`` metric family is
+emitted. Buckets (4, 8) pad to the shared 16-row device bucket, so the
+compiled kernels are the same persistent-cache entries the other heavy
+tests use; ONE module-scoped ZKVerifier pays the table build once."""
+
+import asyncio
+import random
+
+import pytest
+
+from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+from fabric_token_sdk_tpu.obs import GLOBAL
+from fabric_token_sdk_tpu.serve import (LANE_BULK, LANE_INTERACTIVE,
+                                        STATUS_OK, ServeConfig,
+                                        VerificationService)
+
+rng = random.Random(0x5E47E)
+
+BIT_LENGTH = 16
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(BIT_LENGTH)
+
+
+@pytest.fixture(scope="module")
+def zk(pp):
+    return ZKVerifier(pp, device=True)
+
+
+def _prove_one(pp, value):
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    bf = bn254.fr_rand()
+    com = bn254.g1_add(bn254.g1_mul(cg[0], value), bn254.g1_mul(cg[1], bf))
+    proof = rp.range_prove(com, value, cg, bf, rpp.left_generators,
+                           rpp.right_generators, rpp.P, rpp.Q,
+                           rpp.number_of_rounds, rpp.bit_length)
+    return proof, com
+
+
+# Batch EXECUTION alone can exceed the production 2 s default deadline on a
+# slow CPU host; the smoke validates prewarm/demux correctness, not SLO
+# timing, so give requests a deadline no sane run can miss.
+_SMOKE_DEADLINE_S = 900.0
+
+
+def test_serve_smoke_concurrent_requests(pp, zk):
+    cfg = ServeConfig(buckets=(4, 8), max_wait_s=0.005,
+                      default_deadline_s=_SMOKE_DEADLINE_S)
+    svc = VerificationService(zk, config=cfg)
+    pairs = [_prove_one(pp, rng.randrange(1 << BIT_LENGTH))
+             for _ in range(4)]
+
+    async def run():
+        prewarm_s = await svc.start()
+        # every configured bucket compiled before anything dispatched
+        assert svc.prewarm.ready == set(cfg.buckets)
+        assert svc.first_dispatch_t is None
+        assert prewarm_s > 0.0
+        results = await asyncio.gather(*[
+            svc.submit_range(
+                *pairs[i % len(pairs)],
+                lane=LANE_INTERACTIVE if i % 2 else LANE_BULK)
+            for i in range(32)])
+        await svc.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert len(results) == 32
+    assert all(r.ok and r.accepted for r in results)
+    assert svc.first_dispatch_t is not None
+    # every request rode a batch bounded by the configured ladder
+    assert all(1 <= r.batch_rows <= cfg.max_batch for r in results)
+
+    # the stable serve_* family (ROADMAP bench interface) is emitted
+    text = GLOBAL.prometheus_text()
+    for fam in ("serve_requests_total", "serve_queue_depth",
+                "serve_batches_total", "serve_batch_fill_ratio",
+                "serve_batch_rows", "serve_wait_seconds",
+                "serve_dispatch_seconds", "serve_prewarm_seconds",
+                "serve_results_total"):
+        assert fam in text, f"missing serve family: {fam}"
+
+
+def test_serve_verdicts_bit_identical_to_direct(pp, zk):
+    proofs, coms = [], []
+    for i in range(8):
+        pf, com = _prove_one(pp, rng.randrange(1 << BIT_LENGTH))
+        if i in (1, 4, 6):  # mixed accept/reject demux
+            pf.data.tau = bn254.fr_add(pf.data.tau, 1)
+        proofs.append(pf)
+        coms.append(com)
+
+    direct_single = zk._range.verify([proofs[0]], [coms[0]])
+    direct_full = zk._range.verify(proofs, coms)
+
+    cfg = ServeConfig(buckets=(8,), max_wait_s=0.01,
+                      default_deadline_s=_SMOKE_DEADLINE_S)
+    svc = VerificationService(zk, config=cfg)
+
+    async def run():
+        await svc.start(prewarm=False)  # kernels already warm (same zk)
+        # single-request path: one request alone -> a 1-row batch
+        single = await svc.submit_range(proofs[0], coms[0])
+        # max-batch path: 8 concurrent submits fill bucket 8
+        full = await asyncio.gather(*[
+            svc.submit_range(p, c) for p, c in zip(proofs, coms)])
+        await svc.stop()
+        return single, full
+
+    single, full = asyncio.run(run())
+    assert single.status == STATUS_OK
+    assert single.accepted == bool(direct_single[0])
+    assert all(r.status == STATUS_OK for r in full)
+    assert [r.accepted for r in full] == [bool(x) for x in direct_full]
