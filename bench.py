@@ -369,6 +369,143 @@ def _bench_serve():
     }))
 
 
+def _bench_chaos():
+    """BENCH_MODE=chaos: the serve bench under a seeded fault schedule.
+
+    A FaultInjector shims the device entry points with ~10% transient
+    faults (plus optional stalls / permanent faults, env-tunable) while
+    an open-loop Poisson arrival stream submits range requests through a
+    resilient VerificationService (retry + breaker + watchdog + host
+    fallback). Reports availability (fraction of requests that got a
+    verdict), p99 under faults, the fraction served by the host
+    fallback, and verdict bit-parity against the fault-free expectation
+    (a seeded slice of the arrivals submits a forged proof, so parity is
+    checked on both accepts and rejects). Same seeds → same fault
+    schedule → reproducible run."""
+    import asyncio
+    import copy
+
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.harness.txgen import open_loop_arrivals
+    from fabric_token_sdk_tpu.obs import GLOBAL as METRICS
+    from fabric_token_sdk_tpu.resilience import (FaultInjector,
+                                                 ResilienceConfig)
+    from fabric_token_sdk_tpu.serve import (SERVED_BY_HOST,
+                                            STATUS_DEADLINE_MISS, STATUS_OK,
+                                            ServeConfig, VerificationService)
+
+    pp, proofs, coms = _load()
+    rate = float(os.environ.get("BENCH_CHAOS_RATE", "1000"))
+    duration = float(os.environ.get("BENCH_CHAOS_SECONDS", "30"))
+    fault_rate = float(os.environ.get("BENCH_CHAOS_FAULT", "0.10"))
+    stall_rate = float(os.environ.get("BENCH_CHAOS_STALL", "0.0"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", "16,128,256,512,1024").split(","))
+    cfg = ServeConfig(
+        buckets=buckets,
+        max_wait_s=float(os.environ.get("BENCH_SERVE_WAIT", "0.025")),
+        default_deadline_s=float(os.environ.get("BENCH_SERVE_DEADLINE",
+                                                "5.0")))
+    resil = ResilienceConfig(retry_attempts=4, retry_base_s=0.002,
+                             retry_cap_s=0.05, seed=seed,
+                             breaker_reset_s=1.0,
+                             watchdog_timeout_s=120.0)
+    zk = ZKVerifier(pp, device=True)
+    injector = FaultInjector(seed=seed, transient_rate=fault_rate,
+                             stall_rate=stall_rate, stall_s=0.02)
+    faulty = injector.wrap(zk)
+    svc = VerificationService(faulty, config=cfg, resilience=resil)
+    n = len(proofs)
+    forged = copy.deepcopy(proofs[0])
+    forged.data.tau = (forged.data.tau + 1) % (1 << 250)
+    # fault-free expectation: the corpus verifies, the forgery does not
+    FORGE_EVERY = 97
+
+    async def run():
+        print(f"chaos bench: prewarming {len(cfg.buckets)} buckets",
+              file=sys.stderr)
+        prewarm_s = await svc.start()
+        arrivals = open_loop_arrivals(rate, duration, seed=11)
+        print(f"chaos bench: open loop, {len(arrivals)} arrivals over "
+              f"{duration:.0f}s at transient_rate={fault_rate}",
+              file=sys.stderr)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+
+        async def one(i, offset):
+            delay = t0 + offset - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if i % FORGE_EVERY == 0:
+                return await svc.submit_range(forged, coms[0])
+            return await svc.submit_range(proofs[i % n], coms[i % n])
+
+        results = await asyncio.gather(
+            *[one(i, off) for i, off in enumerate(arrivals)])
+        elapsed = loop.time() - t0
+        await svc.stop(timeout_s=60.0)
+        return prewarm_s, results, elapsed
+
+    prewarm_s, results, elapsed = asyncio.run(run())
+    total = len(results)
+    served = [r for r in results if r.status in (STATUS_OK,
+                                                STATUS_DEADLINE_MISS)
+              and r.accepted is not None]
+    # availability per the acceptance definition: every request reached a
+    # non-error terminal status (errors and shutdowns are the outages;
+    # sheds and misses are explicit policy, not unavailability)
+    errors = sum(r.status in ("error", "shutdown") for r in results)
+    availability = (total - errors) / total if total else 0.0
+    fallback_frac = (sum(r.served_by == SERVED_BY_HOST for r in served)
+                     / len(served)) if served else 0.0
+    parity_bad = sum(
+        1 for i, r in enumerate(results)
+        if r.accepted is not None
+        and r.accepted != (i % FORGE_EVERY != 0))
+    ok = [r for r in results if r.status == STATUS_OK]
+    lat = sorted(r.total_s for r in ok) or [0.0]
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    snap = METRICS.snapshot()
+
+    def fam(name):
+        return sum(v for (fam_name, _), v in snap.items()
+                   if fam_name == name)
+
+    print(json.dumps({
+        "metric": f"chaos_availability_{BIT_LENGTH}bit",
+        "value": round(availability, 6),
+        "unit": (f"non-error terminal fraction ({total - errors}/{total}; "
+                 f"{len(served)} with verdicts; "
+                 f"transient_rate={fault_rate} stall_rate={stall_rate} "
+                 f"seed={seed}; injected "
+                 f"{int(fam('resil_injected_faults_total'))} faults, "
+                 f"{int(fam('resil_retries_total'))} retries, "
+                 f"{int(fam('resil_fallback_batches_total'))} fallback "
+                 f"batches, {int(fam('resil_watchdog_trips_total'))} "
+                 "watchdog trips)"),
+    }))
+    print(json.dumps({
+        "metric": f"chaos_p99_seconds_{BIT_LENGTH}bit",
+        "value": round(p99, 4),
+        "unit": (f"s (p50 {p50 * 1e3:.1f}ms; prewarm {prewarm_s:.1f}s; "
+                 f"{len(ok) / elapsed:.0f} req/s served under faults)"),
+    }))
+    print(json.dumps({
+        "metric": f"chaos_fallback_fraction_{BIT_LENGTH}bit",
+        "value": round(fallback_frac, 6),
+        "unit": "fraction of served requests answered by the host path",
+    }))
+    print(json.dumps({
+        "metric": f"chaos_verdict_parity_errors_{BIT_LENGTH}bit",
+        "value": parity_bad,
+        "unit": (f"verdicts diverging from the fault-free expectation "
+                 f"(0 == bit-identical; {total} requests)"),
+    }))
+    assert parity_bad == 0, "chaos bench: verdict parity broken under faults"
+
+
 def _bench_htlc():
     """BENCH_MODE=htlc — BASELINE config 4: an HTLC claim batch. Each
     swap claim pairs the host-side interop checks (script validation +
@@ -493,6 +630,10 @@ def main():
 
     if mode == "serve":
         _bench_serve()
+        return
+
+    if mode == "chaos":
+        _bench_chaos()
         return
 
     if mode == "htlc":

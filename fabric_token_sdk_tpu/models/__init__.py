@@ -3,6 +3,15 @@
 Each verifier lowers a zkatdlog proof-system check to batched multi-scalar
 multiplications executed on device (SURVEY.md §7 item 3), replacing the
 reference's sequential per-proof Go loops (rangecorrectness.go:137-162).
+
+Device entry-point contract: ``BatchRangeVerifier.verify(proofs,
+commitments)`` (here) and ``ZKVerifier.verify_block(transfers, issues)``
+(core/zkatdlog/verifier.py) are the two blocking device dispatch points
+the serve/ frontend funnels batches through, and therefore the exact
+surface resilience/ shims: ``FaultInjector.wrap`` intercepts them for
+chaos testing, and the retry/breaker/watchdog/fallback machinery
+assumes each call either returns a complete verdict vector or raises —
+no partial results. Keep new verifiers on that contract.
 """
 
 from . import range_verifier  # noqa: F401

@@ -152,6 +152,17 @@ class MetricsProvider:
         child._lock = self._lock
         return child
 
+    def describe(self, name: str, help: str) -> None:
+        """Register a family's HELP text without creating an instrument.
+
+        Lets a subsystem hoist all its family metadata to one place
+        (first-registration-wins otherwise makes the HELP line depend on
+        which call site runs first). Idempotent; an existing description
+        is kept."""
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+
     def counter(self, name: str, help: str = "", **labels) -> Counter:
         key = _key(name, {**self.namespace_labels, **labels})
         with self._lock:

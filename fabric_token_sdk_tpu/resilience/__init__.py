@@ -1,0 +1,109 @@
+"""resilience/ — fault tolerance for the verification pipeline.
+
+The serving promise (ROADMAP: "heavy traffic from millions of users")
+includes the days the hardware misbehaves. This package makes failure a
+first-class, *testable* behaviour instead of an unhandled exception:
+
+  - :class:`FaultInjector` / :class:`FaultyZK` (faults.py): seeded,
+    replayable fault schedules shimmed over the device entry points —
+    transient/permanent errors, stalls, verdict corruption;
+  - :class:`RetryPolicy` (retry.py): shared error classification +
+    exponential backoff with seeded decorrelated jitter, used by the
+    serve dispatcher AND the services-tier retry loops (selector,
+    certifier, custodian broadcast);
+  - :class:`CircuitBreaker` (breaker.py): closed/open/half-open over a
+    failure-rate window, with half-open probe accounting;
+  - :class:`HostFallbackVerifier` (fallback.py): routes a batch through
+    the pure-host proof verifiers for bit-identical verdicts when the
+    device path is exhausted or the breaker is open;
+  - :class:`DispatchWatchdog` (watchdog.py): bounds the blocking device
+    dispatch so a hung call is abandoned (fresh executor thread) and
+    retried/fallen back instead of freezing the dispatcher.
+
+Everything reports under the stable ``resil_*`` metric family
+(``resil_retries_total``, ``resil_breaker_state``,
+``resil_breaker_transitions_total``, ``resil_fallback_batches_total``,
+``resil_fallback_rows_total``, ``resil_watchdog_trips_total``,
+``resil_injected_faults_total``) plus ``resil.retry`` /
+``resil.fallback`` spans. See README "Resilience".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .breaker import (STATE_CLOSED, STATE_HALF_OPEN, STATE_OPEN,
+                      CircuitBreaker)
+from .fallback import HostFallbackVerifier
+from .faults import (ACTIONS, FaultInjector, FaultyZK,
+                     InjectedPermanentError, InjectedTransientError)
+from .retry import (TRANSIENT_TYPES, RetryExhausted, RetryPolicy,
+                    TransientError)
+from .watchdog import DispatchWatchdog, WatchdogTimeout
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Declarative policy for the serve/ dispatcher's failure handling.
+
+    retry_attempts / retry_base_s / retry_cap_s / seed: the shared
+        :class:`RetryPolicy` over transient device errors (seeded
+        decorrelated jitter — deterministic backoff schedules).
+    breaker_*: the :class:`CircuitBreaker` window (failure rate over the
+        last ``breaker_window`` outcomes, openable once
+        ``breaker_min_volume`` outcomes exist), open-state dwell time,
+        and half-open probe count.
+    watchdog_timeout_s: hang budget for one blocking device dispatch;
+        ``None`` disables the watchdog.
+    fallback: route exhausted/broken-open batches through the pure-host
+        verifiers (bit-identical verdicts, ``served_by="host"``) instead
+        of failing them. Requires the backend to expose ``pp`` (or an
+        explicit fallback verifier passed to the service).
+    """
+
+    retry_attempts: int = 3
+    retry_base_s: float = 0.005
+    retry_cap_s: float = 0.25
+    seed: int = 0
+    breaker_window: int = 64
+    breaker_failure_threshold: float = 0.5
+    breaker_min_volume: int = 8
+    breaker_reset_s: float = 5.0
+    breaker_half_open_probes: int = 2
+    watchdog_timeout_s: float | None = 60.0
+    fallback: bool = True
+
+    def build_retry_policy(self, op: str = "serve_dispatch") -> RetryPolicy:
+        return RetryPolicy(max_attempts=self.retry_attempts,
+                           base_s=self.retry_base_s, cap_s=self.retry_cap_s,
+                           seed=self.seed, op=op)
+
+    def build_breaker(self, name: str = "device") -> CircuitBreaker:
+        return CircuitBreaker(
+            window=self.breaker_window,
+            failure_threshold=self.breaker_failure_threshold,
+            min_volume=self.breaker_min_volume,
+            reset_timeout_s=self.breaker_reset_s,
+            half_open_probes=self.breaker_half_open_probes,
+            name=name)
+
+
+__all__ = [
+    "ACTIONS",
+    "CircuitBreaker",
+    "DispatchWatchdog",
+    "FaultInjector",
+    "FaultyZK",
+    "HostFallbackVerifier",
+    "InjectedPermanentError",
+    "InjectedTransientError",
+    "ResilienceConfig",
+    "RetryExhausted",
+    "RetryPolicy",
+    "STATE_CLOSED",
+    "STATE_HALF_OPEN",
+    "STATE_OPEN",
+    "TRANSIENT_TYPES",
+    "TransientError",
+    "WatchdogTimeout",
+]

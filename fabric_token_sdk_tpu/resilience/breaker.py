@@ -1,0 +1,159 @@
+"""Circuit breaker over the device dispatch path (closed/open/half-open).
+
+Retry absorbs *isolated* transient failures; the breaker handles the
+*correlated* ones — a device that has started failing most calls. Retrying
+into a sick backend multiplies load exactly when the backend can least
+absorb it and adds a full retry-budget of latency to every batch, so once
+the failure rate over a sliding outcome window crosses the threshold the
+breaker OPENS and the dispatcher routes straight to the host fallback
+(bit-identical, slower, never wrong). After ``reset_timeout_s`` the
+breaker goes HALF-OPEN and admits a bounded number of probe calls: enough
+consecutive probe successes close it again, a single probe failure snaps
+it back open.
+
+The state machine is pure logic (injectable clock) so the transition
+tests run without sleeping; state changes are observable via the
+``resil_breaker_state`` gauge (0=closed, 1=half-open, 2=open) and the
+``resil_breaker_transitions_total{to=...}`` counter.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..obs import GLOBAL as _METRICS
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
+#: Gauge encoding of the state (dashboard-friendly ordering: higher is
+#: further from healthy).
+_STATE_VALUE = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Failure-rate breaker with half-open probe accounting.
+
+    - CLOSED: every call allowed; outcomes land in a sliding window of
+      the last ``window`` results. With at least ``min_volume`` outcomes
+      recorded and a failure rate >= ``failure_threshold`` -> OPEN.
+    - OPEN: every call refused until ``reset_timeout_s`` has elapsed
+      since opening, then -> HALF-OPEN.
+    - HALF-OPEN: up to ``half_open_probes`` calls admitted concurrently;
+      ``half_open_probes`` successes -> CLOSED (window cleared), any
+      failure -> OPEN (timer restarts).
+
+    ``force_open()`` latches the breaker open until ``force_close()`` —
+    the operational kill switch (and the chaos bench's
+    all-traffic-to-host mode).
+    """
+
+    def __init__(self, window: int = 64, failure_threshold: float = 0.5,
+                 min_volume: int = 8, reset_timeout_s: float = 5.0,
+                 half_open_probes: int = 2, clock=time.monotonic,
+                 name: str = "device"):
+        self.window = window
+        self.failure_threshold = failure_threshold
+        self.min_volume = min_volume
+        self.reset_timeout_s = reset_timeout_s
+        self.half_open_probes = max(1, half_open_probes)
+        self.clock = clock
+        self.name = name
+        self.state = STATE_CLOSED
+        self._events: deque = deque(maxlen=window)  # True == failure
+        self._opened_at: float | None = None
+        self._probes_inflight = 0
+        self._probe_successes = 0
+        self._forced_open = False
+        self._publish()
+
+    # ------------------------------------------------------------- plumbing
+    def _publish(self) -> None:
+        _METRICS.gauge(
+            "resil_breaker_state",
+            help="Circuit-breaker state (0=closed, 1=half-open, 2=open)",
+            breaker=self.name).set(_STATE_VALUE[self.state])
+
+    def _transition(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        _METRICS.counter(
+            "resil_breaker_transitions_total",
+            help="Circuit-breaker state transitions, by target state",
+            breaker=self.name, to=state).add()
+        self._publish()
+
+    @property
+    def failure_rate(self) -> float:
+        if not self._events:
+            return 0.0
+        return sum(self._events) / len(self._events)
+
+    # ------------------------------------------------------------ decisions
+    def allow(self) -> bool:
+        """May the caller attempt a device call right now?
+
+        In HALF-OPEN this *claims* a probe slot: pair every ``allow() ==
+        True`` with exactly one ``record_success``/``record_failure``.
+        """
+        if self._forced_open:
+            return False
+        if self.state == STATE_OPEN:
+            if (self._opened_at is not None
+                    and self.clock() - self._opened_at
+                    >= self.reset_timeout_s):
+                self._probes_inflight = 0
+                self._probe_successes = 0
+                self._transition(STATE_HALF_OPEN)
+            else:
+                return False
+        if self.state == STATE_HALF_OPEN:
+            if self._probes_inflight >= self.half_open_probes:
+                return False
+            self._probes_inflight += 1
+            return True
+        return True
+
+    def record_success(self) -> None:
+        if self.state == STATE_HALF_OPEN:
+            self._probes_inflight = max(0, self._probes_inflight - 1)
+            self._probe_successes += 1
+            if self._probe_successes >= self.half_open_probes:
+                self._events.clear()
+                self._transition(STATE_CLOSED)
+            return
+        self._events.append(False)
+
+    def record_failure(self) -> None:
+        if self.state == STATE_HALF_OPEN:
+            self._probes_inflight = max(0, self._probes_inflight - 1)
+            self._open()
+            return
+        self._events.append(True)
+        if (self.state == STATE_CLOSED
+                and len(self._events) >= self.min_volume
+                and self.failure_rate >= self.failure_threshold):
+            self._open()
+
+    def _open(self) -> None:
+        self._opened_at = self.clock()
+        self._transition(STATE_OPEN)
+
+    # ------------------------------------------------------------ overrides
+    def force_open(self) -> None:
+        """Latch open (kill switch): every call refused until
+        ``force_close``. Used by ops and by the chaos bench's
+        all-host-fallback phase."""
+        self._forced_open = True
+        self._opened_at = self.clock()
+        self._transition(STATE_OPEN)
+
+    def force_close(self) -> None:
+        self._forced_open = False
+        self._events.clear()
+        self._probes_inflight = 0
+        self._probe_successes = 0
+        self._transition(STATE_CLOSED)

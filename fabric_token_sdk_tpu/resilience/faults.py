@@ -1,0 +1,179 @@
+"""Deterministic fault injection over the device verification entry points.
+
+A serving system cannot claim failure behaviour it has never executed.
+``FaultInjector`` produces a *seeded, replayable* fault schedule and
+``FaultyZK`` applies it as a shim over the two device entry points the
+serve/ frontend dispatches to (``BatchRangeVerifier.verify`` via
+``zk._range`` and ``ZKVerifier.verify_block``), without touching the real
+verifier code:
+
+  - ``transient``  — raise :class:`InjectedTransientError` before the
+    call (a retryable hiccup: the next attempt may succeed);
+  - ``permanent``  — raise :class:`InjectedPermanentError` (a
+    non-retryable failure: classification must route it to fallback /
+    error immediately, not burn the retry budget);
+  - ``stall``      — sleep ``stall_s`` before the call (latency fault;
+    with a watchdog configured, long stalls become abandoned dispatches);
+  - ``corrupt``    — let the call run, then flip one seeded entry of the
+    verdict vector (a lying device: the hazard the chaos bench's parity
+    check exists to expose — nothing downstream can detect it, which is
+    exactly the point).
+
+Determinism contract: the schedule is a pure function of ``(seed, call
+index)`` — exactly one RNG draw per call decides the action (corruption
+indices come from an independent RNG so they never perturb the action
+stream). Same seed, same call sequence -> same faults, so a chaos run is
+replayable and a parity check against a fault-free run is meaningful.
+
+Every injected fault counts in ``resil_injected_faults_total{kind}``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+
+from ..obs import GLOBAL as _METRICS
+from .retry import TransientError
+
+
+class InjectedTransientError(TransientError):
+    """A scripted transient device failure (retry should absorb it)."""
+
+
+class InjectedPermanentError(RuntimeError):
+    """A scripted permanent device failure (never retried)."""
+
+
+#: Action precedence when rates are given: the single uniform draw is
+#: compared against the cumulative rate ladder in this order.
+ACTIONS = ("transient", "permanent", "stall", "corrupt")
+
+
+class FaultInjector:
+    """Seeded fault schedule over an abstract sequence of device calls.
+
+    Either give per-action rates (each call draws once and picks the
+    action whose cumulative-probability band the draw lands in) or an
+    explicit ``schedule`` mapping call index -> action name, which
+    overrides the rates entirely (scripted scenarios: "fail calls 3..5,
+    stall call 9").
+    """
+
+    def __init__(self, seed: int = 0, transient_rate: float = 0.0,
+                 permanent_rate: float = 0.0, stall_rate: float = 0.0,
+                 stall_s: float = 0.02, corrupt_rate: float = 0.0,
+                 schedule: dict | None = None, sleep=time.sleep):
+        rates = (transient_rate, permanent_rate, stall_rate, corrupt_rate)
+        if any(r < 0 for r in rates) or sum(rates) > 1.0 + 1e-9:
+            raise ValueError("fault rates must be >= 0 and sum to <= 1")
+        self.seed = seed
+        self.rates = dict(zip(ACTIONS, rates))
+        self.stall_s = stall_s
+        self.schedule = schedule
+        self.calls = 0
+        self.injected: dict[str, int] = {a: 0 for a in ACTIONS}
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        # independent stream for corruption row picks: keeps the action
+        # schedule a pure function of (seed, call index)
+        self._corrupt_rng = random.Random((seed << 1) ^ 0x5EEDFA17)
+
+    # ------------------------------------------------------------- schedule
+    def next_action(self) -> str | None:
+        """The scripted action for the next call (consumes one call
+        index; exactly one RNG draw in rate mode)."""
+        idx = self.calls
+        self.calls += 1
+        if self.schedule is not None:
+            return self.schedule.get(idx)
+        u = self._rng.random()
+        edge = 0.0
+        for action in ACTIONS:
+            edge += self.rates[action]
+            if u < edge:
+                return action
+        return None
+
+    def fire(self, entry: str) -> str | None:
+        """Apply the next scheduled action at device entry point
+        ``entry``. Raises for error faults, sleeps for stalls, and
+        returns ``"corrupt"`` when the caller must corrupt the verdict
+        vector after the real call."""
+        action = self.next_action()
+        if action is None:
+            return None
+        self.injected[action] += 1
+        _METRICS.counter(
+            "resil_injected_faults_total",
+            help="Faults injected into device entry points, by kind",
+            kind=action, entry=entry).add()
+        call_idx = self.calls - 1
+        if action == "transient":
+            raise InjectedTransientError(
+                f"injected transient fault at {entry} (call {call_idx})")
+        if action == "permanent":
+            raise InjectedPermanentError(
+                f"injected permanent fault at {entry} (call {call_idx})")
+        if action == "stall":
+            self._sleep(self.stall_s)
+            return None
+        return action  # "corrupt"
+
+    def corrupt_verdicts(self, verdicts) -> np.ndarray:
+        """Flip one seeded entry of a verdict vector (device lying)."""
+        out = np.array(verdicts, dtype=bool).reshape(-1).copy()
+        if out.size:
+            out[self._corrupt_rng.randrange(out.size)] ^= True
+        return out
+
+    def wrap(self, zk) -> "FaultyZK":
+        return FaultyZK(zk, self)
+
+
+class _FaultyRange:
+    """Shim over ``BatchRangeVerifier``: faults fire at ``verify``."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self._injector = injector
+
+    def verify(self, proofs, commitments, **kwargs):
+        action = self._injector.fire("range.verify")
+        out = self._inner.verify(proofs, commitments, **kwargs)
+        if action == "corrupt":
+            return self._injector.corrupt_verdicts(out)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyZK:
+    """Shim over ``ZKVerifier``: same surface, scripted faults at the
+    device entry points. Prewarm and host-oracle paths pass through
+    unfaulted (faults model the *device dispatch*, not startup compiles
+    or host crypto)."""
+
+    def __init__(self, zk, injector: FaultInjector):
+        self._inner = zk
+        self.injector = injector
+        inner_range = getattr(zk, "_range", None)
+        self._range = (None if inner_range is None
+                       else _FaultyRange(inner_range, injector))
+
+    def verify_block(self, transfers, issues):
+        action = self.injector.fire("verify_block")
+        t_ok, i_ok = self._inner.verify_block(transfers, issues)
+        if action == "corrupt":
+            # one flipped row across the block, action stream untouched
+            if len(t_ok):
+                t_ok = self.injector.corrupt_verdicts(t_ok)
+            elif len(i_ok):
+                i_ok = self.injector.corrupt_verdicts(i_ok)
+        return t_ok, i_ok
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,0 +1,148 @@
+"""Shared retry policy: error classification + seeded decorrelated jitter.
+
+One policy object answers the three questions every retry loop in the
+codebase used to answer ad-hoc (selector sleep-backoff, certifier bounded
+retry, custodian broadcast attempts, and now the serve/ device dispatch):
+
+  - *is this error worth retrying?* — ``is_transient`` classifies by
+    exception type: anything deriving from :class:`TransientError` (the
+    base the fault injector and watchdog raise), plus the stdlib
+    transient families (``ConnectionError``, ``TimeoutError``) and
+    runtime errors whose type name marks a device/runtime hiccup
+    (``XlaRuntimeError`` — jaxlib raises these for RESOURCE_EXHAUSTED /
+    transient dispatch failures). Everything else is permanent: retrying
+    a proof that deterministically fails verification only burns time.
+  - *how long to wait?* — decorrelated jitter
+    (``sleep = min(cap, uniform(base, prev * 3))``), drawn from a seeded
+    ``random.Random`` so a bench or test replays the identical backoff
+    schedule run-over-run. Jitter decorrelates retry storms across
+    callers; the seed keeps each caller deterministic.
+  - *how do retries show up?* — every pause increments
+    ``resil_retries_total{op=...}`` and runs inside a ``resil.retry``
+    span, so uniform backoff behaviour is also uniformly observable.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
+
+
+class TransientError(RuntimeError):
+    """Base class for errors that are worth retrying by construction
+    (injected transient faults, watchdog-abandoned dispatches)."""
+
+
+class RetryExhausted(RuntimeError):
+    """Every attempt failed with a transient error.
+
+    Carries ``last_error`` and ``attempts`` so callers can reformat the
+    failure in their own domain vocabulary (the custodian's
+    ``broadcast ... failed after N attempts`` message, the certifier's
+    ``certification request failed`` one).
+    """
+
+    def __init__(self, msg: str, last_error: Exception | None,
+                 attempts: int):
+        super().__init__(msg)
+        self.last_error = last_error
+        self.attempts = attempts
+
+
+#: Exception types retried by default. Type NAMES are matched too (see
+#: ``is_transient``) so jaxlib's XlaRuntimeError is covered without
+#: importing jaxlib here.
+TRANSIENT_TYPES: tuple = (TransientError, ConnectionError, TimeoutError)
+
+#: Runtime-error type names treated as transient device hiccups.
+_TRANSIENT_TYPE_NAMES = frozenset({"XlaRuntimeError"})
+
+
+class RetryPolicy:
+    """Bounded retry with deterministic decorrelated-jitter backoff.
+
+    Exception-driven loops use :meth:`call`; manual loops (the selector's
+    "not enough unlocked tokens yet" retry, the serve dispatcher's async
+    loop) consume :meth:`delays` and report each wait via :meth:`pause`
+    (or an ``asyncio.sleep`` of their own, counting the retry
+    themselves). Two policies built with the same parameters and seed
+    produce the same delay sequence — the determinism contract the chaos
+    bench and the state-machine tests rely on.
+    """
+
+    def __init__(self, max_attempts: int = 3, base_s: float = 0.01,
+                 cap_s: float = 1.0, seed: int = 0,
+                 transient_types: tuple = TRANSIENT_TYPES,
+                 op: str = "retry"):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.seed = seed
+        self.transient_types = transient_types
+        self.op = op
+        self._rng = random.Random(seed)
+
+    # -------------------------------------------------------- classification
+    def is_transient(self, exc: BaseException) -> bool:
+        """Transient (retry) vs permanent (surface immediately)."""
+        if isinstance(exc, self.transient_types):
+            return True
+        return type(exc).__name__ in _TRANSIENT_TYPE_NAMES
+
+    # ------------------------------------------------------------- schedule
+    def delays(self):
+        """Infinite generator of backoff sleeps (seconds), decorrelated
+        jitter: ``min(cap, uniform(base, prev * 3))``. Consumes this
+        policy's seeded RNG, so the sequence is deterministic per
+        instance."""
+        prev = self.base_s
+        while True:
+            prev = min(self.cap_s, self._rng.uniform(self.base_s,
+                                                     max(self.base_s,
+                                                         prev * 3)))
+            yield prev
+
+    def pause(self, delay_s: float, op: str | None = None,
+              sleep=time.sleep) -> None:
+        """One observable retry wait: counter + span + sleep."""
+        op = op or self.op
+        _METRICS.counter(
+            "resil_retries_total",
+            help="Retry waits taken, by logical operation",
+            op=op).add()
+        with _TRACER.span("resil.retry", op=op,
+                          sleep_s=round(delay_s, 6)):
+            if delay_s > 0:
+                sleep(delay_s)
+
+    # ----------------------------------------------------------------- call
+    def call(self, fn, *, op: str | None = None, classify=None,
+             sleep=time.sleep):
+        """Run ``fn()`` with bounded retry on transient errors.
+
+        Permanent errors (per ``classify``, default :meth:`is_transient`)
+        propagate unchanged on the attempt that raised them; transient
+        exhaustion raises :class:`RetryExhausted` wrapping the last
+        error.
+        """
+        op = op or self.op
+        classify = classify or self.is_transient
+        delays = self.delays()
+        last: Exception | None = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not classify(exc):
+                    raise
+                last = exc
+                if attempt + 1 < self.max_attempts:
+                    self.pause(next(delays), op=op, sleep=sleep)
+        raise RetryExhausted(
+            f"{op} failed after {self.max_attempts} attempts: {last}",
+            last_error=last, attempts=self.max_attempts) from last

@@ -1,0 +1,79 @@
+"""Watchdog over the blocking device dispatch: abandon hung calls.
+
+The serve/ dispatcher funnels every device call through one executor
+thread. A device dispatch that *hangs* (runtime deadlock, collective
+stuck waiting for a peer, driver wedge) would therefore freeze the whole
+dispatcher: the event loop sits in ``await run_in_executor(...)`` forever
+and every queued request misses its deadline with no terminal status.
+
+``DispatchWatchdog`` owns that executor and bounds the wait: past
+``timeout_s`` the future is abandoned, the executor is REPLACED with a
+fresh single thread (the hung thread cannot be killed — Python offers no
+thread cancellation — so it is orphaned and its eventual result, if any,
+is discarded), ``resil_watchdog_trips_total`` counts the trip, and
+:class:`WatchdogTimeout` (a :class:`TransientError`) surfaces to the
+retry/fallback machinery. The dispatcher stays live; the batch gets
+retried on the fresh thread or falls back to the host path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+
+from ..obs import GLOBAL as _METRICS
+from .retry import TransientError
+
+
+class WatchdogTimeout(TransientError):
+    """A device dispatch exceeded the watchdog budget and was abandoned."""
+
+
+class DispatchWatchdog:
+    """Single-thread dispatch executor with a hang budget.
+
+    ``timeout_s=None`` disables the watchdog (plain awaited executor
+    call — the pre-resilience behaviour). The executor is always
+    accessed through :attr:`executor` because a trip swaps it out.
+    """
+
+    def __init__(self, timeout_s: float | None = None,
+                 thread_name_prefix: str = "serve-dispatch"):
+        self.timeout_s = timeout_s
+        self.trips = 0
+        self._prefix = thread_name_prefix
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=thread_name_prefix)
+
+    @property
+    def executor(self) -> ThreadPoolExecutor:
+        return self._executor
+
+    async def run(self, fn, *args):
+        """Run ``fn(*args)`` on the dispatch thread, bounded by
+        ``timeout_s``. Raises :class:`WatchdogTimeout` on a trip."""
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(self._executor, fn, *args)
+        if self.timeout_s is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, self.timeout_s)
+        except asyncio.TimeoutError:
+            self._abandon()
+            raise WatchdogTimeout(
+                f"device dispatch exceeded {self.timeout_s}s and was "
+                "abandoned (fresh dispatch thread started)") from None
+
+    def _abandon(self) -> None:
+        self.trips += 1
+        _METRICS.counter(
+            "resil_watchdog_trips_total",
+            help="Hung device dispatches abandoned by the watchdog").add()
+        # The hung thread is unkillable; orphan it and start fresh so the
+        # next dispatch does not queue behind the wedge.
+        self._executor.shutdown(wait=False)
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=self._prefix)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._executor.shutdown(wait=wait)

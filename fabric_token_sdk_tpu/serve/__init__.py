@@ -13,9 +13,10 @@ from .admission import AdmissionController
 from .config import LANE_BULK, LANE_INTERACTIVE, LANES, ServeConfig
 from .prewarm import PrewarmManager
 from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
+                      SERVED_BY_DEVICE, SERVED_BY_HOST,
                       STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
                       STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
-                      VerifyRequest, VerifyResult)
+                      STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
 from .scheduler import GROUPS, BucketScheduler
 from .service import VerificationService
 
@@ -31,12 +32,15 @@ __all__ = [
     "LANE_INTERACTIVE",
     "LANES",
     "PrewarmManager",
+    "SERVED_BY_DEVICE",
+    "SERVED_BY_HOST",
     "ServeConfig",
     "STATUS_DEADLINE_MISS",
     "STATUS_ERROR",
     "STATUS_OK",
     "STATUS_SHED_DEADLINE",
     "STATUS_SHED_QUEUE_FULL",
+    "STATUS_SHUTDOWN",
     "VerificationService",
     "VerifyRequest",
     "VerifyResult",

@@ -30,6 +30,13 @@ STATUS_SHED_DEADLINE = "shed_deadline"
 STATUS_DEADLINE_MISS = "deadline_miss"
 #: The backend raised; ``error`` carries the message.
 STATUS_ERROR = "error"
+#: The service was stopped (bounded-drain timeout) before this request
+#: could be served; terminal, never a hang.
+STATUS_SHUTDOWN = "shutdown"
+
+#: ``VerifyResult.served_by`` values: which backend produced the verdict.
+SERVED_BY_DEVICE = "device"
+SERVED_BY_HOST = "host"
 
 #: Range-proof request kind: payload is (proof, commitment).
 KIND_RANGE = "range"
@@ -55,6 +62,7 @@ class VerifyResult:
     total_s: float = 0.0      # enqueue -> completion
     bucket: int = 0           # scheduler bucket the serving batch filled
     batch_rows: int = 0       # live rows in the serving batch
+    served_by: str = ""       # "device" | "host" (fallback); "" if unserved
 
     @property
     def ok(self) -> bool:

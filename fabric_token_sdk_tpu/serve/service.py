@@ -10,34 +10,62 @@ to each caller's future — bit-identically to what a direct call on the
 same payload would return.
 
 Threading model: all scheduler/queue state lives on the event loop; the
-blocking device call runs on a dedicated single-thread executor via
-``run_in_executor``, so exactly one batch is in flight at a time and
-arrivals keep queueing while the device works (continuous batching).
-Futures resolve on the event loop after the executor returns — no
-cross-thread future writes.
+blocking device call runs on a dedicated single-thread executor (owned by
+the resilience watchdog) via ``run_in_executor``, so exactly one batch is
+in flight at a time and arrivals keep queueing while the device works
+(continuous batching). Futures resolve on the event loop after the
+executor returns — no cross-thread future writes.
+
+Failure handling (resilience/): with a :class:`ResilienceConfig` the
+dispatch is wrapped in retry (transient errors, seeded decorrelated
+jitter), a circuit breaker (failure-rate window, half-open probes), a
+watchdog that abandons hung device calls on a fresh executor thread, and
+a host fallback that routes exhausted/broken-open batches through the
+pure-host proof verifiers for bit-identical verdicts. Results carry
+``served_by="device"`` or ``"host"``; a batch only terminates in
+``error`` when every layer is out of options.
 
 Every stage is observable: admission counts, queue-depth gauges,
 wait/dispatch histograms, shed/deadline-miss counters (all under the
-stable ``serve_*`` family), plus a ``serve.dispatch`` span per device
-batch.
+stable ``serve_*`` family), retry/breaker/fallback/watchdog counters
+(``resil_*``), plus ``serve.dispatch`` / ``resil.retry`` /
+``resil.fallback`` spans.
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
+from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
+    ResilienceConfig
 from .admission import AdmissionController
 from .config import LANE_BULK, ServeConfig
 from .prewarm import PrewarmManager
-from .request import (KIND_ISSUE, KIND_RANGE, KIND_TRANSFER, STATUS_DEADLINE_MISS,
-                      STATUS_ERROR, STATUS_OK, VerifyRequest, VerifyResult)
+from .request import (KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
+                      SERVED_BY_DEVICE, SERVED_BY_HOST,
+                      STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
+                      STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
 from .scheduler import BucketScheduler
+
+#: Family metadata for every serve_* instrument this module touches,
+#: hoisted so the HELP line cannot depend on which call site registers a
+#: family first (``_complete_expired`` vs ``_demux`` used to race on
+#: ``serve_deadline_miss_total``). Registered via ``describe`` at service
+#: construction — call-order independent by construction.
+_SERVE_FAMILIES = {
+    "serve_batches_total": "Device batches dispatched",
+    "serve_dispatch_seconds": "Blocking device-call wall per batch",
+    "serve_deadline_miss_total": "Requests whose deadline passed, by where",
+    "serve_wait_seconds": "Enqueue -> dispatch wait per request",
+    "serve_results_total": "Completed requests by terminal status",
+    "resil_fallback_batches_total":
+        "Batches served by the host fallback path, by group",
+}
 
 
 class VerificationService:
@@ -45,23 +73,45 @@ class VerificationService:
 
     Lifecycle::
 
-        svc = VerificationService(zk=zk, config=ServeConfig(...))
+        svc = VerificationService(zk, config=ServeConfig(...),
+                                  resilience=ResilienceConfig(...))
         prewarm_s = await svc.start()      # compiles every bucket shape
         res = await svc.submit_range(proof, com, deadline_s=0.5)
-        assert res.ok and res.accepted
-        await svc.stop()                   # drains, then stops the loop
+        assert res.ok and res.accepted and res.served_by == "device"
+        await svc.stop(timeout_s=30.0)     # bounded drain, then stop
+
+    ``resilience=None`` (the default) preserves the bare dispatch
+    behaviour: one attempt, no breaker, no watchdog, no fallback —
+    failures complete the batch with ``status="error"``.
     """
 
-    def __init__(self, zk, config: ServeConfig | None = None):
+    def __init__(self, zk, config: ServeConfig | None = None,
+                 resilience: ResilienceConfig | None = None,
+                 fallback=None):
         self.zk = zk
         self.config = config or ServeConfig()
+        self.resilience = resilience
         self.scheduler = BucketScheduler(self.config)
         self.admission = AdmissionController(self.config)
         self.prewarm = PrewarmManager(zk, self.config)
         self.prewarm_s: float | None = None
         self.first_dispatch_t: float | None = None
-        self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="serve-dispatch")
+        for fam, help_text in _SERVE_FAMILIES.items():
+            _METRICS.describe(fam, help_text)
+        self._watchdog = DispatchWatchdog(
+            timeout_s=(resilience.watchdog_timeout_s
+                       if resilience is not None else None))
+        if resilience is not None:
+            self._retry = resilience.build_retry_policy(op="serve_dispatch")
+            self._breaker = resilience.build_breaker(name="device")
+            if fallback is None and resilience.fallback \
+                    and getattr(zk, "pp", None) is not None:
+                fallback = HostFallbackVerifier(zk.pp)
+        else:
+            self._retry = None
+            self._breaker = None
+        self._fallback = fallback
+        self._inflight: list[VerifyRequest] = []
         self._task: asyncio.Task | None = None
         self._wake: asyncio.Event | None = None
         self._running = False
@@ -78,16 +128,24 @@ class VerificationService:
         loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
         if prewarm:
+            # no watchdog here: first-compile legitimately takes minutes
             self.prewarm_s = await loop.run_in_executor(
-                self._executor, self.prewarm.run)
+                self._watchdog.executor, self.prewarm.run)
         self._running = True
         self._task = asyncio.create_task(self._dispatch_loop())
         return self.prewarm_s or 0.0
 
-    async def stop(self, drain: bool = True) -> None:
-        """Stop the dispatch loop; with ``drain`` every queued request is
-        served (or expires) first, without it the queued requests complete
-        with ``error``."""
+    async def stop(self, drain: bool = True,
+                   timeout_s: float | None = None) -> None:
+        """Stop the dispatch loop.
+
+        With ``drain`` every queued request is served (or expires) first;
+        without it the queued requests complete with ``error``. A
+        ``timeout_s`` bounds the drain: past it, still-queued and
+        in-flight requests resolve with the terminal ``shutdown`` status
+        and the loop is cancelled — ``stop`` can no longer block forever
+        behind a hung device call.
+        """
         if not self._running:
             return
         self._running = False
@@ -96,7 +154,23 @@ class VerificationService:
                 self._resolve(req, VerifyResult(
                     status=STATUS_ERROR, error="service stopped"))
         self._wake.set()
-        await self._task
+        if timeout_s is None:
+            await self._task
+        else:
+            try:
+                await asyncio.wait_for(asyncio.shield(self._task),
+                                       timeout_s)
+            except asyncio.TimeoutError:
+                for req in self._drain_queues() + list(self._inflight):
+                    self._resolve(req, VerifyResult(
+                        status=STATUS_SHUTDOWN,
+                        error=f"service stopped after {timeout_s}s drain "
+                              "timeout"))
+                self._task.cancel()
+                try:
+                    await self._task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
         self._task = None
 
     def _drain_queues(self) -> list[VerifyRequest]:
@@ -144,7 +218,6 @@ class VerificationService:
 
     # ------------------------------------------------------ dispatch loop
     async def _dispatch_loop(self) -> None:
-        loop = asyncio.get_running_loop()
         while True:
             now = time.perf_counter()
             for req in self.scheduler.expire(now):
@@ -153,16 +226,19 @@ class VerificationService:
             if batch:
                 if self.first_dispatch_t is None:
                     self.first_dispatch_t = now
+                self._inflight = batch
                 try:
-                    verdicts = await loop.run_in_executor(
-                        self._executor, self._run_batch, batch)
+                    verdicts, served_by = await self._dispatch(batch)
                 except Exception as exc:  # noqa: BLE001 — fail the batch, not the loop
                     msg = f"{type(exc).__name__}: {exc}"
                     for req in batch:
                         self._resolve(req, VerifyResult(
                             status=STATUS_ERROR, error=msg))
                 else:
-                    self._demux(batch, verdicts, dispatch_t=now)
+                    self._demux(batch, verdicts, dispatch_t=now,
+                                served_by=served_by)
+                finally:
+                    self._inflight = []
                 continue
             if not self._running and self.scheduler.depth() == 0:
                 return
@@ -180,6 +256,53 @@ class VerificationService:
                     await asyncio.wait_for(self._wake.wait(), delay)
             except asyncio.TimeoutError:
                 pass
+
+    async def _dispatch(self, batch: list[VerifyRequest]):
+        """One batch through the resilient device path.
+
+        Returns ``(verdicts, served_by)``. Attempt order: device call
+        (watchdog-bounded) with retry on transient errors while the
+        breaker admits traffic; then the host fallback; then raise the
+        last error (the batch completes with ``status="error"``).
+        """
+        if self.resilience is None:
+            return (await self._watchdog.run(self._run_batch, batch),
+                    SERVED_BY_DEVICE)
+        last_exc: Exception | None = None
+        delays = self._retry.delays()
+        for attempt in range(self._retry.max_attempts):
+            if not self._breaker.allow():
+                break
+            try:
+                verdicts = await self._watchdog.run(self._run_batch, batch)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self._breaker.record_failure()
+                last_exc = exc
+                if not self._retry.is_transient(exc):
+                    break
+                if attempt + 1 < self._retry.max_attempts:
+                    delay = next(delays)
+                    # pause() does the resil_retries_total / resil.retry
+                    # bookkeeping; the actual wait must be async.
+                    self._retry.pause(delay, sleep=lambda _s: None)
+                    await asyncio.sleep(delay)
+                continue
+            self._breaker.record_success()
+            return verdicts, SERVED_BY_DEVICE
+        if self._fallback is not None:
+            group = batch[0].group
+            with _TRACER.span("resil.fallback", group=group,
+                              rows=len(batch)):
+                verdicts = await asyncio.get_running_loop().run_in_executor(
+                    self._watchdog.executor,
+                    self._fallback.verify_batch, batch)
+            _METRICS.counter("resil_fallback_batches_total",
+                             group=group).add()
+            return verdicts, SERVED_BY_HOST
+        if last_exc is not None:
+            raise last_exc
+        raise RuntimeError(
+            "circuit breaker open and no host fallback configured")
 
     # ----------------------------------------------------- device batches
     def _run_batch(self, batch: list[VerifyRequest]) -> np.ndarray:
@@ -211,16 +334,14 @@ class VerificationService:
                 verdicts = np.asarray(
                     [(i_ok if which else t_ok)[idx] for which, idx in slots],
                     dtype=bool)
-        _METRICS.counter("serve_batches_total",
-                         help="Device batches dispatched",
-                         group=group).add()
+        _METRICS.counter("serve_batches_total", group=group).add()
         _METRICS.histogram("serve_dispatch_seconds",
-                           help="Blocking device-call wall per batch",
                            group=group).observe(time.perf_counter() - t0)
         return verdicts
 
     # -------------------------------------------------------- completion
-    def _demux(self, batch, verdicts, dispatch_t: float) -> None:
+    def _demux(self, batch, verdicts, dispatch_t: float,
+               served_by: str = SERVED_BY_DEVICE) -> None:
         now = time.perf_counter()
         rows = len(batch)
         bucket = self.config.bucket_for(rows)
@@ -228,19 +349,16 @@ class VerificationService:
             miss = now > req.deadline
             status = STATUS_DEADLINE_MISS if miss else STATUS_OK
             if miss:
-                _METRICS.counter(
-                    "serve_deadline_miss_total",
-                    help="Requests whose deadline passed, by where",
-                    where="served").add()
+                _METRICS.counter("serve_deadline_miss_total",
+                                 where="served").add()
             _METRICS.histogram(
                 "serve_wait_seconds",
-                help="Enqueue -> dispatch wait per request",
                 lane=req.lane).observe(dispatch_t - req.enqueue_t)
             self._resolve(req, VerifyResult(
                 status=status, accepted=bool(acc),
                 wait_s=dispatch_t - req.enqueue_t,
                 total_s=now - req.enqueue_t,
-                bucket=bucket, batch_rows=rows))
+                bucket=bucket, batch_rows=rows, served_by=served_by))
 
     def _complete_expired(self, req: VerifyRequest, now: float) -> None:
         _METRICS.counter("serve_deadline_miss_total",
@@ -251,7 +369,6 @@ class VerificationService:
 
     def _resolve(self, req: VerifyRequest, result: VerifyResult) -> None:
         _METRICS.counter("serve_results_total",
-                         help="Completed requests by terminal status",
                          status=result.status).add()
         if req.future is not None and not req.future.done():
             req.future.set_result(result)

@@ -18,9 +18,9 @@ certified) rides the same device MSM used by the auditor re-open
 from __future__ import annotations
 
 import hashlib
-import time
 from dataclasses import dataclass, field
 
+from ..resilience import RetryExhausted, RetryPolicy
 from ..token.model import ID
 from .db.sqldb import CertificationDB
 
@@ -104,22 +104,22 @@ class CertificationClient:
         to_certify = [i for i in ids if not self.is_certified(i)]
         if not to_certify:
             return
-        last_err: Exception | None = None
-        for attempt in range(self.max_attempts):
-            try:
-                sigs = self.node.bus.node(self.certifier_name).certify_tokens(
-                    to_certify)
-                break
-            except CertificationError:
-                raise  # deterministic refusal (e.g. unknown token): no retry
-            except Exception as e:  # noqa: BLE001 — transient: retry
-                last_err = e
-                if attempt + 1 < self.max_attempts:
-                    time.sleep(self.wait_time)
-        else:
+        policy = RetryPolicy(max_attempts=self.max_attempts,
+                             base_s=self.wait_time,
+                             cap_s=self.wait_time * 8,
+                             op="certify_request")
+        try:
+            # CertificationError is a deterministic refusal (e.g. unknown
+            # token): permanent, surfaces unchanged. Anything else is a
+            # session-plane hiccup worth the bounded retry.
+            sigs = policy.call(
+                lambda: self.node.bus.node(
+                    self.certifier_name).certify_tokens(to_certify),
+                classify=lambda e: not isinstance(e, CertificationError))
+        except RetryExhausted as e:
             raise CertificationError(
-                f"certification request failed after {self.max_attempts} "
-                f"attempts: {last_err}")
+                f"certification request failed after {e.attempts} "
+                f"attempts: {e.last_error}") from e.last_error
         if len(sigs) != len(to_certify):
             raise CertificationError(
                 f"certifier returned {len(sigs)} certifications for "

@@ -16,8 +16,7 @@ achieves behind driver.Network (network/driver/network.go:38).
 
 from __future__ import annotations
 
-import time
-
+from ...resilience import RetryExhausted, RetryPolicy
 from .rws import KeyTranslator
 from .tcc import CommitEvent
 
@@ -47,6 +46,9 @@ class CustodianNode:
         self.cc = chaincode
         self.max_broadcast_attempts = max_broadcast_attempts
         self.retry_wait = retry_wait
+        self._broadcast_retry = RetryPolicy(
+            max_attempts=max_broadcast_attempts, base_s=retry_wait,
+            cap_s=retry_wait * 8, op="custodian_broadcast")
         self._subscribers: list = []
         # test/fault hook: raised-once transient failures (broadcast.go
         # retry path); a callable returning True means "fail this attempt"
@@ -74,20 +76,24 @@ class CustodianNode:
 
     def broadcast(self, tx_id: str, request_raw: bytes) -> CommitEvent:
         """orion/broadcast.go:52: submit for ordering + commit, retrying
-        transient submission failures (:128-137)."""
-        last_err: Exception | None = None
-        for attempt in range(self.max_broadcast_attempts):
-            try:
-                if self.fault_hook is not None and self.fault_hook(attempt):
-                    raise ConnectionError("transient submission failure")
-                return self.cc.process_request(tx_id, request_raw)
-            except ConnectionError as e:
-                last_err = e
-                if attempt + 1 < self.max_broadcast_attempts:
-                    time.sleep(self.retry_wait)
-        raise CustodianError(
-            f"broadcast of [{tx_id}] failed after "
-            f"{self.max_broadcast_attempts} attempts: {last_err}")
+        transient submission failures (:128-137) under the shared
+        :class:`RetryPolicy` (ConnectionError and friends are transient;
+        validation failures propagate unchanged)."""
+        attempt = 0
+
+        def submit():
+            nonlocal attempt
+            this_attempt, attempt = attempt, attempt + 1
+            if self.fault_hook is not None and self.fault_hook(this_attempt):
+                raise ConnectionError("transient submission failure")
+            return self.cc.process_request(tx_id, request_raw)
+
+        try:
+            return self._broadcast_retry.call(submit)
+        except RetryExhausted as e:
+            raise CustodianError(
+                f"broadcast of [{tx_id}] failed after "
+                f"{e.attempts} attempts: {e.last_error}") from e.last_error
 
     def query_state(self, key: str) -> bytes | None:
         return self.cc.ledger.get_state(key)

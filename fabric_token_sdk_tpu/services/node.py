@@ -141,12 +141,17 @@ class TokenNode:
         self._tms[tmsid] = tms
         return tms
 
-    def verification_frontend(self, config=None):
+    def verification_frontend(self, config=None, resilience=None):
         """The continuous-batching verification service (serve/) over this
         node's validator ZK backend. One cached instance per node — the
         service owns the device dispatch queue, so every caller must share
         it. Raises for drivers without a device ZK backend (fabtoken).
-        The caller starts/stops it (``await svc.start()``)."""
+        The caller starts/stops it (``await svc.start()``).
+
+        A node frontend always runs resilient: retries with seeded
+        jitter, circuit breaker, watchdog, and host fallback under the
+        default :class:`ResilienceConfig` unless the caller passes their
+        own (see resilience/)."""
         if getattr(self, "_serve", None) is not None:
             return self._serve
         zk = getattr(getattr(self.cc.validator, "pp", None),
@@ -155,9 +160,13 @@ class TokenNode:
             raise RuntimeError(
                 f"node [{self.name}]: validator has no device ZK backend "
                 "to serve")
+        from ..resilience import ResilienceConfig
         from ..serve import VerificationService
 
-        self._serve = VerificationService(zk, config=config)
+        if resilience is None:
+            resilience = ResilienceConfig()
+        self._serve = VerificationService(zk, config=config,
+                                          resilience=resilience)
         return self._serve
 
     def prometheus_text(self) -> str:

@@ -6,7 +6,9 @@ Behavioral mirror of reference token/services/selector (SURVEY.md §2.4):
 - SherdLockSelector ~ selector/sherdlock: DB-lease-based distributed lock
   that is safe across replicas sharing one lock DB; leases expire so stuck
   locks recover (docs/core-token.md:25-31). Eager fetcher with retry/backoff
-  (sherdlock/selector.go:92-157).
+  (sherdlock/selector.go:92-157) — backoff schedule comes from the shared
+  :class:`~..resilience.RetryPolicy` (seeded decorrelated jitter), so the
+  waits are observable under ``resil_retries_total{op="selector_select"}``.
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ import time
 from dataclasses import dataclass
 
 from ..obs import GLOBAL as _METRICS
+from ..resilience import RetryPolicy
 from ..token import quantity as q
 from ..token.model import ID, UnspentToken
 from .db.sqldb import TokenDB, TokenLockDB
@@ -39,19 +42,23 @@ class SherdLockSelector:
 
     def __init__(self, tokendb: TokenDB, lockdb: TokenLockDB,
                  precision: int = 64, lease_seconds: float = 180.0,
-                 retries: int = 3, backoff: float = 0.05):
+                 retries: int = 3, backoff: float = 0.05, seed: int = 0):
         self.tokendb = tokendb
         self.lockdb = lockdb
         self.precision = precision
         self.lease_seconds = lease_seconds
         self.retries = retries
         self.backoff = backoff
+        self.retry = RetryPolicy(max_attempts=retries, base_s=backoff,
+                                 cap_s=backoff * 8, seed=seed,
+                                 op="selector_select")
 
     def select(self, wallet_id: str, token_type: str, amount_hex: str,
                consumer_tx_id: str) -> Selection:
         """Lock enough tokens to cover `amount`; all-or-nothing."""
         t0 = time.perf_counter()
         target = q.to_quantity(amount_hex, self.precision).value
+        delays = self.retry.delays()
         for attempt in range(self.retries):
             if attempt:
                 _METRICS.counter("selector_retries_total").add()
@@ -75,7 +82,7 @@ class SherdLockSelector:
             self.lockdb.unlock_by_consumer(consumer_tx_id)
             self.lockdb.evict_expired(self.lease_seconds)
             if attempt < self.retries - 1:
-                time.sleep(self.backoff * (2 ** attempt))
+                self.retry.pause(next(delays))
         _METRICS.counter("selector_insufficient_funds_total").add()
         _METRICS.histogram(
             "selector_select_seconds",

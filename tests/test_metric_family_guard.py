@@ -1,0 +1,120 @@
+"""Tier-1 guard: the stable metric families must exist in the source.
+
+ROADMAP.md declares the metric family names a stable interface —
+dashboards, the bench harness, and the obs report all key on them, so a
+rename or deletion is a breaking change. The smoke tests
+(test_obs_smoke.py, test_serve_smoke.py) verify families light up under
+load, but only for the layers they exercise; this guard covers the whole
+inventory cheaply by scanning the package source for each registered
+family name. A family that disappears (renamed, dropped in a refactor)
+fails here with the missing name, before any dashboard goes dark.
+"""
+
+from pathlib import Path
+
+import pytest
+
+_PKG = Path(__file__).resolve().parent.parent
+
+#: Every stable family, by subsystem (keep sorted within each block).
+STABLE_FAMILIES = (
+    # models/ pipeline + device verifiers
+    "adjust_points_total",
+    "pipeline_batch_seconds",
+    "pipeline_batches_total",
+    "pipeline_pad_rows_total",
+    "pipeline_pad_waste_ratio",
+    "pipeline_phase_seconds",
+    "pipeline_rows_total",
+    "pipeline_steady_seconds",
+    "sigma_dispatches_total",
+    "sigma_pad_rows_total",
+    "sigma_rows_total",
+    "zk_block_actions_total",
+    "zk_blocks_verified_total",
+    "zk_device_oracle_disagreements_total",
+    "zk_range_batch_verify_seconds",
+    "zk_range_proofs_verified_total",
+    "zk_sigma_verify_seconds",
+    # services/ tiers
+    "selector_insufficient_funds_total",
+    "selector_retries_total",
+    "selector_select_seconds",
+    "selector_tokens_locked_total",
+    "tcc_commit_seconds",
+    "tcc_process_request_seconds",
+    "tcc_request_status_total",
+    "tcc_requests_total",
+    "tcc_translate_seconds",
+    "tcc_validate_seconds",
+    "ttx_collect_endorsements_seconds",
+    "ttx_commit_ingest_seconds",
+    "ttx_commits_total",
+    "ttx_execute_seconds",
+    "ttx_executions_total",
+    "ttx_ordering_finality_seconds",
+    "txgen_op_seconds",
+    "txgen_ops_total",
+    # serve/ frontend
+    "serve_batch_fill_ratio",
+    "serve_batch_rows",
+    "serve_batches_total",
+    "serve_deadline_miss_total",
+    "serve_dispatch_seconds",
+    "serve_prewarm_seconds",
+    "serve_queue_depth",
+    "serve_requests_total",
+    "serve_results_total",
+    "serve_shed_total",
+    "serve_wait_seconds",
+    # resilience/
+    "resil_breaker_state",
+    "resil_breaker_transitions_total",
+    "resil_fallback_batches_total",
+    "resil_fallback_rows_total",
+    "resil_injected_faults_total",
+    "resil_retries_total",
+    "resil_watchdog_trips_total",
+)
+
+#: Families whose names are built dynamically: family -> the source
+#: fragment that constructs it (services/db/sqldb.py templates the method
+#: name into ``db_<method>_seconds``).
+DYNAMIC_FAMILIES = {
+    "db_store_token_seconds": 'db_{fn.__name__}_seconds',
+}
+
+
+def _source_corpus() -> str:
+    chunks = [(_PKG / "bench.py").read_text()]
+    for path in sorted((_PKG / "fabric_token_sdk_tpu").rglob("*.py")):
+        chunks.append(path.read_text())
+    return "\n".join(chunks)
+
+
+def test_stable_metric_families_present_in_source():
+    corpus = _source_corpus()
+    missing = [fam for fam in STABLE_FAMILIES if fam not in corpus]
+    assert not missing, (
+        "stable metric families missing from the source (renaming or "
+        f"dropping one is a breaking interface change): {missing}")
+
+
+def test_dynamic_metric_families_still_constructed():
+    corpus = _source_corpus()
+    for fam, fragment in DYNAMIC_FAMILIES.items():
+        assert fragment in corpus, (
+            f"dynamic family {fam} lost its constructor "
+            f"(expected source fragment {fragment!r})")
+
+
+def test_no_duplicate_family_entries():
+    assert len(set(STABLE_FAMILIES)) == len(STABLE_FAMILIES)
+
+
+@pytest.mark.parametrize("prefix", ["ttx_", "tcc_", "zk_", "sigma_",
+                                    "pipeline_", "selector_", "serve_",
+                                    "txgen_", "resil_"])
+def test_every_stable_prefix_is_covered(prefix):
+    # the inventory above must not silently drop a whole subsystem
+    assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

@@ -1,0 +1,480 @@
+"""resilience/ — pure-logic state machines + serve-integration chaos.
+
+Everything here runs against fake backends (no device, no crypto), so
+these tests belong to the tier-1 gate: retry/breaker transition
+correctness, seeded-jitter and fault-schedule determinism, and the serve
+dispatcher surviving injected faults with bit-identical verdicts. The
+real-device chaos smoke lives in tests/test_serve_smoke.py (marked
+slow).
+"""
+
+import asyncio
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL as METRICS
+from fabric_token_sdk_tpu.resilience import (STATE_CLOSED, STATE_HALF_OPEN,
+                                             STATE_OPEN, CircuitBreaker,
+                                             DispatchWatchdog, FaultInjector,
+                                             InjectedPermanentError,
+                                             InjectedTransientError,
+                                             ResilienceConfig, RetryExhausted,
+                                             RetryPolicy, TransientError,
+                                             WatchdogTimeout)
+from fabric_token_sdk_tpu.serve import (SERVED_BY_DEVICE, SERVED_BY_HOST,
+                                        STATUS_ERROR, STATUS_OK,
+                                        STATUS_SHUTDOWN, ServeConfig,
+                                        VerificationService)
+
+pytestmark = pytest.mark.chaos
+
+
+def _counter_sum(name: str) -> float:
+    return sum(v for (fam, _), v in METRICS.snapshot().items()
+               if fam == name)
+
+
+# ---------------------------------------------------------------- RetryPolicy
+def test_retry_transient_then_success():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("hiccup")
+        return "ok"
+
+    slept = []
+    out = RetryPolicy(max_attempts=3, base_s=0.01, seed=1).call(
+        fn, sleep=slept.append)
+    assert out == "ok"
+    assert len(calls) == 3
+    assert len(slept) == 2 and all(s >= 0.01 for s in slept)
+
+
+def test_retry_permanent_error_propagates_unchanged():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("proof is simply wrong")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5).call(fn, sleep=lambda s: None)
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_exhaustion_wraps_last_error():
+    def fn():
+        raise ConnectionError("still down")
+
+    with pytest.raises(RetryExhausted) as ei:
+        RetryPolicy(max_attempts=3, base_s=0.0).call(
+            fn, op="unit", sleep=lambda s: None)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_error, ConnectionError)
+    assert "unit failed after 3 attempts" in str(ei.value)
+
+
+def test_retry_classification():
+    p = RetryPolicy()
+    xla_exc = type("XlaRuntimeError", (RuntimeError,), {})()
+    assert p.is_transient(TransientError("x"))
+    assert p.is_transient(ConnectionError())
+    assert p.is_transient(TimeoutError())
+    assert p.is_transient(xla_exc)  # matched by type NAME, no jaxlib import
+    assert not p.is_transient(ValueError("bad proof"))
+    assert not p.is_transient(RuntimeError("generic"))
+
+
+def test_jitter_schedule_is_seeded_and_bounded():
+    take = lambda policy, n: list(itertools.islice(policy.delays(), n))
+    a = take(RetryPolicy(base_s=0.01, cap_s=0.5, seed=42), 16)
+    b = take(RetryPolicy(base_s=0.01, cap_s=0.5, seed=42), 16)
+    c = take(RetryPolicy(base_s=0.01, cap_s=0.5, seed=43), 16)
+    assert a == b, "same seed must replay the same backoff schedule"
+    assert a != c, "different seeds must decorrelate"
+    assert all(0.01 <= d <= 0.5 for d in a)
+
+
+# -------------------------------------------------------------- CircuitBreaker
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(**kw):
+    clock = _Clock()
+    kw.setdefault("window", 8)
+    kw.setdefault("failure_threshold", 0.5)
+    kw.setdefault("min_volume", 4)
+    kw.setdefault("reset_timeout_s", 5.0)
+    kw.setdefault("half_open_probes", 2)
+    return CircuitBreaker(clock=clock, **kw), clock
+
+
+def test_breaker_opens_on_failure_rate():
+    br, _ = _breaker()
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == STATE_CLOSED  # below min_volume
+    br.record_failure()
+    assert br.state == STATE_OPEN
+    assert not br.allow()
+
+
+def test_breaker_stays_closed_below_threshold():
+    br, _ = _breaker()
+    for _ in range(10):
+        br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == STATE_CLOSED
+    assert br.allow()
+
+
+def test_breaker_half_open_probe_accounting():
+    br, clock = _breaker()
+    for _ in range(4):
+        br.record_failure()
+    assert br.state == STATE_OPEN
+    clock.t += 5.0
+    # first allow() flips to half-open and claims probe slot 1 of 2
+    assert br.allow()
+    assert br.state == STATE_HALF_OPEN
+    assert br.allow()          # probe slot 2
+    assert not br.allow()      # probe budget exhausted
+    br.record_success()
+    assert br.state == STATE_HALF_OPEN  # one success is not enough
+    br.record_success()
+    assert br.state == STATE_CLOSED
+    assert br.failure_rate == 0.0  # window cleared on close
+
+
+def test_breaker_half_open_failure_reopens_and_restarts_timer():
+    br, clock = _breaker()
+    for _ in range(4):
+        br.record_failure()
+    clock.t += 5.0
+    assert br.allow()
+    br.record_failure()
+    assert br.state == STATE_OPEN
+    clock.t += 4.9             # timer restarted at the probe failure
+    assert not br.allow()
+    clock.t += 0.2
+    assert br.allow()
+
+
+def test_breaker_force_open_is_latched():
+    br, clock = _breaker()
+    br.force_open()
+    assert not br.allow()
+    clock.t += 1000.0          # reset timeout never applies while forced
+    assert not br.allow()
+    br.force_close()
+    assert br.state == STATE_CLOSED
+    assert br.allow()
+
+
+# --------------------------------------------------------------- FaultInjector
+def test_fault_schedule_is_deterministic_per_seed():
+    mk = lambda seed: FaultInjector(seed=seed, transient_rate=0.2,
+                                    permanent_rate=0.05, stall_rate=0.1,
+                                    corrupt_rate=0.05, sleep=lambda s: None)
+    inj1, inj2, inj3 = mk(9), mk(9), mk(10)
+    seq1 = [inj1.next_action() for _ in range(500)]
+    seq2 = [inj2.next_action() for _ in range(500)]
+    seq3 = [inj3.next_action() for _ in range(500)]
+    assert seq1 == seq2, "same seed must produce the same fault schedule"
+    assert seq1 != seq3
+    assert {s for s in seq1 if s is not None} <= {"transient", "permanent",
+                                                 "stall", "corrupt"}
+    assert any(s is not None for s in seq1)
+
+
+def test_fault_rates_validated():
+    with pytest.raises(ValueError):
+        FaultInjector(transient_rate=0.8, permanent_rate=0.3)
+    with pytest.raises(ValueError):
+        FaultInjector(transient_rate=-0.1)
+
+
+def test_explicit_schedule_overrides_rates():
+    slept = []
+    inj = FaultInjector(seed=0, transient_rate=1.0,
+                        schedule={0: "transient", 2: "stall",
+                                  3: "permanent"},
+                        stall_s=0.5, sleep=slept.append)
+    with pytest.raises(InjectedTransientError):
+        inj.fire("range.verify")        # call 0
+    assert inj.fire("range.verify") is None  # call 1: scheduled clean
+    assert inj.fire("range.verify") is None  # call 2: stall (sleeps)
+    assert slept == [0.5]
+    with pytest.raises(InjectedPermanentError):
+        inj.fire("range.verify")        # call 3
+    assert inj.injected["transient"] == 1
+    assert inj.injected["permanent"] == 1
+    assert inj.injected["stall"] == 1
+
+
+def test_corrupt_verdicts_flips_exactly_one_row_deterministically():
+    base = np.ones(16, dtype=bool)
+    a = FaultInjector(seed=5).corrupt_verdicts(base)
+    b = FaultInjector(seed=5).corrupt_verdicts(base)
+    assert (a == b).all()
+    assert (a != base).sum() == 1
+    assert base.all(), "input vector must not be mutated"
+
+
+def test_faulty_zk_shims_entry_points_and_forwards_the_rest():
+    class _Range:
+        def verify(self, proofs, coms):
+            return np.ones(len(proofs), dtype=bool)
+
+        last_path = "device"
+
+    class _ZK:
+        _range = _Range()
+        pp = "sentinel-pp"
+
+        def verify_block(self, transfers, issues):
+            return (np.ones(len(transfers), dtype=bool),
+                    np.ones(len(issues), dtype=bool))
+
+    inj = FaultInjector(seed=0, schedule={0: "transient", 2: "corrupt"})
+    faulty = inj.wrap(_ZK())
+    assert faulty.pp == "sentinel-pp"            # passthrough
+    assert faulty._range.last_path == "device"   # passthrough on the shim
+    with pytest.raises(InjectedTransientError):
+        faulty._range.verify([1, 2], [1, 2])     # call 0
+    out = faulty._range.verify([1, 2], [1, 2])   # call 1: clean
+    assert out.all()
+    t_ok, _ = faulty.verify_block([("t",)], [])  # call 2: corrupt
+    assert not t_ok.all()
+
+
+# ------------------------------------------------------------------- Watchdog
+def test_watchdog_abandons_hung_call_and_recovers():
+    wd = DispatchWatchdog(timeout_s=0.05)
+    release = threading.Event()
+
+    async def run():
+        with pytest.raises(WatchdogTimeout):
+            await wd.run(release.wait, 5.0)
+        # fresh executor thread: the next dispatch is not queued behind
+        # the orphaned hung call
+        return await wd.run(lambda: "alive")
+
+    try:
+        assert asyncio.run(run()) == "alive"
+        assert wd.trips == 1
+    finally:
+        release.set()
+        wd.shutdown(wait=False)
+
+
+# --------------------------------------------------- serve/ chaos integration
+class _TruthRange:
+    """The payload IS the expected verdict: proofs are truthy/falsy."""
+
+    def verify(self, proofs, commitments):
+        return np.asarray([bool(p) for p in proofs], dtype=bool)
+
+
+class _TruthZK:
+    def __init__(self):
+        self._range = _TruthRange()
+
+    def verify_block(self, transfers, issues):
+        return (np.asarray([bool(t[0]) for t in transfers], dtype=bool),
+                np.asarray([bool(i[0]) for i in issues], dtype=bool))
+
+    def prewarm_shapes(self, batch_sizes=(1,), include_block=True):
+        return {b: 0.0 for b in batch_sizes}
+
+
+class _TruthFallback:
+    """Host-path stand-in with the same truth semantics as _TruthZK."""
+
+    def __init__(self):
+        self.batches = 0
+
+    def verify_batch(self, batch):
+        self.batches += 1
+        return np.asarray([bool(r.payload[0]) for r in batch], dtype=bool)
+
+
+def _fast_resilience(**kw):
+    kw.setdefault("retry_attempts", 4)
+    kw.setdefault("retry_base_s", 0.0)
+    kw.setdefault("retry_cap_s", 0.0)
+    kw.setdefault("breaker_min_volume", 10_000)  # keep closed under chaos
+    kw.setdefault("watchdog_timeout_s", None)
+    return ResilienceConfig(**kw)
+
+
+def test_serve_chaos_transient_faults_bit_identical_no_hangs():
+    inj = FaultInjector(seed=3, transient_rate=0.25, sleep=lambda s: None)
+    zk = inj.wrap(_TruthZK())
+    fb = _TruthFallback()
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(8, 32), max_wait_s=0.005),
+        resilience=_fast_resilience(), fallback=fb)
+    expected = [i % 3 != 0 for i in range(32)]
+
+    async def run():
+        await svc.start(prewarm=False)
+        results = await asyncio.wait_for(asyncio.gather(*[
+            svc.submit_range(exp, object(), deadline_s=30.0)
+            for exp in expected]), timeout=10.0)
+        # dispatcher must survive chaos: a second wave still completes
+        again = await asyncio.wait_for(
+            svc.submit_range(True, object(), deadline_s=30.0), timeout=10.0)
+        await svc.stop()
+        return results, again
+
+    results, again = asyncio.run(run())
+    assert [r.status for r in results] == [STATUS_OK] * 32
+    assert [r.accepted for r in results] == expected, \
+        "verdicts must be bit-identical under injected transient faults"
+    assert all(r.served_by in (SERVED_BY_DEVICE, SERVED_BY_HOST)
+               for r in results)
+    assert again.ok and again.accepted is True
+    assert inj.injected["transient"] > 0, "chaos test injected nothing"
+
+
+def test_serve_breaker_forced_open_routes_everything_to_host():
+    zk = _TruthZK()
+    fb = _TruthFallback()
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(8,), max_wait_s=0.005),
+        resilience=_fast_resilience(), fallback=fb)
+    expected = [i % 2 == 0 for i in range(8)]
+
+    async def run():
+        await svc.start(prewarm=False)
+        svc._breaker.force_open()
+        results = await asyncio.wait_for(asyncio.gather(*[
+            svc.submit_range(exp, object(), deadline_s=30.0)
+            for exp in expected]), timeout=10.0)
+        await svc.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert all(r.ok and r.served_by == SERVED_BY_HOST for r in results)
+    assert [r.accepted for r in results] == expected, \
+        "host fallback verdicts must be bit-identical"
+    assert fb.batches > 0
+
+
+def test_serve_permanent_fault_without_fallback_errors_promptly():
+    inj = FaultInjector(seed=0, schedule={0: "permanent"})
+    zk = inj.wrap(_TruthZK())  # no pp attribute -> no implicit fallback
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(4,), max_wait_s=0.005),
+        resilience=_fast_resilience())
+    assert svc._fallback is None
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await asyncio.wait_for(
+            svc.submit_range(True, object(), deadline_s=30.0), timeout=10.0)
+        await svc.stop()
+        return res
+
+    res = asyncio.run(run())
+    assert res.status == STATUS_ERROR
+    assert "InjectedPermanentError" in res.error
+
+
+def test_serve_watchdog_trip_retries_on_fresh_thread():
+    hang = threading.Event()
+    calls = []
+
+    class _HangOnceRange(_TruthRange):
+        def verify(self, proofs, commitments):
+            calls.append(1)
+            if len(calls) == 1:
+                hang.wait(5.0)  # first dispatch wedges
+            return super().verify(proofs, commitments)
+
+    zk = _TruthZK()
+    zk._range = _HangOnceRange()
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(4,), max_wait_s=0.005),
+        resilience=_fast_resilience(watchdog_timeout_s=0.1))
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await asyncio.wait_for(
+            svc.submit_range(True, object(), deadline_s=30.0), timeout=10.0)
+        await svc.stop()
+        return res
+
+    try:
+        res = asyncio.run(run())
+    finally:
+        hang.set()
+    assert res.ok and res.accepted is True
+    assert res.served_by == SERVED_BY_DEVICE
+    assert svc._watchdog.trips == 1
+
+
+def test_serve_stop_timeout_resolves_stuck_requests_with_shutdown():
+    hang = threading.Event()
+
+    class _HungRange(_TruthRange):
+        def verify(self, proofs, commitments):
+            hang.wait(10.0)  # device wedged for the whole test
+            return super().verify(proofs, commitments)
+
+    zk = _TruthZK()
+    zk._range = _HungRange()
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(4,), max_wait_s=0.005))
+
+    async def run():
+        await svc.start(prewarm=False)
+        task = asyncio.create_task(
+            svc.submit_range(True, object(), deadline_s=30.0))
+        await asyncio.sleep(0.1)  # let it dispatch into the hung call
+        await asyncio.wait_for(svc.stop(timeout_s=0.2), timeout=5.0)
+        return await asyncio.wait_for(task, timeout=5.0)
+
+    try:
+        res = asyncio.run(run())
+    finally:
+        hang.set()
+    assert res.status == STATUS_SHUTDOWN
+    assert "drain timeout" in res.error
+
+
+def test_chaos_metrics_families_emitted():
+    METRICS.reset()
+    inj = FaultInjector(seed=1, transient_rate=0.4, sleep=lambda s: None)
+    zk = inj.wrap(_TruthZK())
+    svc = VerificationService(
+        zk, config=ServeConfig(buckets=(8,), max_wait_s=0.005),
+        resilience=_fast_resilience(), fallback=_TruthFallback())
+
+    async def run():
+        await svc.start(prewarm=False)
+        await asyncio.wait_for(asyncio.gather(*[
+            svc.submit_range(True, object(), deadline_s=30.0)
+            for _ in range(32)]), timeout=10.0)
+        await svc.stop()
+
+    asyncio.run(run())
+    assert _counter_sum("resil_injected_faults_total") > 0
+    text = METRICS.prometheus_text()
+    assert "resil_breaker_state" in text
+    # retries and/or fallback batches depending on where faults landed
+    assert (_counter_sum("resil_retries_total") > 0
+            or _counter_sum("resil_fallback_batches_total") > 0)

@@ -121,3 +121,52 @@ def test_serve_verdicts_bit_identical_to_direct(pp, zk):
     assert single.accepted == bool(direct_single[0])
     assert all(r.status == STATUS_OK for r in full)
     assert [r.accepted for r in full] == [bool(x) for x in direct_full]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_chaos_real_device_parity(pp, zk):
+    """Real-device chaos smoke: scripted transient faults on the device
+    entry point, then a forced-open breaker. Both phases must return
+    verdicts bit-identical to the direct device call — the first served
+    by the device after retries, the second by the pure-host fallback."""
+    from fabric_token_sdk_tpu.resilience import FaultInjector, \
+        ResilienceConfig
+    from fabric_token_sdk_tpu.serve import SERVED_BY_HOST
+
+    proofs, coms = [], []
+    for i in range(4):
+        pf, com = _prove_one(pp, rng.randrange(1 << BIT_LENGTH))
+        if i == 2:  # one forged proof: parity covers rejects too
+            pf.data.tau = bn254.fr_add(pf.data.tau, 1)
+        proofs.append(pf)
+        coms.append(com)
+    direct = [bool(x) for x in zk._range.verify(proofs, coms)]
+
+    inj = FaultInjector(seed=0, schedule={0: "transient", 1: "transient"})
+    svc = VerificationService(
+        inj.wrap(zk),
+        config=ServeConfig(buckets=(4,), max_wait_s=0.01,
+                           default_deadline_s=_SMOKE_DEADLINE_S),
+        resilience=ResilienceConfig(retry_attempts=4, retry_base_s=0.0,
+                                    retry_cap_s=0.0,
+                                    watchdog_timeout_s=None))
+
+    async def run():
+        await svc.start(prewarm=False)  # kernels already warm (same zk)
+        faulted = await asyncio.gather(*[
+            svc.submit_range(p, c) for p, c in zip(proofs, coms)])
+        svc._breaker.force_open()
+        hosted = await asyncio.gather(*[
+            svc.submit_range(p, c) for p, c in zip(proofs, coms)])
+        await svc.stop(timeout_s=120.0)
+        return faulted, hosted
+
+    faulted, hosted = asyncio.run(run())
+    assert inj.injected["transient"] == 2
+    assert all(r.status == STATUS_OK for r in faulted + hosted)
+    assert [r.accepted for r in faulted] == direct, \
+        "device-path verdicts diverge under injected transient faults"
+    assert [r.accepted for r in hosted] == direct, \
+        "host-fallback verdicts diverge from the device path"
+    assert all(r.served_by == SERVED_BY_HOST for r in hosted)
