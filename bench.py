@@ -277,6 +277,39 @@ def _bench_adversarial():
             "vs_baseline": round(BATCH / elapsed / TARGET_BASELINE, 4)}))
 
 
+def _start_bench_telemetry(svc):
+    """With BENCH_TELEMETRY_PORT=<port> set, put the live telemetry
+    plane on the running bench service (scrape /metrics, /statusz,
+    /tracez while the open loop is in flight). Returns the server or
+    None; callers stop() it after the run."""
+    port = os.environ.get("BENCH_TELEMETRY_PORT")
+    if not port:
+        return None
+    from fabric_token_sdk_tpu.obs import TelemetryConfig, serve_telemetry
+
+    host = os.environ.get("BENCH_TELEMETRY_HOST", "0.0.0.0")
+    server = serve_telemetry(svc, TelemetryConfig(host=host, port=int(port)))
+    print(f"bench: telemetry plane at {server.url} "
+          "(/metrics /healthz /readyz /statusz /tracez)", file=sys.stderr)
+    return server
+
+
+def _write_trace_out() -> None:
+    """With BENCH_TRACE_OUT=<path> set, export the tracer's completed
+    root spans (serve.request trees with linked serve.batch spans) as a
+    Chrome/Perfetto trace after the run."""
+    path = os.environ.get("BENCH_TRACE_OUT")
+    if not path:
+        return
+    from fabric_token_sdk_tpu.obs import TRACER
+    from fabric_token_sdk_tpu.obs.export import write_chrome_trace
+
+    spans = TRACER.root_snapshot()
+    write_chrome_trace(path, spans)
+    print(f"bench: {len(spans)} trace roots written to {path}",
+          file=sys.stderr)
+
+
 def _bench_serve():
     """BENCH_MODE=serve: open-loop arrival bench through the serve/
     frontend on one chip. A seeded Poisson arrival schedule (default
@@ -285,12 +318,19 @@ def _bench_serve():
     deadline policy. Prewarm wall is reported separately from steady
     state; the tail carries p50/p99, deadline-miss and shed counts.
     Before the run, a mixed clean/forged spot batch asserts the service's
-    demuxed verdicts are bit-identical to the direct batched call."""
+    demuxed verdicts are bit-identical to the direct batched call.
+
+    The full telemetry plane rides along: retry/breaker resilience (so
+    resil_* families are live), an SLO burn-rate monitor bound to the
+    breaker, per-bucket device profiling at prewarm/dispatch, and —
+    with BENCH_TELEMETRY_PORT set — the HTTP scrape surface."""
     import asyncio
     import copy
 
     from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
     from fabric_token_sdk_tpu.harness.txgen import open_loop_arrivals
+    from fabric_token_sdk_tpu.obs import SloMonitor
+    from fabric_token_sdk_tpu.resilience import ResilienceConfig
     from fabric_token_sdk_tpu.serve import (STATUS_DEADLINE_MISS, STATUS_OK,
                                             ServeConfig, VerificationService)
 
@@ -303,9 +343,16 @@ def _bench_serve():
         buckets=buckets,
         max_wait_s=float(os.environ.get("BENCH_SERVE_WAIT", "0.025")),
         default_deadline_s=float(os.environ.get("BENCH_SERVE_DEADLINE",
-                                                "2.0")))
+                                                "2.0")),
+        trace_every=int(os.environ.get("BENCH_TRACE_EVERY", "100")))
     zk = ZKVerifier(pp, device=True)
-    svc = VerificationService(zk, config=cfg)
+    slo = SloMonitor()
+    svc = VerificationService(
+        zk, config=cfg,
+        resilience=ResilienceConfig(watchdog_timeout_s=120.0), slo=slo)
+    if svc.breaker is not None:
+        slo.bind_breaker(svc.breaker)
+    telemetry = _start_bench_telemetry(svc)
     n = len(proofs)
 
     async def run():
@@ -343,6 +390,12 @@ def _bench_serve():
         return prewarm_s, results, elapsed
 
     prewarm_s, results, elapsed = asyncio.run(run())
+    if telemetry is not None:
+        telemetry.stop()
+    from fabric_token_sdk_tpu.obs import PROFILER
+    print(f"serve bench: slo {json.dumps(slo.summary())}", file=sys.stderr)
+    print(f"serve bench: profile {json.dumps(PROFILER.summary())}",
+          file=sys.stderr)
     ok = [r for r in results if r.status == STATUS_OK]
     misses = sum(r.status == STATUS_DEADLINE_MISS for r in results)
     shed = len(results) - len(ok) - misses
@@ -415,7 +468,13 @@ def _bench_chaos():
     injector = FaultInjector(seed=seed, transient_rate=fault_rate,
                              stall_rate=stall_rate, stall_s=0.02)
     faulty = injector.wrap(zk)
-    svc = VerificationService(faulty, config=cfg, resilience=resil)
+    # SLO gauges ride along, but the breaker stays driven by its own
+    # failure accounting (no bind_breaker): a fast-burn force-open would
+    # change the fault-recovery behaviour the chaos bench measures.
+    from fabric_token_sdk_tpu.obs import SloMonitor
+    svc = VerificationService(faulty, config=cfg, resilience=resil,
+                              slo=SloMonitor())
+    telemetry = _start_bench_telemetry(svc)
     n = len(proofs)
     forged = copy.deepcopy(proofs[0])
     forged.data.tau = (forged.data.tau + 1) % (1 << 250)
@@ -448,6 +507,8 @@ def _bench_chaos():
         return prewarm_s, results, elapsed
 
     prewarm_s, results, elapsed = asyncio.run(run())
+    if telemetry is not None:
+        telemetry.stop()
     total = len(results)
     served = [r for r in results if r.status in (STATUS_OK,
                                                 STATUS_DEADLINE_MISS)
@@ -696,3 +757,4 @@ if __name__ == "__main__":
         main()
     finally:
         _write_obs_report()
+        _write_trace_out()

@@ -25,6 +25,20 @@ from ...obs import TRACER as _TRACER
 
 logger = logging.getLogger("fabric_token_sdk_tpu.zkverifier")
 
+#: zk_* family metadata (HELP independent of call-site order).
+_ZK_FAMILIES = {
+    "zk_blocks_verified_total": "Block-level verify_block calls",
+    "zk_block_actions_total":
+        "Actions through verify_block, by accept/reject",
+    "zk_range_batch_verify_seconds":
+        "Batched device range-proof verification wall",
+    "zk_range_proofs_verified_total":
+        "Range proofs verified on the device batch path, by verdict",
+    "zk_sigma_verify_seconds": "Σ-protocol verification wall per action",
+}
+for _fam, _help in _ZK_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
+
 
 def __getattr__(name: str):
     # Back-compat for the old module-global disagreement count: the value
@@ -99,6 +113,15 @@ class ZKVerifier:
                 _adjust.prewarm(batch_sizes=(b,))
             out[b] = _time.perf_counter() - t0
         return out
+
+    def kernel_cost(self, batch_size: int) -> dict | None:
+        """XLA cost analysis of the dominant range kernel at a bucket
+        (see ``BatchRangeVerifier.kernel_cost``); None without a device
+        backend. Consumed duck-typed by the device profiler at serve
+        prewarm (the FaultyZK chaos shim passes it through)."""
+        if self._range is None:
+            return None
+        return self._range.kernel_cost(batch_size)
 
     # ------------------------------------------------------------ transfer
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],

@@ -22,6 +22,11 @@ from dataclasses import dataclass, field
 from ..obs import GLOBAL as _METRICS
 from ..obs import bench_snapshot
 
+_METRICS.describe("txgen_ops_total",
+                  "Load-generator operations executed, by op and outcome")
+_METRICS.describe("txgen_op_seconds",
+                  "End-to-end wall per load-generator operation")
+
 
 def open_loop_arrivals(rate_hz: float, duration_s: float,
                        seed: int = 0) -> list[float]:

@@ -24,6 +24,10 @@ from ..ops import ec, limbs
 from .batching import bucket_rows
 from .range_verifier import affine_batch_to_bytes
 
+_METRICS.describe(
+    "adjust_points_total",
+    "Commitment adjustments performed, by host/device path")
+
 #: Below this count the two host adds beat the device round-trip.
 _HOST_THRESHOLD = 16
 

@@ -1035,6 +1035,38 @@ class BatchRangeVerifier:
             out[b] = _time.perf_counter() - t0
         return out
 
+    def kernel_cost(self, batch_size: int) -> dict | None:
+        """XLA cost analysis (FLOPs, bytes accessed) of the dominant
+        pass-2 kernel — the per-chunk variable-base windowed MSM — at the
+        padded chunk bucket covering ``batch_size``.
+
+        Lowering only, never compiles: ``jit(...).lower`` traces the
+        kernel against ShapeDtypeStructs and ``Lowered.cost_analysis``
+        reads the estimate off the unoptimized module. Feeds the
+        ``profile_bucket_*`` roofline gauges (obs/profiling.py); any
+        failure (backend without cost analysis, jax API drift) returns
+        None rather than disturbing the serving path.
+        """
+        try:
+            rows = _bucket_rows(min(int(batch_size), _CHUNK_ROWS))
+            nv = 2 + 2 * self.params.rounds + 3
+            pts = jax.ShapeDtypeStruct((rows * nv, 3, limbs.NLIMBS),
+                                       jnp.uint32)
+            sc = jax.ShapeDtypeStruct((rows * nv, limbs.NLIMBS),
+                                      jnp.uint32)
+            cost = _var_partial_kernel.lower(pts, sc).cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if not isinstance(cost, dict):
+                return None
+            return {"kernel": "msm_windowed", "chunk_rows": rows,
+                    "points": rows * nv,
+                    "flops": cost.get("flops"),
+                    "bytes_accessed": cost.get(
+                        "bytes_accessed", cost.get("bytes accessed"))}
+        except Exception:
+            return None
+
     def verify(self, proofs: list[rp.RangeProof], commitments: list,
                exact: bool = False) -> np.ndarray:
         """Returns a bool accept vector, one entry per (proof, commitment).

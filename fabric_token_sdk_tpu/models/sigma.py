@@ -40,6 +40,15 @@ from ..ops import ec, limbs
 from .batching import bucket_rows as _bucket_rows, next_pow2 as _next_pow2
 from .range_verifier import affine_batch_to_bytes, hex_ascii
 
+#: Σ-protocol family metadata (HELP independent of call-site order).
+_SIGMA_FAMILIES = {
+    "sigma_dispatches_total": "Σ-protocol device dispatches, by kind",
+    "sigma_rows_total": "Live Σ rows verified, by kind",
+    "sigma_pad_rows_total": "Σ padding rows added for bucketing, by kind",
+}
+for _fam, _help in _SIGMA_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
+
 
 @jax.jit
 def _sigma_tables_kernel(gens):

@@ -21,18 +21,25 @@ Layer map vs the reference SDK:
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsProvider, GLOBAL,
-                      escape_label_value, sanitize_label_name,
-                      sanitize_metric_name)
+                      escape_help_text, escape_label_value,
+                      sanitize_label_name, sanitize_metric_name)
 from .tracing import Span, Tracer, TRACER
 from .pipeline import BatchRecord, PhaseTimer, PipelineRecorder, RECORDS
 from .export import spans_to_chrome_trace, write_chrome_trace
 from .report import bench_snapshot, write_bench_report
+from .slo import SloMonitor, SloPolicy
+from .profiling import DeviceProfiler, PROFILER
+from .telemetry import TelemetryConfig, TelemetryServer, serve_telemetry
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsProvider", "GLOBAL",
     "sanitize_metric_name", "sanitize_label_name", "escape_label_value",
+    "escape_help_text",
     "Span", "Tracer", "TRACER",
     "BatchRecord", "PhaseTimer", "PipelineRecorder", "RECORDS",
     "spans_to_chrome_trace", "write_chrome_trace",
     "bench_snapshot", "write_bench_report",
+    "SloMonitor", "SloPolicy",
+    "DeviceProfiler", "PROFILER",
+    "TelemetryConfig", "TelemetryServer", "serve_telemetry",
 ]

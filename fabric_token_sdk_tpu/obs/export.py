@@ -20,6 +20,14 @@ from .tracing import Span
 
 def _span_events(span: Span, pid: int, tid: int) -> list[dict]:
     ts = span.start * 1e6
+    args = {
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "parent_id": span.parent_id,
+        **span.attributes,
+    }
+    if span.links:
+        args["links"] = list(span.links)
     out = [{
         "name": span.name,
         "ph": "X",
@@ -27,12 +35,7 @@ def _span_events(span: Span, pid: int, tid: int) -> list[dict]:
         "dur": (span.duration or 0.0) * 1e6,
         "pid": pid,
         "tid": tid,
-        "args": {
-            "trace_id": span.trace_id,
-            "span_id": span.span_id,
-            "parent_id": span.parent_id,
-            **span.attributes,
-        },
+        "args": args,
     }]
     for name, offset, attrs in span.events:
         out.append({

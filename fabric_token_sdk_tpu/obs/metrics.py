@@ -53,6 +53,13 @@ def escape_label_value(value) -> str:
             .replace("\n", "\\n"))
 
 
+def escape_help_text(value) -> str:
+    """Escape HELP text per the exposition format. Unlike label values,
+    HELP lines are unquoted: only backslash and line feed are escaped —
+    a double quote must pass through verbatim."""
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 @dataclass
 class Counter:
     value: float = 0.0
@@ -228,9 +235,14 @@ class MetricsProvider:
             return "{" + inner + "}"
 
         def fmt_num(v) -> str:
+            v = float(v)
+            if v != v:
+                return "NaN"
             if v == float("inf"):
                 return "+Inf"
-            return repr(float(v))
+            if v == float("-inf"):
+                return "-Inf"
+            return repr(v)
 
         with self._lock:
             by_family: dict[str, list] = {}
@@ -246,13 +258,14 @@ class MetricsProvider:
                 kind = by_family[name][0][0]
                 help_text = self._help.get(name, "") or name
                 lines.append(f"# HELP {fam} "
-                             f"{escape_label_value(help_text)}")
+                             f"{escape_help_text(help_text)}")
                 lines.append(f"# TYPE {fam} {kind}")
                 for _, labels, inst in sorted(
                         by_family[name], key=lambda t: t[1]):
                     if isinstance(inst, (Counter, Gauge)):
                         lines.append(
-                            f"{fam}{fmt_labels(labels)} {inst.value}")
+                            f"{fam}{fmt_labels(labels)} "
+                            f"{fmt_num(inst.value)}")
                     else:
                         cum = 0
                         for bound, cnt in zip(inst.buckets, inst.counts):
@@ -265,7 +278,8 @@ class MetricsProvider:
                             f"{fmt_labels(labels + (('le', '+Inf'),))} "
                             f"{inst.n}")
                         lines.append(
-                            f"{fam}_sum{fmt_labels(labels)} {inst.total}")
+                            f"{fam}_sum{fmt_labels(labels)} "
+                            f"{fmt_num(inst.total)}")
                         lines.append(
                             f"{fam}_count{fmt_labels(labels)} {inst.n}")
         return "\n".join(lines) + "\n"

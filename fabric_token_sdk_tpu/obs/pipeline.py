@@ -23,6 +23,22 @@ from dataclasses import dataclass, field
 
 from .metrics import GLOBAL, MetricsProvider
 
+#: Family metadata for the pipeline_* instruments (HELP lines must not
+#: depend on call-site order; scripts/check_metric_help.py enforces).
+_PIPELINE_FAMILIES = {
+    "pipeline_batches_total":
+        "Batched device verifies, by kind and cold/steady state",
+    "pipeline_rows_total": "Live (non-padding) rows verified, by kind",
+    "pipeline_pad_rows_total": "Padding rows added for bucketing, by kind",
+    "pipeline_batch_seconds": "Batch wall seconds, by kind and state",
+    "pipeline_steady_seconds":
+        "Steady-state batch wall seconds (cold compiles excluded)",
+    "pipeline_phase_seconds":
+        "Host-prep / device-execute / result-fetch wall split per batch",
+    "pipeline_pad_waste_ratio":
+        "Fraction of padded device rows carrying no real proof",
+}
+
 
 @dataclass
 class BatchRecord:
@@ -105,6 +121,8 @@ class PipelineRecorder:
         self._keep = keep
         self._seen_shapes: set = set()
         self._lock = threading.Lock()
+        for fam, help_text in _PIPELINE_FAMILIES.items():
+            self.provider.describe(fam, help_text)
 
     def is_cold(self, kind: str, shape_key) -> bool:
         """True (and marks seen) when this process has not run `kind` at

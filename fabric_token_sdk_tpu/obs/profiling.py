@@ -1,0 +1,188 @@
+"""Device profiling telemetry: compile cost, kernel roofline inputs,
+memory watermarks.
+
+Turns the one-off ROOFLINE.md study into continuously measured
+quantities: per-bucket XLA cost analysis (FLOPs / bytes accessed, taken
+from the *lowered* module so capturing it never triggers a compile) and
+compile seconds at prewarm, device memory watermarks and compile-cache
+hit/miss counters at dispatch. All capture paths are guarded — a JAX
+version that lacks ``cost_analysis`` keys, or a CPU backend whose
+``memory_stats()`` is ``None``, degrades to "metric absent", never to an
+exception on the serving path.
+
+Exported families (stable names, see ROADMAP):
+  profile_compile_seconds{kind,bucket}     compile wall time
+  profile_bucket_flops{kind,bucket}        lowered-module FLOP estimate
+  profile_bucket_bytes{kind,bucket}        lowered-module bytes accessed
+  profile_device_bytes_in_use{device}      allocator watermark (live)
+  profile_device_peak_bytes{device}        allocator watermark (peak)
+  profile_compile_cache_total{kind,event}  hit/miss at dispatch
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .metrics import GLOBAL, MetricsProvider
+
+_PROFILE_FAMILIES = {
+    "profile_compile_seconds":
+        "Wall-clock compile/warm-up seconds per kernel kind and batch "
+        "bucket.",
+    "profile_bucket_flops":
+        "XLA cost-analysis FLOP estimate for the dominant kernel at a "
+        "batch bucket (lowering only, never compiles).",
+    "profile_bucket_bytes":
+        "XLA cost-analysis bytes-accessed estimate for the dominant "
+        "kernel at a batch bucket.",
+    "profile_device_bytes_in_use":
+        "Device allocator bytes currently in use (absent on backends "
+        "without memory_stats).",
+    "profile_device_peak_bytes":
+        "Device allocator peak bytes in use since process start.",
+    "profile_compile_cache_total":
+        "Dispatch-time compile-cache events: event=hit rows whose "
+        "(kind, bucket) shape was already compiled, event=miss first "
+        "sightings.",
+}
+
+
+def _normalize_cost(cost) -> dict | None:
+    """``cost_analysis()`` shape-shifts across JAX versions: a dict on
+    some backends, a list of per-computation dicts on others. Reduce to
+    one flat dict or None."""
+    if cost is None:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    return cost
+
+
+class DeviceProfiler:
+    """Process-wide sink for device profiling telemetry.
+
+    Thread-safe: prewarm writes from the executor thread, the dispatcher
+    writes cache events from the event loop, /statusz reads from scrape
+    threads."""
+
+    def __init__(self, provider: MetricsProvider | None = None):
+        self.provider = provider or GLOBAL
+        self._costs: dict = {}
+        self._compiles: dict = {}
+        self._lock = threading.Lock()
+        for fam, help_text in _PROFILE_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    # ------------------------------------------------------------ compile
+    def record_compile(self, kind: str, bucket: int,
+                       seconds: float) -> None:
+        self.provider.histogram("profile_compile_seconds", kind=kind,
+                                bucket=bucket).observe(seconds)
+        with self._lock:
+            self._compiles[(kind, int(bucket))] = seconds
+
+    def record_cache_event(self, kind: str, hit: bool) -> None:
+        self.provider.counter("profile_compile_cache_total", kind=kind,
+                              event="hit" if hit else "miss").add()
+
+    # ----------------------------------------------------------- roofline
+    def set_bucket_cost(self, kind: str, bucket: int,
+                        cost: dict | None) -> None:
+        """Publish a normalized cost dict (``flops`` / ``bytes_accessed``
+        keys, extras kept for the summary)."""
+        if not cost:
+            return
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes_accessed", cost.get("bytes accessed"))
+        if flops is not None:
+            self.provider.gauge("profile_bucket_flops", kind=kind,
+                                bucket=bucket).set(float(flops))
+        if nbytes is not None:
+            self.provider.gauge("profile_bucket_bytes", kind=kind,
+                                bucket=bucket).set(float(nbytes))
+        with self._lock:
+            self._costs[(kind, int(bucket))] = dict(cost)
+
+    def capture_bucket_cost(self, zk, bucket: int,
+                            kind: str = "range") -> dict | None:
+        """Ask a verifier for its dominant kernel's AOT cost at a bucket
+        (duck-typed ``kernel_cost`` — the FaultyZK shim passes it
+        through) and publish it. Any failure returns None."""
+        fn = getattr(zk, "kernel_cost", None)
+        if not callable(fn):
+            return None
+        try:
+            cost = _normalize_cost(fn(bucket))
+        except Exception:
+            return None
+        self.set_bucket_cost(kind, bucket, cost)
+        return cost
+
+    def capture_kernel_cost(self, kind: str, bucket: int, fn, *args,
+                            **kwargs) -> dict | None:
+        """Lower ``fn(*args)`` (jit-wrapping if needed) and publish its
+        cost analysis. Lowering is trace-only — safe to call on the
+        serving path for kernels that were never compiled."""
+        try:
+            import jax
+
+            jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+            cost = _normalize_cost(
+                jitted.lower(*args, **kwargs).cost_analysis())
+        except Exception:
+            return None
+        self.set_bucket_cost(kind, bucket, cost)
+        return cost
+
+    # ------------------------------------------------------------- memory
+    def record_memory_watermark(self) -> dict:
+        """Sample every local device's allocator stats. Backends without
+        ``memory_stats`` (CPU) contribute nothing."""
+        out = {}
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return out
+        for dev in devices:
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            label = f"{dev.platform}:{dev.id}"
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            if in_use is not None:
+                self.provider.gauge("profile_device_bytes_in_use",
+                                    device=label).set(float(in_use))
+            if peak is not None:
+                self.provider.gauge("profile_device_peak_bytes",
+                                    device=label).set(float(peak))
+            out[label] = {"bytes_in_use": in_use, "peak_bytes": peak}
+        return out
+
+    # ------------------------------------------------------------ reading
+    def summary(self) -> dict:
+        """Point-in-time view for /statusz and the BENCH report."""
+        with self._lock:
+            costs = {f"{kind}:{bucket}": {
+                "flops": cost.get("flops"),
+                "bytes_accessed": cost.get(
+                    "bytes_accessed", cost.get("bytes accessed")),
+            } for (kind, bucket), cost in sorted(self._costs.items())}
+            compiles = {f"{kind}:{bucket}": round(s, 3)
+                        for (kind, bucket), s in
+                        sorted(self._compiles.items())}
+        return {"bucket_costs": costs, "compile_seconds": compiles,
+                "memory": self.record_memory_watermark(),
+                "sampled_at": time.time()}
+
+
+#: Process-global profiler (mirrors obs.metrics.GLOBAL / obs.tracing.TRACER).
+PROFILER = DeviceProfiler()

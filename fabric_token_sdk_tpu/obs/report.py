@@ -30,13 +30,18 @@ def bench_snapshot(provider: MetricsProvider | None = None,
     provider = provider or GLOBAL
     recorder = recorder or RECORDS
     counters: dict[str, list] = {}
+    gauges: dict[str, list] = {}
     histograms: dict[str, list] = {}
     with provider._lock:
         counter_items = list(provider._counters.items())
+        gauge_items = list(provider._gauges.items())
         hist_items = list(provider._histograms.items())
     for (name, labels), c in counter_items:
         counters.setdefault(name, []).append(
             {"labels": _labels_dict(labels), "value": c.value})
+    for (name, labels), g in gauge_items:
+        gauges.setdefault(name, []).append(
+            {"labels": _labels_dict(labels), "value": g.value})
     for (name, labels), h in hist_items:
         histograms.setdefault(name, []).append({
             "labels": _labels_dict(labels),
@@ -50,6 +55,7 @@ def bench_snapshot(provider: MetricsProvider | None = None,
         "schema": "fts-obs-bench-v1",
         "host": platform.node(),
         "counters": counters,
+        "gauges": gauges,
         "histograms": histograms,
         "pipeline": recorder.summary(),
     }

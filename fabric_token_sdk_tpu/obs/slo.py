@@ -1,0 +1,192 @@
+"""SLO burn-rate monitor over serve results.
+
+Multi-window availability / p99-latency tracking in the Google SRE
+workbook style: the error-budget *burn rate* is the ratio between the
+observed error rate and the rate that would exactly exhaust the budget
+over the SLO period — burn 1.0 spends the budget on schedule, burn 14.4
+exhausts a 30-day budget in 2 days. A **fast-burn** condition (high burn
+sustained on the short window, confirmed on the long window) is the
+page-worthy signal; here it can optionally trip the serve circuit
+breaker's ``force_open`` kill switch so overload degrades to host
+fallback instead of a deadline-miss storm.
+
+Everything is clock-injectable and lock-protected: ``record`` is called
+from the serve dispatcher loop while gauges are scraped from the
+telemetry server's request threads.
+
+Exported families (stable names, see ROADMAP):
+  slo_availability_ratio{window}    rolling success fraction
+  slo_p99_seconds{window}           rolling p99 of successful latencies
+  slo_error_budget_burn_rate{window}
+  slo_window_requests{window}       sample count behind the two above
+  slo_fast_burn_active              1 while the fast-burn condition holds
+  slo_fast_burn_trips_total         edge-triggered trip count
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from .metrics import GLOBAL, MetricsProvider
+
+#: Bound on retained (timestamp, ok, latency) events. At the ROADMAP
+#: target of 10k verifies/s a 300 s window would want 3M events; beyond
+#: this cap the window degrades to "most recent N" — still a valid SLI
+#: estimator, and bounded memory matters more on a long-running node.
+_EVENT_KEEP = 262144
+
+_SLO_FAMILIES = {
+    "slo_availability_ratio":
+        "Rolling fraction of serve requests completing ok per window.",
+    "slo_p99_seconds":
+        "Rolling p99 latency of successful serve requests per window.",
+    "slo_error_budget_burn_rate":
+        "Observed error rate over allowed error rate per window; "
+        "1.0 spends the error budget exactly on schedule.",
+    "slo_window_requests":
+        "Serve results currently inside each SLO window.",
+    "slo_fast_burn_active":
+        "1 while the fast-burn condition (short- and long-window burn "
+        "above the fast_burn threshold) holds.",
+    "slo_fast_burn_trips_total":
+        "Edge-triggered count of fast-burn episodes.",
+}
+
+
+@dataclass(frozen=True)
+class SloPolicy:
+    """Serve-path SLO targets and burn thresholds.
+
+    ``windows`` orders short -> long; the fast-burn condition requires
+    the burn rate to exceed ``fast_burn`` on EVERY window (the classic
+    multi-window guard against paging on a 5-request blip)."""
+    availability_target: float = 0.999
+    p99_target_s: float = 1.0
+    windows: tuple = (60.0, 300.0)
+    fast_burn: float = 14.4
+    min_volume: int = 32
+    recover_burn: float = 1.0
+
+
+class SloMonitor:
+    """Rolling multi-window SLI tracker with an optional breaker hook.
+
+    ``record(ok, latency_s)`` is the single write path; gauges update on
+    every record so a scrape between records always sees a consistent
+    (if slightly stale) picture. ``on_fast_burn`` / ``on_recover`` fire
+    edge-triggered from inside ``record`` on the caller's thread."""
+
+    def __init__(self, policy: SloPolicy | None = None,
+                 provider: MetricsProvider | None = None,
+                 clock=time.monotonic,
+                 on_fast_burn=None, on_recover=None):
+        self.policy = policy or SloPolicy()
+        self.provider = provider or GLOBAL
+        self.clock = clock
+        self.on_fast_burn = on_fast_burn
+        self.on_recover = on_recover
+        self.fast_burn_active = False
+        self.trips = 0
+        self._events: deque = deque(maxlen=_EVENT_KEEP)
+        self._lock = threading.Lock()
+        for fam, help_text in _SLO_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    # ------------------------------------------------------------ wiring
+    def bind_breaker(self, breaker) -> None:
+        """Wire fast-burn to the circuit breaker's kill switch: trip ->
+        ``force_open`` (serve degrades to host fallback), recovery ->
+        ``force_close``. Replaces any previously-set hooks."""
+        self.on_fast_burn = breaker.force_open
+        self.on_recover = breaker.force_close
+
+    # ----------------------------------------------------------- updates
+    def record(self, ok: bool, latency_s: float | None = None) -> None:
+        now = self.clock()
+        with self._lock:
+            self._events.append((now, bool(ok), latency_s))
+            horizon = now - max(self.policy.windows)
+            while self._events and self._events[0][0] < horizon:
+                self._events.popleft()
+            stats = [self._window_stats(now, w)
+                     for w in self.policy.windows]
+        self._publish(stats)
+        self._check_burn(stats)
+
+    def _window_stats(self, now: float, window: float) -> dict:
+        """Caller holds the lock."""
+        cutoff = now - window
+        n = ok_n = 0
+        lat: list[float] = []
+        for t, ok, latency in self._events:
+            if t < cutoff:
+                continue
+            n += 1
+            if ok:
+                ok_n += 1
+                if latency is not None:
+                    lat.append(latency)
+        availability = ok_n / n if n else 1.0
+        budget = 1.0 - self.policy.availability_target
+        burn = ((1.0 - availability) / budget) if budget > 0 else 0.0
+        lat.sort()
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+        return {"window": f"{int(window)}s", "requests": n,
+                "availability": availability, "burn": burn, "p99": p99}
+
+    def _publish(self, stats: list[dict]) -> None:
+        for st in stats:
+            w = st["window"]
+            self.provider.gauge("slo_availability_ratio", window=w).set(
+                st["availability"])
+            self.provider.gauge("slo_p99_seconds", window=w).set(st["p99"])
+            self.provider.gauge("slo_error_budget_burn_rate",
+                                window=w).set(st["burn"])
+            self.provider.gauge("slo_window_requests", window=w).set(
+                st["requests"])
+
+    def _check_burn(self, stats: list[dict]) -> None:
+        volume_ok = all(st["requests"] >= self.policy.min_volume
+                        for st in stats)
+        burning = volume_ok and all(st["burn"] >= self.policy.fast_burn
+                                    for st in stats)
+        recovered = all(st["burn"] <= self.policy.recover_burn
+                        for st in stats)
+        if burning and not self.fast_burn_active:
+            self.fast_burn_active = True
+            self.trips += 1
+            self.provider.counter("slo_fast_burn_trips_total").add()
+            self.provider.gauge("slo_fast_burn_active").set(1)
+            if self.on_fast_burn is not None:
+                self.on_fast_burn()
+        elif self.fast_burn_active and recovered:
+            self.fast_burn_active = False
+            self.provider.gauge("slo_fast_burn_active").set(0)
+            if self.on_recover is not None:
+                self.on_recover()
+        else:
+            self.provider.gauge("slo_fast_burn_active").set(
+                1 if self.fast_burn_active else 0)
+
+    # ----------------------------------------------------------- reading
+    def summary(self) -> dict:
+        """Point-in-time view for /statusz and the BENCH report."""
+        now = self.clock()
+        with self._lock:
+            stats = [self._window_stats(now, w)
+                     for w in self.policy.windows]
+        return {
+            "availability_target": self.policy.availability_target,
+            "p99_target_s": self.policy.p99_target_s,
+            "fast_burn_active": self.fast_burn_active,
+            "trips": self.trips,
+            "windows": {st["window"]: {
+                "requests": st["requests"],
+                "availability": round(st["availability"], 6),
+                "burn_rate": round(st["burn"], 3),
+                "p99_s": round(st["p99"], 6),
+            } for st in stats},
+        }

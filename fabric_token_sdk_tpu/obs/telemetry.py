@@ -1,0 +1,261 @@
+"""Live telemetry plane: stdlib HTTP exposition of metrics, health,
+status, and traces.
+
+The obs/ stack so far was harvestable only post-mortem (in-process
+snapshots, BENCH_OBS_OUT artifacts). This module puts a scrape surface
+on a running node with zero new dependencies — ``http.server`` on a
+daemon thread, in the spirit of the reference SDK's operational
+services (auditor/logging) and the Prometheus exposition conventions:
+
+  /metrics   Prometheus text format (``MetricsProvider.prometheus_text``)
+  /healthz   liveness: 200 unless a registered health check fails
+             (e.g. circuit breaker open) -> 503
+  /readyz    readiness: 200 once registered ready checks pass
+             (serve frontend running, prewarm complete) -> 503
+  /statusz   JSON snapshot from registered status sources (queue depths,
+             prewarm, breaker, pipeline records, SLO, profiler)
+  /tracez    Chrome-trace JSON of the tracer's completed span buffer
+
+Scrapes observe themselves: ``telemetry_scrapes_total{endpoint}`` is
+incremented BEFORE rendering so a /metrics response already contains its
+own scrape, and ``telemetry_scrape_seconds{endpoint}`` times rendering.
+
+Thread model: ``ThreadingHTTPServer`` handles each scrape on its own
+thread; every data source consulted (metrics registry, tracer root
+buffer, SLO monitor, profiler) takes its own lock, and status sources
+are individually guarded so one failing subsystem degrades to an
+``{"error": ...}`` entry instead of a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .export import spans_to_chrome_trace
+from .metrics import GLOBAL, MetricsProvider
+from .tracing import TRACER, Tracer
+
+_TELEMETRY_FAMILIES = {
+    "telemetry_scrapes_total":
+        "Telemetry HTTP requests served, by endpoint (incremented "
+        "before rendering so /metrics includes its own scrape).",
+    "telemetry_scrape_seconds":
+        "Telemetry endpoint render latency.",
+}
+
+_ENDPOINTS = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez")
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """Where the telemetry plane listens. ``port=0`` binds an ephemeral
+    port (tests); production passes a fixed scrape port."""
+    host: str = "127.0.0.1"
+    port: int = 0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "fts-telemetry/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # scrape traffic must not spam the node's stdout
+
+    def do_GET(self):
+        telemetry: TelemetryServer = self.server.telemetry
+        path = self.path.split("?", 1)[0]
+        t0 = time.perf_counter()
+        try:
+            code, ctype, body = telemetry.render(path)
+        except Exception as exc:  # defensive: a scrape must never crash
+            code, ctype = 500, "text/plain; charset=utf-8"
+            body = f"internal error: {exc!r}\n".encode()
+        telemetry.provider.histogram(
+            "telemetry_scrape_seconds", endpoint=path).observe(
+            time.perf_counter() - t0)
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TelemetryServer:
+    """Daemon-thread HTTP server over the obs/ registries.
+
+    Checks and status sources are registered as callables so the server
+    stays decoupled from serve/resilience: ``add_health_check(name, fn)``
+    where ``fn() -> (ok, detail)`` or a plain bool; ``add_status_source``
+    registers a ``fn() -> JSON-serializable`` snapshot."""
+
+    def __init__(self, config: TelemetryConfig | None = None,
+                 provider: MetricsProvider | None = None,
+                 tracer: Tracer | None = None):
+        self.config = config or TelemetryConfig()
+        self.provider = provider or GLOBAL
+        self.tracer = tracer or TRACER
+        self._health: dict[str, object] = {}
+        self._ready: dict[str, object] = {}
+        self._status: dict[str, object] = {}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._started_at: float | None = None
+        for fam, help_text in _TELEMETRY_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    # ---------------------------------------------------------- wiring
+    def add_health_check(self, name: str, fn) -> None:
+        self._health[name] = fn
+
+    def add_ready_check(self, name: str, fn) -> None:
+        self._ready[name] = fn
+
+    def add_status_source(self, name: str, fn) -> None:
+        self._status[name] = fn
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> str:
+        """Bind and serve on a daemon thread; returns the base URL
+        (resolves the ephemeral port)."""
+        if self._httpd is not None:
+            return self.url
+        httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), _Handler)
+        httpd.daemon_threads = True
+        httpd.telemetry = self
+        self._httpd = httpd
+        self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="fts-telemetry", daemon=True)
+        self._thread.start()
+        return self.url
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int | None:
+        return (self._httpd.server_address[1]
+                if self._httpd is not None else None)
+
+    @property
+    def url(self) -> str:
+        host = self.config.host
+        return f"http://{host}:{self.port}"
+
+    # -------------------------------------------------------- rendering
+    @staticmethod
+    def _run_checks(checks: dict) -> dict[str, str]:
+        """Normalize check callables -> {name: failure detail} (empty
+        when healthy). A check may return bool or (ok, detail); raising
+        counts as failing."""
+        failures: dict[str, str] = {}
+        for name, fn in checks.items():
+            try:
+                res = fn()
+            except Exception as exc:
+                failures[name] = f"raised {exc!r}"
+                continue
+            if isinstance(res, tuple):
+                ok, detail = res
+            else:
+                ok, detail = bool(res), "check returned false"
+            if not ok:
+                failures[name] = str(detail)
+        return failures
+
+    def _check_body(self, checks: dict) -> tuple[int, str, bytes]:
+        failures = self._run_checks(checks)
+        if not failures:
+            return 200, "text/plain; charset=utf-8", b"ok\n"
+        body = json.dumps({"status": "unavailable",
+                           "failures": failures}).encode()
+        return 503, "application/json", body
+
+    def render(self, path: str) -> tuple[int, str, bytes]:
+        """(status code, content type, body) for one endpoint."""
+        if path in _ENDPOINTS:
+            # count before rendering: a /metrics scrape reports itself
+            self.provider.counter("telemetry_scrapes_total",
+                                  endpoint=path).add()
+        if path == "/metrics":
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    self.provider.prometheus_text().encode())
+        if path == "/healthz":
+            return self._check_body(self._health)
+        if path == "/readyz":
+            return self._check_body(self._ready)
+        if path == "/statusz":
+            status: dict = {"uptime_s": (
+                round(time.time() - self._started_at, 3)
+                if self._started_at is not None else None)}
+            for name, fn in self._status.items():
+                try:
+                    status[name] = fn()
+                except Exception as exc:
+                    status[name] = {"error": repr(exc)}
+            return (200, "application/json",
+                    json.dumps(status, default=str).encode())
+        if path == "/tracez":
+            doc = spans_to_chrome_trace(self.tracer.root_snapshot())
+            return 200, "application/json", json.dumps(doc).encode()
+        if path == "/":
+            body = ("fabric_token_sdk_tpu telemetry\n"
+                    + "".join(f"  {e}\n" for e in _ENDPOINTS)).encode()
+            return 200, "text/plain; charset=utf-8", body
+        return 404, "text/plain; charset=utf-8", b"not found\n"
+
+
+def serve_telemetry(service, config: TelemetryConfig | None = None,
+                    provider: MetricsProvider | None = None,
+                    tracer: Tracer | None = None) -> TelemetryServer:
+    """Wire a TelemetryServer to a serve ``VerificationService``
+    (duck-typed) and start it.
+
+    healthz fails while the circuit breaker is OPEN (forced or tripped):
+    the node is alive but actively degrading, which is what a load
+    balancer should route around. readyz fails until the frontend is
+    running and prewarm compiled every bucket.
+    """
+    server = TelemetryServer(config=config, provider=provider,
+                             tracer=tracer)
+    breaker = getattr(service, "breaker", None)
+    if breaker is not None:
+        server.add_health_check(
+            "breaker",
+            lambda: (breaker.state != "open",
+                     f"breaker {breaker.state} "
+                     f"(failure_rate={breaker.failure_rate:.3f})"))
+    server.add_ready_check(
+        "running", lambda: (bool(getattr(service, "_running", False)),
+                            "frontend not running"))
+    prewarm = getattr(service, "prewarm", None)
+    if prewarm is not None:
+        server.add_ready_check(
+            "prewarm",
+            lambda: (set(service.config.buckets) <= set(prewarm.ready),
+                     f"prewarmed {sorted(prewarm.ready)} of "
+                     f"{sorted(service.config.buckets)}"))
+    if hasattr(service, "status"):
+        server.add_status_source("serve", service.status)
+
+    from .pipeline import RECORDS
+    from .profiling import PROFILER
+    server.add_status_source("pipeline", RECORDS.summary)
+    server.add_status_source("profile", PROFILER.summary)
+    slo = getattr(service, "slo", None)
+    if slo is not None:
+        server.add_status_source("slo", slo.summary)
+    server.start()
+    return server

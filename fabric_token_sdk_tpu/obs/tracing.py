@@ -46,6 +46,7 @@ class Span:
     attributes: dict = field(default_factory=dict)
     events: list = field(default_factory=list)
     children: list = field(default_factory=list)
+    links: list = field(default_factory=list)
     duration: float | None = None
 
     def add_event(self, name: str, **attributes) -> None:
@@ -55,6 +56,17 @@ class Span:
 
     def set_attribute(self, key: str, value) -> None:
         self.attributes[key] = value
+
+    def add_link(self, other: "Span", **attributes) -> None:
+        """OpenTelemetry-style span link: a causal reference to a span in
+        a DIFFERENT trace (a shared batch-dispatch span references each
+        member request's span and vice versa). Links carry enough identity
+        to join the two traces in an export."""
+        link = {"trace_id": other.trace_id, "span_id": other.span_id,
+                "name": other.name}
+        if attributes:
+            link.update(attributes)
+        self.links.append(link)
 
     def walk(self):
         """Depth-first iteration over this span and its descendants."""
@@ -84,10 +96,10 @@ class Tracer:
         self._keep = keep_spans
         self._lock = threading.Lock()
 
-    @contextmanager
-    def span(self, name: str, **attributes):
-        parent: Span | None = _CURRENT.get()
-        sp = Span(name=name, start=time.perf_counter(),
+    def _make_span(self, name: str, parent: Span | None,
+                   attributes: dict, start: float | None = None) -> Span:
+        sp = Span(name=name,
+                  start=time.perf_counter() if start is None else start,
                   span_id=_next_id(),
                   trace_id=(parent.trace_id if parent is not None
                             else _next_id()),
@@ -96,6 +108,54 @@ class Tracer:
                   attributes=dict(attributes))
         if parent is not None:
             parent.children.append(sp)
+        return sp
+
+    def _finish(self, sp: Span, end: float | None = None) -> None:
+        sp.duration = ((time.perf_counter() if end is None else end)
+                       - sp.start)
+        self.provider.histogram(
+            sanitize_metric_name(f"span_{sp.name}_seconds")).observe(
+            sp.duration)
+        with self._lock:
+            self.finished.append(sp)
+            if len(self.finished) > self._keep:
+                self.finished.pop(0)
+            if sp.parent_id is None:
+                self.roots.append(sp)
+                if len(self.roots) > self._keep:
+                    self.roots.pop(0)
+
+    def start_span(self, name: str, parent: Span | None = None,
+                   **attributes) -> Span:
+        """Explicitly-parented span for flows a ``with`` block cannot
+        scope: a serve request whose lifetime spans admission -> queue ->
+        dispatch -> verdict across coroutines and executor threads (the
+        contextvar does not propagate through ``run_in_executor``). Pair
+        with :meth:`end_span`; ``parent=None`` starts a new trace."""
+        return self._make_span(name, parent, attributes)
+
+    def end_span(self, span: Span) -> None:
+        """Finish a span obtained from :meth:`start_span`. Idempotent so
+        late completions (deadline expiry racing dispatch) cannot
+        double-observe the duration histogram."""
+        if span.duration is not None:
+            return
+        self._finish(span)
+
+    def record_span(self, name: str, start: float, end: float,
+                    parent: Span | None = None, **attributes) -> Span:
+        """Record an already-elapsed interval as a completed span
+        (e.g. queue wait reconstructed at dispatch time from the request's
+        enqueue timestamp). ``start``/``end`` are perf_counter values."""
+        sp = self._make_span(name, parent, attributes, start=start)
+        self._finish(sp, end=end)
+        return sp
+
+    @contextmanager
+    def span(self, name: str, parent: Span | None = None, **attributes):
+        if parent is None:
+            parent = _CURRENT.get()
+        sp = self._make_span(name, parent, attributes)
         token = _CURRENT.set(sp)
         profiling = False
         annotation = None
@@ -125,22 +185,17 @@ class Tracer:
 
                 jax.profiler.stop_trace()
             _CURRENT.reset(token)
-            sp.duration = time.perf_counter() - sp.start
-            self.provider.histogram(
-                sanitize_metric_name(f"span_{name}_seconds")).observe(
-                sp.duration)
-            with self._lock:
-                self.finished.append(sp)
-                if len(self.finished) > self._keep:
-                    self.finished.pop(0)
-                if parent is None:
-                    self.roots.append(sp)
-                    if len(self.roots) > self._keep:
-                        self.roots.pop(0)
+            self._finish(sp)
 
     def current(self) -> Span | None:
         """The innermost open span on this execution context, if any."""
         return _CURRENT.get()
+
+    def root_snapshot(self) -> list[Span]:
+        """Copy of the completed-root list, taken under the lock — the
+        safe input for exporters running on scrape threads."""
+        with self._lock:
+            return list(self.roots)
 
     def last_root(self, name: str | None = None) -> Span | None:
         """Most recent completed root span (optionally by name)."""

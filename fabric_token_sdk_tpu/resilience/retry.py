@@ -108,14 +108,18 @@ class RetryPolicy:
             yield prev
 
     def pause(self, delay_s: float, op: str | None = None,
-              sleep=time.sleep) -> None:
-        """One observable retry wait: counter + span + sleep."""
+              sleep=time.sleep, parent=None) -> None:
+        """One observable retry wait: counter + span + sleep.
+
+        ``parent`` explicitly parents the ``resil.retry`` span (the serve
+        dispatcher attaches retries under its batch span, which lives
+        outside the contextvar chain)."""
         op = op or self.op
         _METRICS.counter(
             "resil_retries_total",
             help="Retry waits taken, by logical operation",
             op=op).add()
-        with _TRACER.span("resil.retry", op=op,
+        with _TRACER.span("resil.retry", parent=parent, op=op,
                           sleep_s=round(delay_s, 6)):
             if delay_s > 0:
                 sleep(delay_s)

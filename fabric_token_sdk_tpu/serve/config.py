@@ -52,6 +52,10 @@ class ServeConfig:
         push a member past ``deadline - service_estimate_s``.
     prewarm_block: also compile the block path (Σ + adjust kernels) at
         startup; range-only services skip it to keep prewarm minimal.
+    trace_every: trace sampling — every Nth admitted request gets a full
+        ``serve.request`` span (admission → queue wait → linked batch
+        dispatch → verdict) under its own trace id. 1 traces everything,
+        0 disables request tracing (batch-level spans remain).
     """
 
     buckets: tuple = tuple(b for b in B_BUCKETS if b <= 1024)
@@ -62,6 +66,7 @@ class ServeConfig:
     service_estimate_s: float = 0.0
     prewarm_block: bool = False
     lanes: tuple = LANES
+    trace_every: int = 1
 
     def __post_init__(self):
         if not self.buckets:

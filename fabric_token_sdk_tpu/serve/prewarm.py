@@ -27,6 +27,7 @@ import time
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
+from ..obs.profiling import PROFILER
 from .config import ServeConfig
 
 
@@ -62,5 +63,11 @@ class PrewarmManager:
                     "serve_prewarm_seconds",
                     help="Per-bucket prewarm compile wall at service start",
                     bucket=str(bucket)).observe(elapsed)
+                # profiling telemetry: compile wall + AOT cost analysis of
+                # the dominant kernel at this bucket (lowering only; a
+                # backend without kernel_cost contributes nothing)
+                PROFILER.record_compile("serve_prewarm", bucket, elapsed)
+                PROFILER.capture_bucket_cost(self.zk, bucket)
+            PROFILER.record_memory_watermark()
         self.total_s += time.perf_counter() - t0
         return self.total_s

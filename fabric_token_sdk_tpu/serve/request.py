@@ -80,6 +80,7 @@ class VerifyRequest:
     enqueue_t: float = field(default_factory=time.perf_counter)
     future: object = None     # asyncio.Future set by the service
     req_id: int = field(default_factory=lambda: next(_req_ids))
+    span: object = None       # obs Span opened at admission (sampled)
 
     @property
     def group(self) -> str:

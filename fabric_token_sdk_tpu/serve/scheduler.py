@@ -146,7 +146,9 @@ class BucketScheduler:
                 "serve_batch_fill_ratio",
                 help="Live rows / covering bucket, per dispatched batch",
                 group=group).observe(len(batch) / bucket)
-            _METRICS.histogram("serve_batch_rows", group=group).observe(
-                len(batch))
+            _METRICS.histogram(
+                "serve_batch_rows",
+                help="Live rows per dispatched batch",
+                group=group).observe(len(batch))
             return batch
         return []

@@ -30,6 +30,19 @@ wait/dispatch histograms, shed/deadline-miss counters (all under the
 stable ``serve_*`` family), retry/breaker/fallback/watchdog counters
 (``resil_*``), plus ``serve.dispatch`` / ``resil.retry`` /
 ``resil.fallback`` spans.
+
+Trace propagation (``ServeConfig.trace_every``): every Nth admitted
+request opens a ``serve.request`` root span with its own trace id, which
+the service carries across the whole lifetime the contextvar cannot
+(coroutine -> scheduler queue -> executor thread): queue wait lands as a
+``serve.queue_wait`` child at dispatch, the shared per-batch
+``serve.batch`` span cross-links with every member request's span, and
+``serve.dispatch`` / ``resil.retry`` / ``resil.fallback`` spans parent
+under the batch span — so one request's admission/wait/dispatch/retry
+history is a connected chain in the Chrome-trace export. An optional
+:class:`~fabric_token_sdk_tpu.obs.slo.SloMonitor` receives every
+terminal result, and the device profiler records compile-cache hit/miss
+and memory watermarks per dispatch.
 """
 
 from __future__ import annotations
@@ -41,6 +54,7 @@ import numpy as np
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
+from ..obs.profiling import PROFILER
 from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
     ResilienceConfig
 from .admission import AdmissionController
@@ -83,14 +97,19 @@ class VerificationService:
     ``resilience=None`` (the default) preserves the bare dispatch
     behaviour: one attempt, no breaker, no watchdog, no fallback —
     failures complete the batch with ``status="error"``.
+
+    ``slo`` optionally attaches an :class:`SloMonitor` that receives
+    every terminal result (``slo.bind_breaker(svc.breaker)`` wires
+    fast-burn to the breaker's kill switch).
     """
 
     def __init__(self, zk, config: ServeConfig | None = None,
                  resilience: ResilienceConfig | None = None,
-                 fallback=None):
+                 fallback=None, slo=None):
         self.zk = zk
         self.config = config or ServeConfig()
         self.resilience = resilience
+        self.slo = slo
         self.scheduler = BucketScheduler(self.config)
         self.admission = AdmissionController(self.config)
         self.prewarm = PrewarmManager(zk, self.config)
@@ -115,6 +134,18 @@ class VerificationService:
         self._task: asyncio.Task | None = None
         self._wake: asyncio.Event | None = None
         self._running = False
+        # (group, bucket) shapes already dispatched/prewarmed — the basis
+        # of the profile_compile_cache_total hit/miss classification
+        self._warm_shapes: set[tuple] = set()
+        # the in-flight batch's span: exactly one batch is in flight at a
+        # time, and the executor thread cannot see the event loop's
+        # contextvars, so explicit hand-off is both safe and required
+        self._batch_span = None
+
+    @property
+    def breaker(self):
+        """The dispatch circuit breaker (None without resilience)."""
+        return self._breaker
 
     # ---------------------------------------------------------- lifecycle
     async def start(self, prewarm: bool = True) -> float:
@@ -209,9 +240,21 @@ class VerificationService:
         req = VerifyRequest(kind=kind, payload=payload, lane=lane,
                             deadline=now + deadline_s, enqueue_t=now,
                             future=asyncio.get_running_loop().create_future())
+        if self.config.trace_every \
+                and req.req_id % self.config.trace_every == 0:
+            req.span = _TRACER.start_span(
+                "serve.request", kind=kind, lane=lane, req_id=req.req_id,
+                deadline_s=round(deadline_s, 6))
         shed = self.admission.admit(req, self.scheduler.lane_depth(lane))
         if shed is not None:
-            return VerifyResult(status=shed)
+            result = VerifyResult(status=shed)
+            if self.slo is not None:
+                self.slo.record(False)
+            self._finish_request_span(req, result)
+            return result
+        if req.span is not None:
+            req.span.add_event(
+                "admitted", depth=self.scheduler.lane_depth(lane))
         self.scheduler.push(req)
         self._wake.set()
         return await req.future
@@ -258,13 +301,51 @@ class VerificationService:
                 pass
 
     async def _dispatch(self, batch: list[VerifyRequest]):
-        """One batch through the resilient device path.
+        """One batch through the resilient device path, under a shared
+        ``serve.batch`` span cross-linked with every member request's
+        span (the OpenTelemetry link pattern for fan-in: N request traces
+        reference one batch span and vice versa).
 
-        Returns ``(verdicts, served_by)``. Attempt order: device call
-        (watchdog-bounded) with retry on transient errors while the
-        breaker admits traffic; then the host fallback; then raise the
-        last error (the batch completes with ``status="error"``).
+        Returns ``(verdicts, served_by)``.
         """
+        group = batch[0].group
+        bucket = self.config.bucket_for(len(batch))
+        warm_key = (group, bucket)
+        # compile-cache classification: prewarm covers range buckets (and
+        # block shapes when prewarm_block); anything else is warm only
+        # after its first dispatch
+        prewarmed = bucket in self.prewarm.ready and (
+            group == KIND_RANGE or self.config.prewarm_block)
+        PROFILER.record_cache_event(
+            "serve_dispatch", hit=prewarmed
+            or warm_key in self._warm_shapes)
+        self._warm_shapes.add(warm_key)
+        bspan = _TRACER.start_span("serve.batch", group=group,
+                                   rows=len(batch), bucket=bucket)
+        for req in batch:
+            if req.span is not None:
+                bspan.add_link(req.span, role="member")
+                req.span.add_link(bspan, role="batch")
+        self._batch_span = bspan
+        try:
+            verdicts, served_by = await self._dispatch_resilient(batch,
+                                                                 bspan)
+            bspan.set_attribute("served_by", served_by)
+            return verdicts, served_by
+        except Exception as exc:
+            bspan.set_attribute("error", f"{type(exc).__name__}: {exc}")
+            raise
+        finally:
+            self._batch_span = None
+            _TRACER.end_span(bspan)
+            PROFILER.record_memory_watermark()
+
+    async def _dispatch_resilient(self, batch: list[VerifyRequest],
+                                  bspan):
+        """Attempt order: device call (watchdog-bounded) with retry on
+        transient errors while the breaker admits traffic; then the host
+        fallback; then raise the last error (the batch completes with
+        ``status="error"``)."""
         if self.resilience is None:
             return (await self._watchdog.run(self._run_batch, batch),
                     SERVED_BY_DEVICE)
@@ -284,14 +365,15 @@ class VerificationService:
                     delay = next(delays)
                     # pause() does the resil_retries_total / resil.retry
                     # bookkeeping; the actual wait must be async.
-                    self._retry.pause(delay, sleep=lambda _s: None)
+                    self._retry.pause(delay, sleep=lambda _s: None,
+                                      parent=bspan)
                     await asyncio.sleep(delay)
                 continue
             self._breaker.record_success()
             return verdicts, SERVED_BY_DEVICE
         if self._fallback is not None:
             group = batch[0].group
-            with _TRACER.span("resil.fallback", group=group,
+            with _TRACER.span("resil.fallback", parent=bspan, group=group,
                               rows=len(batch)):
                 verdicts = await asyncio.get_running_loop().run_in_executor(
                     self._watchdog.executor,
@@ -312,7 +394,10 @@ class VerificationService:
         """
         group = batch[0].group
         t0 = time.perf_counter()
-        with _TRACER.span("serve.dispatch", group=group, rows=len(batch),
+        # explicit parent: contextvars do not cross run_in_executor, and
+        # exactly one batch is in flight, so _batch_span is unambiguous
+        with _TRACER.span("serve.dispatch", parent=self._batch_span,
+                          group=group, rows=len(batch),
                           bucket=self.config.bucket_for(len(batch))):
             if group == KIND_RANGE:
                 proofs = [r.payload[0] for r in batch]
@@ -354,6 +439,10 @@ class VerificationService:
             _METRICS.histogram(
                 "serve_wait_seconds",
                 lane=req.lane).observe(dispatch_t - req.enqueue_t)
+            if req.span is not None:
+                _TRACER.record_span("serve.queue_wait", req.enqueue_t,
+                                    dispatch_t, parent=req.span,
+                                    lane=req.lane)
             self._resolve(req, VerifyResult(
                 status=status, accepted=bool(acc),
                 wait_s=dispatch_t - req.enqueue_t,
@@ -363,12 +452,63 @@ class VerificationService:
     def _complete_expired(self, req: VerifyRequest, now: float) -> None:
         _METRICS.counter("serve_deadline_miss_total",
                          where="queued").add()
+        if req.span is not None:
+            _TRACER.record_span("serve.queue_wait", req.enqueue_t, now,
+                                parent=req.span, lane=req.lane)
         self._resolve(req, VerifyResult(
             status=STATUS_DEADLINE_MISS,
             total_s=now - req.enqueue_t))
 
+    def _finish_request_span(self, req: VerifyRequest,
+                             result: VerifyResult) -> None:
+        sp = req.span
+        if sp is None:
+            return
+        req.span = None
+        sp.set_attribute("status", result.status)
+        if result.served_by:
+            sp.set_attribute("served_by", result.served_by)
+        if result.accepted is not None:
+            sp.add_event("verdict", accepted=bool(result.accepted))
+        _TRACER.end_span(sp)
+
     def _resolve(self, req: VerifyRequest, result: VerifyResult) -> None:
         _METRICS.counter("serve_results_total",
                          status=result.status).add()
+        if self.slo is not None:
+            ok = result.status == STATUS_OK
+            self.slo.record(ok, result.total_s if ok else None)
+        self._finish_request_span(req, result)
         if req.future is not None and not req.future.done():
             req.future.set_result(result)
+
+    # ----------------------------------------------------------- statusz
+    def status(self) -> dict:
+        """JSON-serializable point-in-time snapshot for /statusz."""
+        out = {
+            "running": self._running,
+            "queue_depth": {lane: self.scheduler.lane_depth(lane)
+                            for lane in self.config.lanes},
+            "inflight_rows": len(self._inflight),
+            "prewarm": {
+                "ready": sorted(self.prewarm.ready),
+                "compile_s": {str(b): round(s, 3) for b, s in
+                              sorted(self.prewarm.compile_s.items())},
+                "total_s": round(self.prewarm.total_s, 3),
+            },
+            "config": {
+                "buckets": list(self.config.buckets),
+                "max_wait_s": self.config.max_wait_s,
+                "queue_capacity": self.config.queue_capacity,
+                "default_deadline_s": self.config.default_deadline_s,
+                "trace_every": self.config.trace_every,
+            },
+        }
+        if self._breaker is not None:
+            out["breaker"] = {
+                "state": self._breaker.state,
+                "failure_rate": round(self._breaker.failure_rate, 4),
+            }
+        if self.slo is not None:
+            out["slo"] = self.slo.summary()
+        return out

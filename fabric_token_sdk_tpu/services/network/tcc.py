@@ -23,6 +23,20 @@ from ...obs import TRACER as _TRACER
 from ...token.model import ID
 from .rws import KeyTranslator, MemoryRWSet, Translator, TranslatorError
 
+#: Chaincode family metadata (HELP independent of call-site order).
+_TCC_FAMILIES = {
+    "tcc_requests_total": "Token requests processed by the chaincode",
+    "tcc_request_status_total":
+        "Token-request outcomes, by commit status",
+    "tcc_process_request_seconds":
+        "Full process-request wall: validate + translate + commit",
+    "tcc_validate_seconds": "Token-request validation wall",
+    "tcc_translate_seconds": "Action -> RWSet translation wall",
+    "tcc_commit_seconds": "Ledger commit wall per token request",
+}
+for _fam, _help in _TCC_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
+
 
 class LedgerError(Exception):
     pass

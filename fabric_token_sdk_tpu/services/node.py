@@ -27,6 +27,22 @@ from .tokens import Tokens
 from .ttx import SessionBus, Transaction, TtxError, collect_endorsements, \
     ordering_and_finality
 
+#: Family metadata for the ttx_* lifecycle instruments, hoisted so every
+#: family carries a HELP line regardless of which call site registers it
+#: first (scripts/check_metric_help.py enforces this for stable families).
+_TTX_FAMILIES = {
+    "ttx_executions_total": "ttx lifecycle outcomes per node",
+    "ttx_execute_seconds":
+        "end-to-end ttx latency: endorse -> order -> finality",
+    "ttx_collect_endorsements_seconds":
+        "endorsement collection wall per ttx",
+    "ttx_ordering_finality_seconds":
+        "ordering submission -> finality event wall per ttx",
+    "ttx_commits_total": "finality events observed, by commit status",
+    "ttx_commit_ingest_seconds":
+        "finality listener: vault sync per observed commit",
+}
+
 
 class TokenNode:
     """One party: wallet + stores + ttx views over the shared backends."""
@@ -88,6 +104,8 @@ class TokenNode:
         # this node touches carries a node="<name>" label, and
         # prometheus_text() serves the shared registry per node
         self.metrics = _METRICS.with_labels(node=name)
+        for fam, help_text in _TTX_FAMILIES.items():
+            self.metrics.describe(fam, help_text)
         bus.register(name, self)
         chaincode.ledger.add_finality_listener(self._on_commit)
         # txs this node assembled or endorsed: refresh ttxdb on finality
@@ -141,7 +159,8 @@ class TokenNode:
         self._tms[tmsid] = tms
         return tms
 
-    def verification_frontend(self, config=None, resilience=None):
+    def verification_frontend(self, config=None, resilience=None,
+                              telemetry=None, slo=None):
         """The continuous-batching verification service (serve/) over this
         node's validator ZK backend. One cached instance per node — the
         service owns the device dispatch queue, so every caller must share
@@ -151,7 +170,15 @@ class TokenNode:
         A node frontend always runs resilient: retries with seeded
         jitter, circuit breaker, watchdog, and host fallback under the
         default :class:`ResilienceConfig` unless the caller passes their
-        own (see resilience/)."""
+        own (see resilience/).
+
+        An :class:`SloMonitor` (``slo`` overrides the default policy)
+        tracks rolling availability/p99 over every result, with fast-burn
+        wired to the breaker's kill switch so sustained overload degrades
+        to host fallback. Passing ``telemetry`` (a ``TelemetryConfig``)
+        additionally starts the live HTTP plane — /metrics, /healthz,
+        /readyz, /statusz, /tracez — on a daemon thread; the server
+        handle is ``svc.telemetry`` (``.stop()`` to shut it down)."""
         if getattr(self, "_serve", None) is not None:
             return self._serve
         zk = getattr(getattr(self.cc.validator, "pp", None),
@@ -160,13 +187,23 @@ class TokenNode:
             raise RuntimeError(
                 f"node [{self.name}]: validator has no device ZK backend "
                 "to serve")
+        from ..obs.slo import SloMonitor
         from ..resilience import ResilienceConfig
         from ..serve import VerificationService
 
         if resilience is None:
             resilience = ResilienceConfig()
-        self._serve = VerificationService(zk, config=config,
-                                          resilience=resilience)
+        if slo is None:
+            slo = SloMonitor()
+        svc = VerificationService(zk, config=config,
+                                  resilience=resilience, slo=slo)
+        if svc.breaker is not None:
+            slo.bind_breaker(svc.breaker)
+        svc.telemetry = None
+        if telemetry is not None:
+            from ..obs.telemetry import serve_telemetry
+            svc.telemetry = serve_telemetry(svc, telemetry)
+        self._serve = svc
         return self._serve
 
     def prometheus_text(self) -> str:

@@ -20,6 +20,18 @@ from ..obs import GLOBAL as _METRICS
 from ..resilience import RetryPolicy
 from ..token import quantity as q
 from ..token.model import ID, UnspentToken
+
+#: Selector family metadata (HELP independent of call-site order).
+_SELECTOR_FAMILIES = {
+    "selector_select_seconds": "token selection + locking latency",
+    "selector_retries_total":
+        "Selection retries after an insufficient unlocked balance",
+    "selector_tokens_locked_total": "Tokens locked by successful selections",
+    "selector_insufficient_funds_total":
+        "Selections that exhausted retries without covering the amount",
+}
+for _fam, _help in _SELECTOR_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
 from .db.sqldb import TokenDB, TokenLockDB
 
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Lint: every stable-family instrument registration must carry HELP text.
+
+The obs registry is first-registration-wins for HELP lines, so a bare
+``counter("serve_foo_total", ...)`` call silently ships ``# HELP
+serve_foo_total serve_foo_total`` to every dashboard if it runs before
+the describing call site. This lint scans the source for
+``counter(`` / ``gauge(`` / ``histogram(`` registrations of stable
+families (tests/test_metric_family_guard.py is the inventory) and
+requires each registered family to have a HELP source somewhere:
+
+  - an inline ``help=`` kwarg or positional help string at a
+    registration site,
+  - a ``describe("family", ...)`` call, or
+  - an entry in a hoisted metadata dict (``"family": "help text"`` —
+    the ``_SERVE_FAMILIES`` / ``_TTX_FAMILIES`` pattern).
+
+Runnable standalone (``python scripts/check_metric_help.py`` — exits 1
+with the offender list) and imported by tests/test_metric_help_lint.py
+as a tier-1 test.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _stable_families() -> tuple:
+    spec = importlib.util.spec_from_file_location(
+        "_metric_family_guard",
+        REPO / "tests" / "test_metric_family_guard.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.STABLE_FAMILIES
+
+
+def _source_files() -> list[Path]:
+    files = [REPO / "bench.py"]
+    files.extend(sorted((REPO / "fabric_token_sdk_tpu").rglob("*.py")))
+    return files
+
+
+def _registration_re(fam: str) -> re.Pattern:
+    # counter( / gauge( / histogram( with the family as first argument;
+    # \s crosses newlines, covering black-style wrapped calls
+    return re.compile(
+        r"(?:counter|gauge|histogram)\(\s*['\"]" + re.escape(fam)
+        + r"['\"]")
+
+
+def _help_res(fam: str) -> list[re.Pattern]:
+    q = re.escape(fam)
+    return [
+        # inline: name followed by help= kwarg or a positional string
+        # (optionally parenthesized for multi-line literals)
+        re.compile(r"(?:counter|gauge|histogram)\(\s*['\"]" + q
+                   + r"['\"]\s*,\s*(?:help\s*=\s*)?['\"(]"),
+        # explicit describe("family", ...)
+        re.compile(r"describe\(\s*['\"]" + q + r"['\"]"),
+        # hoisted metadata dict entry: "family": "help" / ("help...
+        re.compile(r"['\"]" + q + r"['\"]\s*:\s*['\"(]"),
+    ]
+
+
+def find_offenders() -> dict[str, list[str]]:
+    """{family: [file:line of each registration]} for every stable
+    family registered via an instrument call but lacking any HELP
+    source."""
+    sources = [(p, p.read_text()) for p in _source_files()]
+    corpus = "\n".join(text for _, text in sources)
+    offenders: dict[str, list[str]] = {}
+    for fam in _stable_families():
+        reg_re = _registration_re(fam)
+        if not reg_re.search(corpus):
+            continue  # never registered via instrument calls (dynamic)
+        if any(rx.search(corpus) for rx in _help_res(fam)):
+            continue
+        sites = []
+        for path, text in sources:
+            for m in reg_re.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                sites.append(f"{path.relative_to(REPO)}:{line}")
+        offenders[fam] = sites
+    return offenders
+
+
+def main() -> int:
+    offenders = find_offenders()
+    if not offenders:
+        print("check_metric_help: every registered stable family has "
+              "HELP text")
+        return 0
+    print("stable metric families registered without HELP text "
+          "(add help=..., describe(), or a metadata-dict entry):")
+    for fam, sites in sorted(offenders.items()):
+        print(f"  {fam}")
+        for site in sites:
+            print(f"    {site}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

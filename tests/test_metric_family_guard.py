@@ -75,6 +75,23 @@ STABLE_FAMILIES = (
     "resil_injected_faults_total",
     "resil_retries_total",
     "resil_watchdog_trips_total",
+    # obs/ live telemetry plane
+    "telemetry_scrape_seconds",
+    "telemetry_scrapes_total",
+    # obs/ SLO burn-rate monitor
+    "slo_availability_ratio",
+    "slo_error_budget_burn_rate",
+    "slo_fast_burn_active",
+    "slo_fast_burn_trips_total",
+    "slo_p99_seconds",
+    "slo_window_requests",
+    # obs/ device profiling
+    "profile_bucket_bytes",
+    "profile_bucket_flops",
+    "profile_compile_cache_total",
+    "profile_compile_seconds",
+    "profile_device_bytes_in_use",
+    "profile_device_peak_bytes",
 )
 
 #: Families whose names are built dynamically: family -> the source
@@ -114,7 +131,8 @@ def test_no_duplicate_family_entries():
 
 @pytest.mark.parametrize("prefix", ["ttx_", "tcc_", "zk_", "sigma_",
                                     "pipeline_", "selector_", "serve_",
-                                    "txgen_", "resil_"])
+                                    "txgen_", "resil_", "telemetry_",
+                                    "slo_", "profile_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

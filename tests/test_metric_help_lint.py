@@ -1,0 +1,42 @@
+"""Tier-1 wrapper around scripts/check_metric_help.py: every stable
+metric family registered anywhere in the tree must carry HELP text
+(inline, via describe(), or through a hoisted family-metadata dict).
+
+The standalone script is the pre-commit entry point; this test makes
+the invariant part of the suite so a new registration site without HELP
+fails CI, not just the linter nobody ran.
+"""
+
+import importlib.util
+import pathlib
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+           / "check_metric_help.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_metric_help",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_stable_family_registration_has_help():
+    mod = _load()
+    offenders = mod.find_offenders()
+    assert not offenders, (
+        "stable families registered without HELP text (add the family to "
+        "the module's hoisted metadata dict + describe() loop, or pass "
+        f"help= at the call site): {offenders}")
+
+
+def test_linter_sees_the_stable_inventory():
+    """Guard the guard: the linter must actually be scanning a non-trivial
+    inventory and file set, or an import/path regression would turn it
+    into a silent no-op."""
+    mod = _load()
+    assert len(mod._stable_families()) > 50
+    files = mod._source_files()
+    assert any(f.name == "bench.py" for f in files)
+    assert sum(1 for f in files if f.suffix == ".py") > 50

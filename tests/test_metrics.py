@@ -255,6 +255,65 @@ def test_histogram_percentiles_from_reservoir():
     assert abs(h.percentile(99) - 0.099) <= 0.002
 
 
+def test_empty_histogram_percentile_and_exposition():
+    """A registered-but-never-observed histogram must neither raise on
+    percentile() nor emit malformed exposition lines (PR: telemetry
+    satellite — /statusz and bench reports read percentiles off live
+    registries that may contain cold instruments)."""
+    p = MetricsProvider()
+    h = p.histogram("cold_seconds")
+    assert h.percentile(50) == 0.0
+    assert h.percentile(99) == 0.0
+    assert h.mean == 0.0
+    text = p.prometheus_text()
+    assert 'cold_seconds_bucket{le="+Inf"} 0' in text
+    assert "cold_seconds_sum 0.0" in text
+    assert "cold_seconds_count 0" in text
+
+
+def test_help_text_escaping_differs_from_label_values():
+    """HELP lines are unquoted: only backslash and line feed get escaped,
+    double quotes pass through verbatim. Label values escape all three."""
+    from fabric_token_sdk_tpu.obs import escape_help_text, escape_label_value
+
+    tricky = 'path "C:\\tmp"\nsecond line'
+    assert escape_help_text(tricky) == 'path "C:\\\\tmp"\\nsecond line'
+    assert escape_label_value(tricky) == \
+        'path \\"C:\\\\tmp\\"\\nsecond line'
+
+    p = MetricsProvider()
+    p.counter("weird_total", help=tricky).add()
+    text = p.prometheus_text()
+    help_line = next(l for l in text.splitlines()
+                     if l.startswith("# HELP weird_total"))
+    assert help_line == \
+        '# HELP weird_total path "C:\\\\tmp"\\nsecond line'
+    assert "\n\n" not in text  # the newline never splits the HELP line
+
+
+def test_nonfinite_sample_values_render_conformantly():
+    """Prometheus exposition spells non-finite values NaN/+Inf/-Inf;
+    Python's repr ("inf", "nan") would poison the whole scrape."""
+    p = MetricsProvider()
+    p.gauge("g_inf").set(float("inf"))
+    p.gauge("g_ninf").set(float("-inf"))
+    p.gauge("g_nan").set(float("nan"))
+    p.counter("c_inf").add(float("inf"))
+    h = p.histogram("h_inf")
+    h.observe(float("inf"))
+    text = p.prometheus_text()
+    assert "g_inf +Inf" in text
+    assert "g_ninf -Inf" in text
+    assert "g_nan NaN" in text
+    assert "c_inf +Inf" in text
+    assert "h_inf_sum +Inf" in text
+    # +Inf observation lands in the overflow bucket, count stays exact
+    assert 'h_inf_bucket{le="+Inf"} 1' in text
+    for token in ("inf", "nan"):
+        assert f" {token}" not in text, \
+            f"raw Python float repr {token!r} leaked into the exposition"
+
+
 def test_bench_snapshot_rolls_up_registry():
     from fabric_token_sdk_tpu.obs import bench_snapshot
     from fabric_token_sdk_tpu.obs.pipeline import (BatchRecord,

@@ -165,3 +165,50 @@ def test_serve_family_stable_names():
     for fam in EXPECTED_SERVE_FAMILIES:
         assert fam in text, f"serve family silent: {fam}"
     assert "# TYPE serve_queue_depth gauge" in text
+
+
+# live telemetry plane families (PR: telemetry) — stable interface; the
+# endpoint behaviour itself is covered crypto-free in tests/test_telemetry.py
+EXPECTED_TELEMETRY_FAMILIES = (
+    "telemetry_scrapes_total",
+    "telemetry_scrape_seconds",
+    "slo_availability_ratio",
+    "slo_p99_seconds",
+    "slo_error_budget_burn_rate",
+    "slo_window_requests",
+    "slo_fast_burn_active",
+    "profile_compile_seconds",
+    "profile_compile_cache_total",
+)
+
+
+def test_live_telemetry_slo_profile_families_export():
+    """One scrape through the real HTTP plane lights every telemetry_*,
+    slo_* and profile_* family a CPU-only run can light."""
+    import urllib.request
+
+    from fabric_token_sdk_tpu.obs import (PROFILER, SloMonitor,
+                                          TelemetryConfig, TelemetryServer)
+
+    GLOBAL.reset()
+    slo = SloMonitor()
+    slo.record(True, latency_s=0.01)
+    slo.record(False)
+    PROFILER.record_compile("smoke", 16, 0.5)
+    PROFILER.record_cache_event("smoke", hit=True)
+    server = TelemetryServer(TelemetryConfig(port=0))
+    url = server.start()
+    try:
+        # two scrapes: telemetry_scrape_seconds observes after rendering,
+        # so only the second body can carry the first scrape's latency
+        for _ in range(2):
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10.0) as resp:
+                text = resp.read().decode()
+    finally:
+        server.stop()
+    for fam in EXPECTED_TELEMETRY_FAMILIES:
+        assert fam in text, f"telemetry family silent: {fam}"
+    assert "# TYPE slo_availability_ratio gauge" in text
+    assert re.search(
+        r'telemetry_scrapes_total\{endpoint="/metrics"\} 2\.0', text)

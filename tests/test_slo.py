@@ -1,0 +1,206 @@
+"""SLO burn-rate monitor and device profiler (obs/slo.py, obs/profiling.py).
+
+Everything runs against a private MetricsProvider and a fake clock —
+no device, no wall-clock sleeps, no global-registry leakage.
+"""
+
+import jax.numpy as jnp
+
+from fabric_token_sdk_tpu.obs import (DeviceProfiler, MetricsProvider,
+                                      SloMonitor, SloPolicy)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _monitor(policy=None, **kw):
+    clock = _Clock()
+    provider = MetricsProvider()
+    mon = SloMonitor(policy=policy or SloPolicy(), provider=provider,
+                     clock=clock, **kw)
+    return mon, clock, provider
+
+
+def _gauge(provider, name, **labels):
+    vals = [v for (n, lbl), v in provider.snapshot().items()
+            if n == name and all((k, str(val)) in
+                                 [(a, str(b)) for a, b in lbl]
+                                 for k, val in labels.items())]
+    assert vals, f"gauge {name}{labels} not published"
+    return vals[0]
+
+
+# ------------------------------------------------------------ SloMonitor
+def test_window_stats_availability_and_p99():
+    mon, clock, provider = _monitor(
+        policy=SloPolicy(windows=(60.0, 300.0), min_volume=8))
+    for i in range(99):
+        mon.record(True, latency_s=(i + 1) / 1000.0)
+        clock.advance(0.1)
+    mon.record(False)
+    assert _gauge(provider, "slo_availability_ratio", window="60s") == 0.99
+    assert _gauge(provider, "slo_window_requests", window="60s") == 100
+    p99 = _gauge(provider, "slo_p99_seconds", window="60s")
+    assert abs(p99 - 0.099) <= 0.002
+    # burn = (1 - 0.99) / (1 - 0.999) = 10x budget
+    burn = _gauge(provider, "slo_error_budget_burn_rate", window="60s")
+    assert abs(burn - 10.0) < 1e-6
+
+
+def test_events_roll_out_of_the_window():
+    mon, clock, provider = _monitor(policy=SloPolicy(windows=(60.0, 300.0)))
+    mon.record(False)
+    clock.advance(100.0)  # failure now outside the 60s window
+    mon.record(True, latency_s=0.01)
+    assert _gauge(provider, "slo_availability_ratio", window="60s") == 1.0
+    # ...but still inside the 300s window
+    assert _gauge(provider, "slo_availability_ratio", window="300s") == 0.5
+    clock.advance(400.0)  # beyond the horizon: pruned entirely
+    mon.record(True, latency_s=0.01)
+    assert _gauge(provider, "slo_window_requests", window="300s") == 1
+
+
+def test_fast_burn_trips_edge_triggered_and_recovers():
+    trips, recoveries = [], []
+    mon, clock, provider = _monitor(
+        policy=SloPolicy(min_volume=10, fast_burn=14.4),
+        on_fast_burn=lambda: trips.append(clock.t),
+        on_recover=lambda: recoveries.append(clock.t))
+    # 100% failures over both windows: burn = 1/0.001 = 1000 >> 14.4
+    for _ in range(20):
+        mon.record(False)
+        clock.advance(0.01)
+    assert mon.fast_burn_active and mon.trips == 1
+    assert len(trips) == 1, "hook must fire once per episode, not per record"
+    assert _gauge(provider, "slo_fast_burn_active") == 1
+
+    # recovery: every failure ages out of both windows
+    clock.advance(400.0)
+    mon.record(True, latency_s=0.01)
+    assert not mon.fast_burn_active
+    assert recoveries and _gauge(provider, "slo_fast_burn_active") == 0
+    counters = {n: v for (n, _), v in provider.snapshot().items()
+                if n == "slo_fast_burn_trips_total"}
+    assert list(counters.values()) == [1.0]
+
+
+def test_min_volume_gates_the_trip():
+    mon, clock, _ = _monitor(policy=SloPolicy(min_volume=32))
+    for _ in range(31):
+        mon.record(False)
+        clock.advance(0.01)
+    assert not mon.fast_burn_active, "a 31-request blip must not page"
+    mon.record(False)
+    assert mon.fast_burn_active
+
+
+def test_bind_breaker_forces_open_on_fast_burn():
+    class _Breaker:
+        state = "closed"
+
+        def force_open(self):
+            self.state = "open"
+
+        def force_close(self):
+            self.state = "closed"
+
+    mon, clock, _ = _monitor(policy=SloPolicy(min_volume=4))
+    breaker = _Breaker()
+    mon.bind_breaker(breaker)
+    for _ in range(8):
+        mon.record(False)
+        clock.advance(0.01)
+    assert breaker.state == "open"
+    clock.advance(400.0)
+    mon.record(True, latency_s=0.01)
+    assert breaker.state == "closed"
+
+
+def test_summary_shape():
+    mon, clock, _ = _monitor()
+    for ok in (True, True, False):
+        mon.record(ok, latency_s=0.02 if ok else None)
+        clock.advance(0.5)
+    doc = mon.summary()
+    assert doc["availability_target"] == 0.999
+    assert set(doc["windows"]) == {"60s", "300s"}
+    w = doc["windows"]["60s"]
+    assert w["requests"] == 3 and 0 < w["availability"] < 1
+    assert w["p99_s"] == 0.02
+
+
+# -------------------------------------------------------- DeviceProfiler
+def test_record_compile_and_cache_events():
+    provider = MetricsProvider()
+    prof = DeviceProfiler(provider=provider)
+    prof.record_compile("serve_prewarm", 256, 12.5)
+    prof.record_cache_event("serve_dispatch", hit=False)
+    prof.record_cache_event("serve_dispatch", hit=True)
+    prof.record_cache_event("serve_dispatch", hit=True)
+    snap = provider.snapshot()
+    hist = [v for (n, lbl), v in snap.items()
+            if n == "profile_compile_seconds"][0]
+    assert hist["count"] == 1 and hist["sum"] == 12.5
+    events = {dict(lbl)["event"]: v for (n, lbl), v in snap.items()
+              if n == "profile_compile_cache_total"}
+    assert events == {"miss": 1.0, "hit": 2.0}
+    assert prof.summary()["compile_seconds"] == {"serve_prewarm:256": 12.5}
+
+
+def test_capture_kernel_cost_lowers_without_compiling():
+    provider = MetricsProvider()
+    prof = DeviceProfiler(provider=provider)
+
+    def fn(x):
+        return (x * 2.0 + 1.0).sum()
+
+    cost = prof.capture_kernel_cost("demo", 16, fn,
+                                    jnp.ones((16,), jnp.float32))
+    assert cost is not None and cost.get("flops", 0) > 0
+    assert _val(provider, "profile_bucket_flops") > 0
+    summ = prof.summary()["bucket_costs"]["demo:16"]
+    assert summ["flops"] == float(cost["flops"])
+
+
+def _val(provider, name):
+    return [v for (n, _), v in provider.snapshot().items() if n == name][0]
+
+
+def test_capture_bucket_cost_duck_types_and_never_raises():
+    provider = MetricsProvider()
+    prof = DeviceProfiler(provider=provider)
+
+    class _NoCost:
+        pass
+
+    class _Raises:
+        def kernel_cost(self, bucket):
+            raise RuntimeError("backend exploded")
+
+    class _ListShaped:
+        def kernel_cost(self, bucket):
+            return [{"flops": 7.0, "bytes accessed": 3.0}]
+
+    assert prof.capture_bucket_cost(_NoCost(), 16) is None
+    assert prof.capture_bucket_cost(_Raises(), 16) is None
+    cost = prof.capture_bucket_cost(_ListShaped(), 16)
+    assert cost == {"flops": 7.0, "bytes accessed": 3.0}
+    assert _val(provider, "profile_bucket_flops") == 7.0
+    assert _val(provider, "profile_bucket_bytes") == 3.0
+
+
+def test_memory_watermark_never_raises_on_cpu():
+    provider = MetricsProvider()
+    prof = DeviceProfiler(provider=provider)
+    out = prof.record_memory_watermark()  # CPU: memory_stats() is None
+    assert isinstance(out, dict)
+    doc = prof.summary()
+    assert "memory" in doc and isinstance(doc["memory"], dict)

@@ -1,0 +1,325 @@
+"""Live telemetry plane: HTTP exposition endpoints + end-to-end trace
+propagation through the serve frontend.
+
+The TelemetryServer binds an ephemeral port per test (config.port=0), so
+tests never collide with each other or a real scrape port. The /metrics
+validator is a pure-Python walk of the exposition grammar — the
+acceptance bar is "a real Prometheus scraper would accept this", checked
+without any non-stdlib dependency.
+"""
+
+import asyncio
+import json
+import re
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from fabric_token_sdk_tpu.obs import (GLOBAL, TRACER, MetricsProvider,
+                                      TelemetryConfig, TelemetryServer,
+                                      serve_telemetry, spans_to_chrome_trace)
+from fabric_token_sdk_tpu.obs.tracing import Tracer
+from fabric_token_sdk_tpu.resilience import FaultInjector, ResilienceConfig
+from fabric_token_sdk_tpu.serve import (STATUS_OK, ServeConfig,
+                                        VerificationService)
+
+
+def _get(url: str) -> tuple[int, str, str]:
+    """(status, content-type, body); 4xx/5xx do not raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers.get("Content-Type", ""), \
+            err.read().decode()
+
+
+# --------------------------------------------------------------- grammar
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram)$")
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'          # metric name
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'  # labels
+    r' (NaN|[+-]Inf|[0-9.e+-]+)$')          # value
+
+
+def validate_prometheus(text: str) -> dict:
+    """Walk every line of an exposition body; raises AssertionError on
+    any grammar violation. Returns {family: type}."""
+    types: dict[str, str] = {}
+    helped: set[str] = set()
+    assert text.endswith("\n"), "exposition must end with a line feed"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            m = _HELP_RE.match(line)
+            assert m, f"malformed HELP line: {line!r}"
+            helped.add(m.group(1))
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed TYPE line: {line!r}"
+            types[m.group(1)] = m.group(2)
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert base in types or m.group(1) in types, \
+            f"sample before its TYPE: {line!r}"
+    assert set(types) == helped, "HELP/TYPE blocks must pair up"
+    return types
+
+
+class _TruthRange:
+    def verify(self, proofs, commitments):
+        return np.asarray([bool(p) for p in proofs], dtype=bool)
+
+
+class _TruthZK:
+    def __init__(self):
+        self._range = _TruthRange()
+
+    def prewarm_shapes(self, batch_sizes=(1,), include_block=True):
+        return {b: 0.0 for b in batch_sizes}
+
+
+def _run_service(svc, n_requests: int = 0, body=None):
+    """Start svc, run `body(svc)` (async callable) or submit n truthy
+    range requests, stop; returns body's/gather's result."""
+
+    async def run():
+        await svc.start()
+        if body is not None:
+            out = await body(svc)
+        else:
+            out = await asyncio.gather(*[
+                svc.submit_range(True, object(), deadline_s=30.0)
+                for _ in range(n_requests)])
+        await svc.stop()
+        return out
+
+    return asyncio.run(run())
+
+
+# ------------------------------------------------------------- endpoints
+def test_metrics_endpoint_serves_valid_prometheus_text():
+    provider = MetricsProvider()
+    provider.counter("demo_total", help="Demo counter",
+                     path='C:\\x "q"\nend').add(3)
+    provider.gauge("demo_gauge", help="Demo gauge").set(float("inf"))
+    provider.histogram("demo_seconds", help="Demo histogram").observe(0.01)
+    server = TelemetryServer(TelemetryConfig(port=0), provider=provider,
+                             tracer=Tracer(provider=provider))
+    url = server.start()
+    try:
+        code, ctype, body = _get(url + "/metrics")
+    finally:
+        server.stop()
+    assert code == 200
+    assert ctype.startswith("text/plain")
+    types = validate_prometheus(body)
+    assert types["demo_total"] == "counter"
+    assert types["demo_seconds"] == "histogram"
+    assert 'le="+Inf"' in body
+    # a scrape observes itself: the response already counts this scrape
+    assert re.search(
+        r'telemetry_scrapes_total\{endpoint="/metrics"\} 1\.0', body)
+
+
+def test_index_and_unknown_path():
+    server = TelemetryServer(TelemetryConfig(port=0),
+                             provider=MetricsProvider())
+    url = server.start()
+    try:
+        code, _, body = _get(url + "/")
+        assert code == 200 and "/metrics" in body and "/tracez" in body
+        code, _, _ = _get(url + "/nope")
+        assert code == 404
+    finally:
+        server.stop()
+
+
+def test_healthz_flips_503_when_breaker_forced_open():
+    svc = VerificationService(
+        _TruthZK(), config=ServeConfig(buckets=(8,), max_wait_s=0.005),
+        resilience=ResilienceConfig(retry_base_s=0.0, retry_cap_s=0.0,
+                                    watchdog_timeout_s=None))
+
+    async def body(svc):
+        server = serve_telemetry(svc, TelemetryConfig(port=0))
+        try:
+            loop = asyncio.get_running_loop()
+            code, _, b = await loop.run_in_executor(
+                None, _get, server.url + "/healthz")
+            assert code == 200 and b == "ok\n"
+            ready_code, _, _ = await loop.run_in_executor(
+                None, _get, server.url + "/readyz")
+            assert ready_code == 200, "prewarmed + running must be ready"
+
+            svc.breaker.force_open()
+            code, ctype, b = await loop.run_in_executor(
+                None, _get, server.url + "/healthz")
+            assert code == 503 and ctype.startswith("application/json")
+            doc = json.loads(b)
+            assert doc["status"] == "unavailable"
+            assert "breaker" in doc["failures"]
+
+            svc.breaker.force_close()
+            code, _, _ = await loop.run_in_executor(
+                None, _get, server.url + "/healthz")
+            assert code == 200
+        finally:
+            server.stop()
+        return True
+
+    assert _run_service(svc, body=body)
+
+
+def test_readyz_fails_before_start_and_prewarm():
+    svc = VerificationService(
+        _TruthZK(), config=ServeConfig(buckets=(8,), max_wait_s=0.005))
+    server = serve_telemetry(svc, TelemetryConfig(port=0))
+    try:
+        code, _, body = _get(server.url + "/readyz")
+        assert code == 503
+        failures = json.loads(body)["failures"]
+        assert "running" in failures and "prewarm" in failures
+    finally:
+        server.stop()
+
+
+def test_statusz_valid_json_under_concurrent_scrapes():
+    svc = VerificationService(
+        _TruthZK(), config=ServeConfig(buckets=(8,), max_wait_s=0.005))
+
+    async def body(svc):
+        server = serve_telemetry(svc, TelemetryConfig(port=0))
+        loop = asyncio.get_running_loop()
+
+        def scrape(path):
+            return _get(server.url + path)
+
+        try:
+            await asyncio.gather(*[
+                svc.submit_range(True, object(), deadline_s=30.0)
+                for _ in range(8)])
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                futs = [loop.run_in_executor(pool, scrape, path)
+                        for _ in range(6)
+                        for path in ("/statusz", "/metrics", "/tracez")]
+                outs = await asyncio.gather(*futs)
+        finally:
+            server.stop()
+        return outs
+
+    outs = _run_service(svc, body=body)
+    assert len(outs) == 18
+    for code, ctype, text in outs:
+        assert code == 200
+        if ctype.startswith("application/json"):
+            json.loads(text)
+    status = next(json.loads(t) for c, ct, t in outs
+                  if ct.startswith("application/json") and '"serve"' in t)
+    assert status["serve"]["running"] is True
+    assert status["serve"]["prewarm"]["ready"] == [8]
+    assert "pipeline" in status and "profile" in status
+    assert status["uptime_s"] >= 0
+
+
+def test_tracez_exports_chrome_trace_json():
+    provider = MetricsProvider()
+    tracer = Tracer(provider=provider)
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            pass
+    server = TelemetryServer(TelemetryConfig(port=0), provider=provider,
+                             tracer=tracer)
+    url = server.start()
+    try:
+        code, ctype, body = _get(url + "/tracez")
+    finally:
+        server.stop()
+    assert code == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"outer", "inner"} <= names
+
+
+# ----------------------------------------------------- trace propagation
+def test_serve_request_trace_is_a_connected_chain():
+    """Acceptance: a sampled request's exported trace shows admission ->
+    queue wait -> batch dispatch (shared span, linked) -> verdict, with
+    retry spans under the same batch span."""
+    GLOBAL.reset()
+    TRACER.clear()
+    inj = FaultInjector(seed=0, schedule={0: "transient"},
+                        sleep=lambda s: None)
+    svc = VerificationService(
+        inj.wrap(_TruthZK()),
+        config=ServeConfig(buckets=(8,), max_wait_s=0.005, trace_every=1),
+        resilience=ResilienceConfig(retry_attempts=3, retry_base_s=0.0,
+                                    retry_cap_s=0.0,
+                                    breaker_min_volume=10_000,
+                                    watchdog_timeout_s=None))
+    results = _run_service(svc, n_requests=4)
+    assert [r.status for r in results] == [STATUS_OK] * 4
+    assert inj.injected["transient"] == 1
+
+    roots = TRACER.root_snapshot()
+    req_roots = [r for r in roots if r.name == "serve.request"]
+    batch_roots = [r for r in roots if r.name == "serve.batch"]
+    assert len(req_roots) == 4 and batch_roots
+
+    batch_ids = {b.span_id: b for b in batch_roots}
+    for req in req_roots:
+        # each request is its own trace, closed with its verdict
+        assert req.parent_id is None and req.duration is not None
+        assert req.attributes["status"] == "ok"
+        assert [e[0] for e in req.events][0] == "admitted"
+        assert "verdict" in [e[0] for e in req.events]
+        # queue wait reconstructed as a child at dispatch time
+        assert [c.name for c in req.children] == ["serve.queue_wait"]
+        # linked (not parented) to the shared batch span, bidirectionally
+        batch_links = [l for l in req.links if l["role"] == "batch"]
+        assert len(batch_links) == 1
+        batch = batch_ids[batch_links[0]["span_id"]]
+        assert req.span_id in {l["span_id"] for l in batch.links
+                               if l["role"] == "member"}
+        assert req.trace_id != batch.trace_id
+
+    # the retried dispatch: retry span and both attempts under ONE batch
+    retried = [b for b in batch_roots
+               if "resil.retry" in {c.name for c in b.children}]
+    assert len(retried) == 1
+    child_names = [c.name for c in retried[0].children]
+    assert child_names.count("serve.dispatch") == 2
+    assert retried[0].attributes["served_by"] == "device"
+
+    # links survive the Chrome-trace export on both sides of the join
+    doc = json.loads(json.dumps(spans_to_chrome_trace(roots)))
+    by_id = {e["args"]["span_id"]: e
+             for e in doc["traceEvents"] if e["ph"] == "X"}
+    exported_req = by_id[req_roots[0].span_id]
+    link = next(l for l in exported_req["args"]["links"]
+                if l["role"] == "batch")
+    assert by_id[link["span_id"]]["name"] == "serve.batch"
+    assert req_roots[0].span_id in {
+        l["span_id"] for l in by_id[link["span_id"]]["args"]["links"]}
+
+
+def test_trace_every_zero_disables_request_spans():
+    GLOBAL.reset()
+    TRACER.clear()
+    svc = VerificationService(
+        _TruthZK(),
+        config=ServeConfig(buckets=(8,), max_wait_s=0.005, trace_every=0))
+    results = _run_service(svc, n_requests=4)
+    assert all(r.ok for r in results)
+    assert not [r for r in TRACER.root_snapshot()
+                if r.name == "serve.request"]
