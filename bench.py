@@ -24,7 +24,16 @@ import time
 
 def _configure_jax_cache() -> None:
     """Persistent compile cache: first compile of the 64-bit kernels is
-    minutes; every subsequent bench run reuses the cached executables."""
+    minutes; every subsequent bench run reuses the cached executables.
+
+    Setting BENCH_COMPILE_CACHE_DIR (e.g. to benchdata/jax_cache) makes
+    BOTH caches survive across container runs: XLA executables land under
+    that directory (utils/jaxcfg.py picks it up as the cache base) and the
+    fixed-base tables are served from uint8 .npz files in benchdata/
+    (FTS_TABLE_CACHE_DIR, keyed by the pp generator digest) instead of
+    being recomputed — the dominant repeat-run warm-up cost."""
+    if os.environ.get("BENCH_COMPILE_CACHE_DIR"):
+        os.environ.setdefault("FTS_TABLE_CACHE_DIR", str(BENCH_DIR))
     from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
 
     configure_jax_cache()

@@ -123,6 +123,15 @@ class ZKVerifier:
             return None
         return self._range.kernel_cost(batch_size)
 
+    def kernel_cost_fused(self, batch_size: int) -> dict | None:
+        """Fused Pallas kernel cost analysis (mixed-affine fb_msm_t +
+        msm_var_fused) at a bucket; None on CPU/XLA backends where the
+        fused path is off. Duck-typed by the device profiler like
+        ``kernel_cost``."""
+        if self._range is None:
+            return None
+        return self._range.kernel_cost_fused(batch_size)
+
     # ------------------------------------------------------------ transfer
     def verify_transfer(self, proof_raw: bytes, inputs: list[G1],
                         outputs: list[G1]) -> None:

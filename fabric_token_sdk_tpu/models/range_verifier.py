@@ -127,6 +127,60 @@ def hex_ascii(a: np.ndarray) -> np.ndarray:
 # compile superlinearly; split, each compiles in seconds and the persistent
 # cache reuses them across runs.
 _tables_kernel = jax.jit(ec.fixed_base_planes)
+# Split pipeline for when BOTH plane flavors are needed (Pallas path) or a
+# flavor is served from the on-disk table cache: one raw table pass feeds
+# byte-plane packing and the affine (madd) tables.
+_raw_tables_kernel = jax.jit(ec.fixed_base_tables)
+_planes_kernel = jax.jit(ec._to_byte_planes)
+_affine_planes_kernel = jax.jit(ec.affine_planes_from_tables)
+
+
+# --------------------------------------------------------------------------
+# fixed-base table cache (opt-in via FTS_TABLE_CACHE_DIR)
+# --------------------------------------------------------------------------
+# Byte planes hold exact uint8 values (0..255) whatever plane_dtype() is,
+# so an .npz of uint8 arrays round-trips bit-identically AND is backend
+# portable (a CPU-written cache warms a TPU run and vice versa). Keyed by
+# the same generator digest as _PARAMS_CACHE: two pp sets differing in any
+# generator can never share a cache file.
+
+def _table_cache_path(bit_length: int, digest: str, flavor: str):
+    base = os.environ.get("FTS_TABLE_CACHE_DIR")
+    if not base or not digest:
+        return None
+    import pathlib
+
+    return (pathlib.Path(base)
+            / f"fbtables_n{bit_length}_{digest}_{flavor}.npz")
+
+
+def _table_cache_load(bit_length: int, digest: str, flavor: str):
+    f = _table_cache_path(bit_length, digest, flavor)
+    if f is None or not f.exists():
+        return None
+    try:
+        with np.load(f) as z:
+            arr = z["planes"]
+    except Exception:
+        return None  # truncated/corrupt cache file: rebuild, don't crash
+    return jnp.asarray(arr).astype(ec.plane_dtype())
+
+
+def _table_cache_save(bit_length: int, digest: str, flavor: str,
+                      planes: jnp.ndarray) -> None:
+    f = _table_cache_path(bit_length, digest, flavor)
+    if f is None or f.exists():
+        return
+    try:
+        f.parent.mkdir(parents=True, exist_ok=True)
+        arr = np.asarray(
+            jax.device_get(planes.astype(jnp.float32))).astype(np.uint8)
+        tmp = f.with_name(f.name + f".tmp{os.getpid()}")
+        np.savez(tmp, planes=arr)
+        # np.savez appends .npz to names without it
+        os.replace(str(tmp) + ".npz", f)
+    except Exception:
+        pass  # cache is best-effort; the build already succeeded
 
 
 def _limbs_to_bytes_dev(aff: jnp.ndarray) -> jnp.ndarray:
@@ -242,16 +296,18 @@ class RangeVerifierParams:
     # left_gen ++ [Q] bytes are pp constants.
     left_gen_bytes: tuple
     q_bytes: bytes
-    # transposed (96, 256)-contraction tables for the fused Pallas kernels
-    # (TPU only; None on CPU). tables_t_all covers every generator in the
-    # `tables` index order; rgp/k are views/gathers of it (pre-built so
-    # per-call jnp.take copies disappear too).
-    tables_t_all: jnp.ndarray | None = None   # (2n+5, 32, 96, 256)
-    tables_t_rgp: jnp.ndarray | None = None   # (n, 32, 96, 256)
-    tables_t_k: jnp.ndarray | None = None     # (n+2, 32, 96, 256)
+    # transposed AFFINE (64, 256)-contraction tables for the fused Pallas
+    # kernels (TPU only; None on CPU): Montgomery affine (x, y) byte
+    # planes feeding the mixed-add (madd) fold — 2/3 the select-matmul
+    # rows and HBM of the projective 96-plane layout. tables_t_all covers
+    # every generator in the `tables` index order; rgp/k are views/gathers
+    # of it (pre-built so per-call jnp.take copies disappear too).
+    tables_t_all: jnp.ndarray | None = None   # (2n+5, 32, 64, 256)
+    tables_t_rgp: jnp.ndarray | None = None   # (n, 32, 64, 256)
+    tables_t_k: jnp.ndarray | None = None     # (n+2, 32, 64, 256)
 
     @classmethod
-    def from_pp(cls, pp) -> "RangeVerifierParams":
+    def from_pp(cls, pp, cache_digest: str = "") -> "RangeVerifierParams":
         rpp = pp.range_proof_params
         n = rpp.bit_length
         s_g = bn254.G1_IDENTITY
@@ -260,14 +316,33 @@ class RangeVerifierParams:
         gen_points = (list(rpp.left_generators) + list(rpp.right_generators)
                       + [rpp.P, rpp.Q] + list(pp.pedersen_generators[1:3])
                       + [s_g])
-        gen_dev = jnp.asarray(limbs.points_to_projective_limbs(gen_points))
-        tables = _tables_kernel(gen_dev)
+        pallas_on = _pallas_enabled()
+        tables = _table_cache_load(n, cache_digest, "proj")
+        aff_planes = (_table_cache_load(n, cache_digest, "affine")
+                      if pallas_on else None)
+        if tables is None or (pallas_on and aff_planes is None):
+            gen_dev = jnp.asarray(
+                limbs.points_to_projective_limbs(gen_points))
+            if pallas_on:
+                # one raw table pass feeds both plane flavors
+                raw = _raw_tables_kernel(gen_dev)
+                if tables is None:
+                    tables = _planes_kernel(raw)
+                    _table_cache_save(n, cache_digest, "proj", tables)
+                if aff_planes is None:
+                    aff_planes = _affine_planes_kernel(raw)
+                    _table_cache_save(n, cache_digest, "affine", aff_planes)
+                del raw
+            else:
+                # CPU/XLA path: raw tables never materialize (fused in-jit)
+                tables = _tables_kernel(gen_dev)
+                _table_cache_save(n, cache_digest, "proj", tables)
         k_idx = list(range(n, 2 * n)) + [2 * n, 2 * n + 4]  # H_i ++ [P, S_G]
         tables_t_all = tables_t_rgp = tables_t_k = None
-        if _pallas_enabled():
+        if pallas_on:
             from ..ops import pallas_fb
 
-            tables_t_all = jax.jit(pallas_fb.transpose_planes)(tables)
+            tables_t_all = jax.jit(pallas_fb.transpose_planes)(aff_planes)
             tables_t_rgp = tables_t_all[n:2 * n]
             # H_i ++ P (contiguous n..2n) ++ S_G
             tables_t_k = jnp.concatenate(
@@ -301,7 +376,8 @@ _PARAMS_CACHE: dict = {}
 
 def _params_for(pp) -> RangeVerifierParams:
     """Key on a digest of EVERY generator baked into the tables — two pp
-    sets differing in any generator must never share cached tables."""
+    sets differing in any generator must never share cached tables. The
+    same digest keys the on-disk table cache (FTS_TABLE_CACHE_DIR)."""
     import hashlib
 
     rpp = pp.range_proof_params
@@ -312,7 +388,8 @@ def _params_for(pp) -> RangeVerifierParams:
         h.update(ser.g1_to_bytes(p))
     key = (rpp.bit_length, h.digest())
     if key not in _PARAMS_CACHE:
-        _PARAMS_CACHE[key] = RangeVerifierParams.from_pp(pp)
+        _PARAMS_CACHE[key] = RangeVerifierParams.from_pp(
+            pp, cache_digest=h.hexdigest()[:16])
     return _PARAMS_CACHE[key]
 
 
@@ -1064,6 +1141,47 @@ class BatchRangeVerifier:
                     "flops": cost.get("flops"),
                     "bytes_accessed": cost.get(
                         "bytes_accessed", cost.get("bytes accessed"))}
+        except Exception:
+            return None
+
+    def kernel_cost_fused(self, batch_size: int) -> dict | None:
+        """Cost analysis of the fused Pallas kernels (mixed-affine
+        fixed-base MSM ``fb_msm_t`` and the variable-base ``msm_var_fused``)
+        at the padded chunk bucket covering ``batch_size``.
+
+        Same lower-only discipline as ``kernel_cost``; each kernel's
+        estimate is published on the stable ``profile_bucket_*`` families
+        under its own ``kind`` label (obs/profiling.py). Returns
+        ``{kind: cost_dict}`` for whichever kernels lowered, or None when
+        the fused path is off (CPU/XLA backends)."""
+        params = self.params
+        if params.tables_t_k is None:
+            return None
+        try:
+            from ..obs.profiling import PROFILER
+            from ..ops import pallas_fb
+
+            rows = _bucket_rows(min(int(batch_size), _CHUNK_ROWS))
+            tk = jax.ShapeDtypeStruct(params.tables_t_k.shape,
+                                      params.tables_t_k.dtype)
+            sc_k = jax.ShapeDtypeStruct(
+                (rows, params.tables_t_k.shape[0], limbs.NLIMBS),
+                jnp.uint32)
+            nv = 2 + 2 * params.rounds + 3
+            vp = jax.ShapeDtypeStruct((rows * nv, 3, limbs.NLIMBS),
+                                      jnp.uint32)
+            vs = jax.ShapeDtypeStruct((rows * nv, limbs.NLIMBS),
+                                      jnp.uint32)
+            out = {}
+            c = PROFILER.capture_kernel_cost(
+                "fb_msm_t", rows, pallas_fb.fixed_base_msm_fused, tk, sc_k)
+            if c is not None:
+                out["fb_msm_t"] = c
+            c = PROFILER.capture_kernel_cost(
+                "msm_var_fused", rows, pallas_fb.msm_var_fused, vp, vs)
+            if c is not None:
+                out["msm_var_fused"] = c
+            return out or None
         except Exception:
             return None
 

@@ -17,6 +17,10 @@ Exported families (stable names, see ROADMAP):
   profile_device_bytes_in_use{device}      allocator watermark (live)
   profile_device_peak_bytes{device}        allocator watermark (peak)
   profile_compile_cache_total{kind,event}  hit/miss at dispatch
+
+The fused Pallas kernels (mixed-affine ``fb_msm_t``, ``msm_var_fused``)
+report on the same families under their own ``kind`` label values —
+never as new families (the exposition names are a stable contract).
 """
 
 from __future__ import annotations
@@ -120,6 +124,22 @@ class DeviceProfiler:
             return None
         self.set_bucket_cost(kind, bucket, cost)
         return cost
+
+    def capture_fused_costs(self, zk, bucket: int) -> dict | None:
+        """Capture the fused Pallas kernel estimates at a bucket, when the
+        verifier runs the mixed-affine Pallas path (duck-typed
+        ``kernel_cost_fused``). Each kernel publishes on the SAME stable
+        ``profile_bucket_*`` families as the XLA path, under its own kind
+        label (``kind="fb_msm_t"`` / ``kind="msm_var_fused"``) — new label
+        values, not new families. None on CPU/XLA backends or shims
+        without the hook."""
+        fn = getattr(zk, "kernel_cost_fused", None)
+        if not callable(fn):
+            return None
+        try:
+            return fn(bucket)
+        except Exception:
+            return None
 
     def capture_kernel_cost(self, kind: str, bucket: int, fn, *args,
                             **kwargs) -> dict | None:

@@ -58,21 +58,29 @@ def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     the traced graph shrinks ~3x — which is what keeps the 256-step
     scalar/MSM loop bodies fast to compile — and the wider batches fill
     VPU lanes better at small batch sizes.
+
+    Canonical limbs in/out, but the interior runs in lazy-carry form
+    (field.add_lazy / sub_lazy, rules R1-R4 in ops/tfield.py): the
+    a1-side sums and t3/t4/y3 skip the carry lookahead + conditional
+    subtract and enter the next mont_mul as its single lazy operand.
     """
     X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
     X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
     addf = lambda a, b: field.add(a, b, FP)
     subf = lambda a, b: field.sub(a, b, FP)
+    subl = lambda a, b: field.sub_lazy(a, b, FP)
 
-    # round 1: t0=X1X2, t1=Y1Y2, t2=Z1Z2 and the three cross sums.
-    a1 = jnp.stack([X1, Y1, Z1, addf(X1, Y1), addf(Y1, Z1), addf(X1, Z1)])
+    # round 1: t0=X1X2, t1=Y1Y2, t2=Z1Z2 and the three cross sums (a1
+    # side lazy, b1 side exact: no lane sees two lazy mont operands).
+    a1 = jnp.stack([X1, Y1, Z1, field.add_lazy(X1, Y1),
+                    field.add_lazy(Y1, Z1), field.add_lazy(X1, Z1)])
     b1 = jnp.stack([X2, Y2, Z2, addf(X2, Y2), addf(Y2, Z2), addf(X2, Z2)])
     m = field.mont_mul(a1, b1, FP)
     t0, t1, t2 = m[0], m[1], m[2]
-    t3 = subf(m[3], addf(t0, t1))        # X1Y2 + X2Y1
-    t4 = subf(m[4], addf(t1, t2))        # Y1Z2 + Y2Z1
-    y3 = subf(m[5], addf(t0, t2))        # X1Z2 + X2Z1
-    t0 = addf(addf(t0, t0), t0)          # 3*X1X2
+    t3 = subl(subl(m[3], t0), t1)        # X1Y2 + X2Y1      (lazy, < 5p)
+    t4 = subl(subl(m[4], t1), t2)        # Y1Z2 + Y2Z1      (lazy, < 5p)
+    y3 = subl(subl(m[5], t0), t2)        # X1Z2 + X2Z1      (lazy, < 5p)
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2 (exact: meets lazy t3)
 
     # round 2: the two b3 scalings.
     s = field.mont_mul(jnp.stack([t2, y3]),
@@ -99,6 +107,69 @@ def double(p: jnp.ndarray) -> jnp.ndarray:
 def neg(p: jnp.ndarray) -> jnp.ndarray:
     Y = field.neg(p[..., _Y, :], FP)
     return p.at[..., _Y, :].set(Y)
+
+
+def madd(p: jnp.ndarray, q_aff: jnp.ndarray) -> jnp.ndarray:
+    """Mixed addition p + (x2 : y2 : 1) — RCB15 Algorithm 8 (a=0, b3=9).
+
+    13 field muls (5 + 2 + 6) instead of `add`'s 14, with a lazy-carry
+    interior that keeps the accumulator's Y/Z in lazy form ACROSS fold
+    iterations (XLA-layout mirror of tec.madd; the invariant and rules
+    live there). p: (..., 3, 16) with X canonical and Y/Z lazy-tolerant
+    (limbs <= 2^16, value < 2p); q_aff: (..., 2, 16) canonical Montgomery
+    affine. Complete for every p including identity and p == +-Q, but NOT
+    for q at infinity — mask digit 0 via `madd_masked`. Finish chains
+    with `normalize_point`.
+    """
+    X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    xq, yq = q_aff[..., 0, :], q_aff[..., 1, :]
+    addf = lambda a, b: field.add(a, b, FP)
+    subf = lambda a, b: field.sub(a, b, FP)
+    subl = lambda a, b: field.sub_lazy(a, b, FP)
+
+    # round 1 (5 muls): Z2 = 1 makes t2 = Z1 free and collapses the
+    # cross terms to t4 = Y2*Z1 + Y1, y3 = X2*Z1 + X1.
+    s1 = field.add_lazy(X1, Y1)          # lazy < 3p (X canonical)
+    s2 = addf(xq, yq)
+    a1 = jnp.stack([X1, Y1, s1, Z1, Z1])
+    b1 = jnp.stack([xq, yq, s2, yq, xq])
+    m = field.mont_mul(a1, b1, FP)
+    t0, t1 = m[0], m[1]                  # X1xq, Y1yq (canonical)
+    t3 = subl(subl(m[2], t0), t1)        # X1Y2 + X2Y1      (lazy, < 5p)
+    t4 = field.add_lazy(m[3], Y1)        # Y2Z1 + Y1        (lazy, < 3p)
+    y3 = field.add_lazy(m[4], X1)        # X2Z1 + X1        (lazy, < 2p)
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2 (exact: meets lazy t3)
+
+    # round 2 (2 muls): b3 scalings of t2 = Z1 (lazy) and y3 (lazy).
+    s = field.mont_mul(jnp.stack([Z1, y3]),
+                       jnp.broadcast_to(_b3(), t1.shape), FP)
+    t2, y3 = s[0], s[1]
+    z3 = addf(t1, t2)                    # exact: z3 meets lazy t4
+    t1 = subf(t1, t2)                    # exact: t1 meets lazy t3
+
+    # round 3 (6 muls): each lane lazy x canonical.
+    a3 = jnp.stack([t4, t3, y3, t1, t0, z3])
+    b3v = jnp.stack([y3, t1, t0, z3, t3, t4])
+    o = field.mont_mul(a3, b3v, FP)
+    x3 = subf(o[1], o[0])                # canonical
+    y3o = field.add_lazy(o[3], o[2])     # lazy < 2p
+    z3o = field.add_lazy(o[5], o[4])     # lazy < 2p
+    return jnp.stack([x3, y3o, z3o], axis=-2)
+
+
+def madd_masked(p: jnp.ndarray, q_aff: jnp.ndarray,
+                q_inf: jnp.ndarray) -> jnp.ndarray:
+    """madd with the identity-table-entry mask: where q_inf (the digit-0
+    lanes, whose affine entry (0, 0) is not a curve point) keep p."""
+    return jnp.where(q_inf[..., None, None], p, madd(p, q_aff))
+
+
+def normalize_point(p: jnp.ndarray) -> jnp.ndarray:
+    """Resolve a madd-chain accumulator to fully canonical limbs (X is
+    already canonical under the madd invariant; Y/Z are lazy < 2p)."""
+    X, Y, Z = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
+    return jnp.stack(
+        [X, field.normalize(Y, FP), field.normalize(Z, FP)], axis=-2)
 
 
 def scale(p: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
@@ -326,23 +397,25 @@ def plane_dtype() -> jnp.dtype:
 
 
 def _to_byte_planes(tables: jnp.ndarray) -> jnp.ndarray:
-    """(..., 3, 16) uint32 limb tables -> (..., 96) byte planes.
+    """(..., C, 16) uint32 limb tables -> (..., 2*C*16) byte planes.
 
+    C = 3 for projective tables (96 planes), C = 2 for affine (64).
     Each 16-bit limb splits into (lo, hi) bytes; dtype per plane_dtype()
     (bf16 on TPU for MXU exactness, f32 on CPU for dispatchability)."""
-    flat = tables.reshape(*tables.shape[:-2], 3 * L.NLIMBS)
+    flat = tables.reshape(*tables.shape[:-2],
+                          tables.shape[-2] * tables.shape[-1])
     dt = plane_dtype()
     lo = (flat & 0xFF).astype(dt)
     hi = ((flat >> 8) & 0xFF).astype(dt)
     return jnp.concatenate([lo, hi], axis=-1)
 
 
-def _from_byte_planes(sel: jnp.ndarray) -> jnp.ndarray:
-    """(..., 96) f32 selected planes -> (..., 3, 16) uint32 limbs."""
+def _from_byte_planes(sel: jnp.ndarray, ncoords: int = 3) -> jnp.ndarray:
+    """(..., 2*ncoords*16) f32 selected planes -> (..., ncoords, 16)."""
     u = sel.astype(jnp.uint32)
-    c = 3 * L.NLIMBS
+    c = ncoords * L.NLIMBS
     out = u[..., :c] + (u[..., c:] << 8)
-    return out.reshape(*out.shape[:-1], 3, L.NLIMBS)
+    return out.reshape(*out.shape[:-1], ncoords, L.NLIMBS)
 
 
 def _select_onehot(tables_planes: jnp.ndarray, digits: jnp.ndarray,
@@ -455,11 +528,15 @@ def fixed_base_msm(table_planes: jnp.ndarray,
     return _tree_sum_shrink(flat)
 
 
-def to_affine_batch(p: jnp.ndarray) -> jnp.ndarray:
-    """Projective -> canonical affine over a trailing point axis, using one
-    Fermat inversion per row via the Montgomery batch-inversion trick.
+def to_affine_batch(p: jnp.ndarray, keep_mont: bool = False) -> jnp.ndarray:
+    """Projective -> affine over a trailing point axis, using one Fermat
+    inversion per row via the Montgomery batch-inversion trick.
 
     p: (..., K, 3, 16) -> (..., K, 2, 16). Identity maps to (0, 0).
+    keep_mont=True returns the coordinates still in MONTGOMERY form (what
+    the mixed-addition table path consumes — madd multiplies them straight
+    into Montgomery accumulators); default False converts out of
+    Montgomery for host-facing serialization.
     """
     X, Y, Z = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
     inf = is_identity(p)                           # (..., K)
@@ -486,11 +563,81 @@ def to_affine_batch(p: jnp.ndarray) -> jnp.ndarray:
         field.mont_mul(prefix_shift, suffix_shift, FP),
         jnp.broadcast_to(total_inv[..., None, :], z_safe.shape), FP)
 
-    xa = field.from_mont(field.mont_mul(X, zinv, FP), FP)
-    ya = field.from_mont(field.mont_mul(Y, zinv, FP), FP)
+    xa = field.mont_mul(X, zinv, FP)
+    ya = field.mont_mul(Y, zinv, FP)
+    if not keep_mont:
+        xa = field.from_mont(xa, FP)
+        ya = field.from_mont(ya, FP)
     xa = jnp.where(inf[..., None], jnp.zeros_like(xa), xa)
     ya = jnp.where(inf[..., None], jnp.zeros_like(ya), ya)
     return jnp.stack([xa, ya], axis=-2)
+
+
+def fixed_base_affine_planes(points: jnp.ndarray) -> jnp.ndarray:
+    """Affine byte-plane form of the 8-bit fixed-base tables.
+
+    points: (T, 3, 16) -> (T, 32, 256, 64) in plane_dtype(): every table
+    entry batch-normalized to MONTGOMERY affine (one Fermat inversion per
+    term row via to_affine_batch) and split into 64 byte planes — 2/3 the
+    select matmul rows and HBM of the 96-plane projective tables, and the
+    entries feed `madd` (13 muls) instead of the complete `add` (14).
+    Digit-0 entries land on (0, 0) (identity -> (0, 0)); the fold masks
+    them (madd is not complete for Q at infinity)."""
+    return affine_planes_from_tables(fixed_base_tables(points))
+
+
+def affine_planes_from_tables(proj: jnp.ndarray) -> jnp.ndarray:
+    """(T, 32, 256, 3, 16) raw projective tables -> (T, 32, 256, 64)
+    affine byte planes. Split from fixed_base_affine_planes so callers
+    holding the raw tables (verifier param build derives BOTH the
+    projective and affine plane flavors from one table pass) skip the
+    second fixed_base_tables evaluation."""
+    flat = proj.reshape(-1, 256, 3, L.NLIMBS)      # rows: (T*32, 256)
+    aff = to_affine_batch(flat, keep_mont=True)    # (T*32, 256, 2, 16)
+    aff = aff.reshape(*proj.shape[:-2], 2, L.NLIMBS)
+    return _to_byte_planes(aff)
+
+
+def fixed_base_gather_mixed(affine_planes: jnp.ndarray,
+                            scalars: jnp.ndarray) -> jnp.ndarray:
+    """Per-term fixed-base scalar mul over AFFINE tables via madd.
+
+    affine_planes: (T, 32, 256, 64) (fixed_base_affine_planes);
+    scalars: (..., T, 16) plain limbs. Returns (..., T, 3, 16) =
+    scalars[t] * P_t, canonical (normalized at the end of the chain).
+
+    The 32 windows fold SEQUENTIALLY — madd needs an affine second
+    operand, so there is no tree over window partial sums — at 13 muls
+    per window (vs 14 x 31 adds for the projective tree) with all carry
+    resolution deferred to one normalize_point per chain.
+    """
+    digits = window_digits8(scalars)               # (..., T, 32)
+    onehot = jax.nn.one_hot(digits.astype(jnp.int32), 256,
+                            dtype=plane_dtype())   # (..., T, 32, 256)
+    sel = jnp.einsum("...twv,twvc->...twc", onehot, affine_planes,
+                     preferred_element_type=jnp.float32)
+    aff = _from_byte_planes(sel, ncoords=2)        # (..., T, 32, 2, 16)
+    inf = (digits == 0)                            # (..., T, 32)
+    batch_t = scalars.shape[:-1]                   # (..., T)
+
+    def body(w, acc):
+        q = jax.lax.dynamic_slice_in_dim(aff, w, 1, axis=-3)[..., 0, :, :]
+        m = jax.lax.dynamic_slice_in_dim(inf, w, 1, axis=-1)[..., 0]
+        return madd_masked(acc, q, m)
+
+    acc = jax.lax.fori_loop(0, _W8_WINDOWS, body, identity(batch_t))
+    return normalize_point(acc)
+
+
+def fixed_base_msm_mixed(affine_planes: jnp.ndarray,
+                         scalars: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-base MSM over affine tables: sum_t scalars[t] * P_t.
+
+    affine_planes: (T, 32, 256, 64); scalars: (..., T, 16) -> (..., 3, 16).
+    Per-term madd chains (fixed_base_gather_mixed), then a projective
+    tree over the term axis (the partial sums are projective, so the
+    cross-term fold keeps the complete add)."""
+    return _tree_sum_shrink(fixed_base_gather_mixed(affine_planes, scalars))
 
 
 def to_affine(p: jnp.ndarray) -> jnp.ndarray:

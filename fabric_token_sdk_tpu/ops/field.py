@@ -305,6 +305,66 @@ def sub(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     return jnp.where(need_fix, fixed, diff)
 
 
+# --------------------------------------------------------------------------
+# lazy-carry arithmetic — the (..., 16) limb-minor mirror of the
+# ops/tfield.py lazy layer (rules R1-R4 documented there; ops/tfield.py
+# also hosts the LimbBound schedule tracker). Limbs may sit <= 2^16
+# between ops and the value < 5*mod; chains end at `normalize` or flow
+# through mont_mul, which canonicalizes.
+# --------------------------------------------------------------------------
+
+#: see tfield.LAZY_LIMB_MAX — the stable inter-op limb bound.
+LAZY_LIMB_MAX = 1 << BITS
+
+
+def lazy_limbs(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Lazy column sums -> LAZY limbs: ONE ripple pass, no lookahead."""
+    k = t.shape[-1]
+    if k < out_limbs:
+        t = jnp.concatenate(
+            [t, jnp.zeros(t.shape[:-1] + (out_limbs - k,), dtype=t.dtype)],
+            axis=-1)
+    else:
+        t = t[..., :out_limbs]
+    return (t & MASK) + _shift_right_one(t >> BITS)
+
+
+def add_lazy(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b in lazy form (one ripple, no lookahead / mod subtract).
+
+    At most one operand lazy (limbs <= 2^16), sum value < 2^256;
+    output limbs <= 2^16, value exact (nothing reduced)."""
+    t = a + b
+    return (t & MASK) + _shift_right_one(t >> BITS)
+
+
+_SUB2P_ARRS: dict = {}
+
+
+def _sub2p_arr(spec: FieldSpec) -> jnp.ndarray:
+    """Pre-borrowed 2*mod limbs (see tfield._sub2p_limbs) for sub_lazy."""
+    if spec.name not in _SUB2P_ARRS:
+        from . import tfield
+
+        _SUB2P_ARRS[spec.name] = tfield._sub2p_limbs(spec.mod_int)
+    return jnp.asarray(_SUB2P_ARRS[spec.name])
+
+
+def sub_lazy(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """a + 2*mod - b in lazy form: two ripple passes, no borrow chain.
+
+    `a` may be lazy (limbs <= 2^16); `b` MUST be canonical (< mod) so
+    the pre-borrowed 2p limbs majorize it per-limb (no underflow).
+    Output limbs <= 2^16, value = a + 2*mod - b."""
+    t = a + _sub2p_arr(spec) - b
+    return lazy_limbs(lazy_limbs(t, N), N)
+
+
+def normalize(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Lazy form (limbs <= 2^16, value < 2*mod) -> canonical (< mod)."""
+    return _cond_sub_mod(_carry_propagate(a, N + 1), spec)
+
+
 def neg(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Modular negation: mod - a, with -0 = 0."""
     diff, _ = _sub_limbs(jnp.broadcast_to(spec.mod_arr, a.shape), a)
@@ -325,6 +385,11 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
       m  = (T mod 2^256) * N' mod 2^256
       S  = (T + m*mod) >> 256      (exact division; low half cancels)
     Output canonical (< mod): standard bound (p^2 + 2^256 p)/2^256 < 2p.
+
+    Lazy-carry contract (R3, see ops/tfield.py): at most ONE operand may
+    be lazy (limbs <= LAZY_LIMB_MAX) with value < 5*mod; then
+    S < (5p^2 + 2^256 p)/2^256 < 2p for BN254 and the single conditional
+    subtract still canonicalizes. Output is always canonical.
     """
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)

@@ -5,11 +5,14 @@ The XLA path (ec.fixed_base_gather / fixed_base_msm) materializes a
 ~4.5 GB at B=2048, and every field op in the 31-add window fold round-trips
 HBM (the round-3 roofline's measured wall: the batch verify is
 bandwidth-bound on unfused VPU ops, not compute-bound). This kernel keeps
-the whole select+fold in VMEM: per grid step it loads one term's byte-plane
-table block (1.6 MB), builds the one-hot per window on the fly (a (256, bB)
-iota compare), selects via one MXU matmul, and folds the 32 windows into an
-accumulator with the transposed complete-add chain (ops/tec.py). HBM
-traffic drops to tables + digits in, folded points out.
+the whole select+fold in VMEM: per grid step it loads one term's AFFINE
+byte-plane table block (~1 MB, 64 planes — 2/3 the projective tables'
+HBM), builds the one-hot per window on the fly (a (256, bB) iota
+compare), selects via one MXU matmul, and folds the 32 windows into an
+accumulator with the transposed MIXED-addition chain (tec.madd, 13 muls
+vs 14, digit-0 masked) whose Y/Z ride in lazy-carry form until one
+normalize_point per fold. HBM traffic drops to tables + digits in,
+folded points out.
 
 Replaces the sequential per-proof table walk of the reference
 (token/core/zkatdlog/nogh/v1/crypto/rp/bulletproof.go:252-333 and
@@ -41,20 +44,29 @@ def _plane_dtype():
 
 
 def _fb_fold_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
-                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+                    wnp_ref, wmod_ref, sub2p_ref, b3_ref, out_ref, *,
+                    windows: int):
     """One (term, lane-block) grid step: fold `windows` table selections.
 
-    planes_ref: (1, windows, 96, 256) plane-dtype — one term's tables,
-        transposed so the select contraction is (96, 256) x (256, bB).
+    planes_ref: (1, windows, 64, 256) plane-dtype — one term's AFFINE
+        tables (ec.fixed_base_affine_planes, transposed): 2/3 the select
+        matmul rows and HBM of the old 96-row projective planes.
     digits_ref: (1, windows, bB) int32 — 8-bit window digits.
     out_ref:    (1, 48, bB) uint32 — sum_w table[w][digit_w], transposed
-        projective Montgomery.
+        projective Montgomery, canonical limbs.
     Remaining refs carry the field/curve constants (tfield.TSpec layout).
+
+    The fold is a MIXED-addition chain (tec.madd, 13 muls vs tec.add's
+    14) whose accumulator Y/Z stay in lazy-carry form across all
+    `windows` iterations — one tec.normalize_point at the end resolves
+    the deferred carries. Digit-0 lanes (affine entry (0,0), not a curve
+    point) are masked to keep the accumulator unchanged, which restores
+    completeness on the table path.
     """
     cc = tec.CurveConsts(
         ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
                     r1=r1_ref[...], w_nprime=wnp_ref[...],
-                    w_mod=wmod_ref[...], mod_int=0),
+                    w_mod=wmod_ref[...], mod_int=0, sub2p=sub2p_ref[...]),
         b3=b3_ref[...])
     bB = digits_ref.shape[-1]
     dt = planes_ref.dtype
@@ -65,13 +77,16 @@ def _fb_fold_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         onehot = (iota == d[None, :]).astype(jnp.int32).astype(dt)
         sel = jax.lax.dot_general(
             planes_ref[0, w], onehot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # (96, bB) f32
+            preferred_element_type=jnp.float32)           # (64, bB) f32
         u = sel.astype(jnp.int32).astype(jnp.uint32)
-        pt = u[0:48, :] + (u[48:96, :] << 8)              # (48, bB) limbs
-        return tec.add(acc, pt, cc)
+        xq = u[0:16, :] + (u[32:48, :] << 8)              # (16, bB) limbs
+        yq = u[16:32, :] + (u[48:64, :] << 8)
+        keep = (d[None, :] == 0)                          # (1, bB)
+        return jnp.where(keep, acc, tec.madd(acc, xq, yq, cc))
 
-    out_ref[0] = jax.lax.fori_loop(
+    folded = jax.lax.fori_loop(
         0, windows, body, tec.identity(bB, cc), unroll=False)
+    out_ref[0] = tec.normalize_point(folded, cc)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "lane_block"))
@@ -80,9 +95,10 @@ def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
               lane_block: int = LANE_BLOCK) -> jnp.ndarray:
     """Fused fixed-base fold, transposed interface.
 
-    planes_t: (T, W, 96, 256) plane-dtype byte-plane tables (transposed);
+    planes_t: (T, W, 64, 256) plane-dtype AFFINE byte-plane tables
+        (ec.fixed_base_affine_planes through transpose_planes);
     digits_t: (T, W, B) int32 with B a multiple of `lane_block` (pad digit
-        0 -> identity entry -> identity point for dead lanes).
+        0 -> masked madd -> identity point for dead lanes).
     Returns (T, 48, B) uint32: per-(term, lane) folded points.
     """
     from jax.experimental import pallas as pl
@@ -92,7 +108,7 @@ def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
     assert B % lane_block == 0, (B, lane_block)
     cc = tec.make_consts()
     consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
-              cc.ts.w_mod, cc.b3)
+              cc.ts.w_mod, cc.ts.sub2p, cc.b3)
     const_specs = [
         pl.BlockSpec(c.shape, lambda t, b, *, _nd=c.ndim: (0,) * _nd)
         for c in consts
@@ -102,7 +118,7 @@ def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
         kernel,
         grid=(T, B // lane_block),
         in_specs=[
-            pl.BlockSpec((1, W, 96, 256), lambda t, b: (t, 0, 0, 0)),
+            pl.BlockSpec((1, W, 64, 256), lambda t, b: (t, 0, 0, 0)),
             pl.BlockSpec((1, W, lane_block), lambda t, b: (t, 0, b)),
             *const_specs,
         ],
@@ -117,7 +133,9 @@ def fb_fold_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
 # --------------------------------------------------------------------------
 
 def transpose_planes(table_planes: jnp.ndarray) -> jnp.ndarray:
-    """(T, W, 256, 96) ec.fixed_base_planes layout -> (T, W, 96, 256)."""
+    """(T, W, 256, C) ec.fixed_base_[affine_]planes layout ->
+    (T, W, C, 256) — C = 64 affine (the kernels' table form) or 96
+    projective."""
     return jnp.transpose(table_planes, (0, 1, 3, 2))
 
 
@@ -161,7 +179,7 @@ def fixed_base_gather_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
                             interpret: bool = False) -> jnp.ndarray:
     """Per-term fixed-base scalar mul (ec.fixed_base_gather semantics).
 
-    planes_t: (T, 32, 96, 256) transposed planes; scalars: (B, T, 16).
+    planes_t: (T, 32, 64, 256) transposed affine planes; scalars: (B, T, 16).
     Returns (B, T, 3, 16) = scalars[b, t] * P_t. Jitted end-to-end so the
     digit prep / transposes / tree folds around the pallas_call never run
     eagerly (each eager op is a separate dispatch through the TPU tunnel).
@@ -173,21 +191,25 @@ def fixed_base_gather_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
 
 
 def _fb_msm_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
-                   wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int):
+                   wnp_ref, wmod_ref, sub2p_ref, b3_ref, out_ref, *,
+                   windows: int):
     """One (lane-block, term) grid step of the ACCUMULATED fixed-base MSM.
 
-    Same per-term select+fold as _fb_fold_kernel, but the grid's term axis
-    is innermost and every term accumulates into the SAME output block —
+    Same per-term madd select+fold as _fb_fold_kernel (affine tables,
+    lazy-carry accumulator, digit-0 mask), but the grid's term axis is
+    innermost and every term accumulates into the SAME output block —
     out_ref stays VMEM-resident across the consecutive revisits (Mosaic
     reduction pattern), so the T-axis fold never materializes a
-    (B, T, 3, 16) intermediate nor runs XLA-layout point adds.
+    (B, T, 3, 16) intermediate nor runs XLA-layout point adds. The
+    per-term fold is normalized before the cross-term complete add, so
+    out_ref always holds canonical limbs.
     """
     from jax.experimental import pallas as pl
 
     cc = tec.CurveConsts(
         ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
                     r1=r1_ref[...], w_nprime=wnp_ref[...],
-                    w_mod=wmod_ref[...], mod_int=0),
+                    w_mod=wmod_ref[...], mod_int=0, sub2p=sub2p_ref[...]),
         b3=b3_ref[...])
     bB = digits_ref.shape[-1]
     dt = planes_ref.dtype
@@ -200,11 +222,14 @@ def _fb_msm_kernel(planes_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
             planes_ref[0, w], onehot, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         u = sel.astype(jnp.int32).astype(jnp.uint32)
-        pt = u[0:48, :] + (u[48:96, :] << 8)
-        return tec.add(acc, pt, cc)
+        xq = u[0:16, :] + (u[32:48, :] << 8)
+        yq = u[16:32, :] + (u[48:64, :] << 8)
+        keep = (d[None, :] == 0)
+        return jnp.where(keep, acc, tec.madd(acc, xq, yq, cc))
 
-    folded = jax.lax.fori_loop(0, windows, body, tec.identity(bB, cc),
-                               unroll=False)
+    folded = tec.normalize_point(
+        jax.lax.fori_loop(0, windows, body, tec.identity(bB, cc),
+                          unroll=False), cc)
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -222,9 +247,10 @@ def fb_msm_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
              lane_block: int = LANE_BLOCK) -> jnp.ndarray:
     """Accumulated fixed-base MSM, transposed interface.
 
-    planes_t: (T, W, 96, 256); digits_t: (T, W, B) -> (48, B) uint32:
-    per-lane sum over every term of table[t][digit]. The term axis rides
-    the INNER grid dim so each lane-block's accumulator stays in VMEM.
+    planes_t: (T, W, 64, 256) affine; digits_t: (T, W, B) -> (48, B)
+    uint32: per-lane sum over every term of table[t][digit]. The term
+    axis rides the INNER grid dim so each lane-block's accumulator stays
+    in VMEM.
     """
     from jax.experimental import pallas as pl
 
@@ -233,7 +259,7 @@ def fb_msm_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
     assert B % lane_block == 0, (B, lane_block)
     cc = tec.make_consts()
     consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
-              cc.ts.w_mod, cc.b3)
+              cc.ts.w_mod, cc.ts.sub2p, cc.b3)
     const_specs = [
         pl.BlockSpec(c.shape, lambda b, t, *, _nd=c.ndim: (0,) * _nd)
         for c in consts
@@ -243,7 +269,7 @@ def fb_msm_t(planes_t: jnp.ndarray, digits_t: jnp.ndarray,
         kernel,
         grid=(B // lane_block, T),
         in_specs=[
-            pl.BlockSpec((1, W, 96, 256), lambda b, t: (t, 0, 0, 0)),
+            pl.BlockSpec((1, W, 64, 256), lambda b, t: (t, 0, 0, 0)),
             pl.BlockSpec((1, W, lane_block), lambda b, t: (t, 0, b)),
             *const_specs,
         ],
@@ -261,7 +287,7 @@ def fixed_base_msm_fused(planes_t: jnp.ndarray, scalars: jnp.ndarray,
     accumulated fold: per-term select+fold AND the term-axis sum run in
     one pallas kernel (no XLA tree, no (B, T, 3, 16) intermediate).
 
-    planes_t: (T, 32, 96, 256); scalars: (..., T, 16) -> (..., 3, 16).
+    planes_t: (T, 32, 64, 256) affine; scalars: (..., T, 16) -> (..., 3, 16).
     """
     batch = scalars.shape[:-2]
     flat = scalars.reshape((-1,) + scalars.shape[-2:])
@@ -284,8 +310,8 @@ _VAR_KEEP = 128
 
 
 def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
-                    wnp_ref, wmod_ref, b3_ref, out_ref, *, windows: int,
-                    keep: int = _VAR_KEEP):
+                    wnp_ref, wmod_ref, sub2p_ref, b3_ref, out_ref, *,
+                    windows: int, keep: int = _VAR_KEEP):
     """One term-block: 4-bit-window Horner over a VMEM multiple table.
 
     pts_ref:    (48, VAR_BLOCK) uint32 transposed projective points.
@@ -306,7 +332,7 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
     cc = tec.CurveConsts(
         ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
                     r1=r1_ref[...], w_nprime=wnp_ref[...],
-                    w_mod=wmod_ref[...], mod_int=0),
+                    w_mod=wmod_ref[...], mod_int=0, sub2p=sub2p_ref[...]),
         b3=b3_ref[...])
     pts = pts_ref[...]
     bV = pts.shape[-1]
@@ -363,7 +389,7 @@ def msm_var_fused(points: jnp.ndarray, scalars: jnp.ndarray,
 
     cc = tec.make_consts()
     consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
-              cc.ts.w_mod, cc.b3)
+              cc.ts.w_mod, cc.ts.sub2p, cc.b3)
     const_specs = [
         pl.BlockSpec(c.shape, lambda b, *, _nd=c.ndim: (0,) * _nd)
         for c in consts
@@ -430,7 +456,7 @@ def mul2_rows_fused(points: jnp.ndarray, scalars: jnp.ndarray,
 
     cc = tec.make_consts()
     consts = (cc.ts.mod, cc.ts.nprime, cc.ts.r1, cc.ts.w_nprime,
-              cc.ts.w_mod, cc.b3)
+              cc.ts.w_mod, cc.ts.sub2p, cc.b3)
     const_specs = [
         pl.BlockSpec(c.shape, lambda b, *, _nd=c.ndim: (0,) * _nd)
         for c in consts

@@ -75,32 +75,42 @@ def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
     """Complete projective addition, valid for every input pair.
 
     Mirrors ec.add's three grouped multiplication rounds (6 + 2 + 6
-    products), batched along the LANE axis.
+    products), batched along the LANE axis. Canonical limbs in, canonical
+    limbs out — but the INTERIOR runs in lazy-carry form (tf.add_lazy /
+    tf.sub_lazy): the a1-side cross sums and the t3/t4/y3 linear
+    combinations skip the Kogge-Stone lookahead + conditional subtract
+    and flow into the next mont_mul as its single lazy operand (rule R3;
+    every round-3 lane pairs one lazy input with one canonical input).
     """
     ts = cc.ts
     X1, Y1, Z1 = coords(p)
     X2, Y2, Z2 = coords(q)
     addf = lambda a, b: tf.add(a, b, ts)
     subf = lambda a, b: tf.sub(a, b, ts)
+    subl = lambda a, b: tf.sub_lazy(a, b, ts)
 
-    # round 1: X1X2, Y1Y2, Z1Z2 and the three cross sums.
-    a1 = _cat([X1, Y1, Z1, addf(X1, Y1), addf(Y1, Z1), addf(X1, Z1)])
+    # round 1: X1X2, Y1Y2, Z1Z2 and the three cross sums. The a1-side
+    # sums are lazy (< 2p, one lazy mont operand per lane); the b1 side
+    # stays exact so no lane sees two lazy inputs.
+    a1 = _cat([X1, Y1, Z1, tf.add_lazy(X1, Y1), tf.add_lazy(Y1, Z1),
+               tf.add_lazy(X1, Z1)])
     b1 = _cat([X2, Y2, Z2, addf(X2, Y2), addf(Y2, Z2), addf(X2, Z2)])
     m = tf.mont_mul(a1, b1, ts)
     t0, t1, t2, m3, m4, m5 = _split(m, 6)
-    t3 = subf(m3, addf(t0, t1))          # X1Y2 + X2Y1
-    t4 = subf(m4, addf(t1, t2))          # Y1Z2 + Y2Z1
-    y3 = subf(m5, addf(t0, t2))          # X1Z2 + X2Z1
-    t0 = addf(addf(t0, t0), t0)          # 3*X1X2
+    t3 = subl(subl(m3, t0), t1)          # X1Y2 + X2Y1      (lazy, < 5p)
+    t4 = subl(subl(m4, t1), t2)          # Y1Z2 + Y2Z1      (lazy, < 5p)
+    y3 = subl(subl(m5, t0), t2)          # X1Z2 + X2Z1      (lazy, < 5p)
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2 (exact: it multiplies
+                                         # lazy t3 in round 3)
 
-    # round 2: the two b3 scalings.
+    # round 2: the two b3 scalings (b3 canonical; t2/y3 may be lazy).
     b3b = jnp.broadcast_to(cc.b3, t2.shape)
     s = tf.mont_mul(_cat([t2, y3]), _cat([b3b, b3b]), ts)
     t2, y3 = _split(s, 2)
-    z3 = addf(t1, t2)
-    t1 = subf(t1, t2)
+    z3 = addf(t1, t2)                    # exact: z3 multiplies lazy t4
+    t1 = subf(t1, t2)                    # exact: t1 multiplies lazy t3
 
-    # round 3: the six output products.
+    # round 3: the six output products — each lane lazy x canonical.
     a3 = _cat([t4, t3, y3, t1, t0, z3])
     b3v = _cat([y3, t1, t0, z3, t3, t4])
     o = tf.mont_mul(a3, b3v, ts)
@@ -109,6 +119,68 @@ def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
     y3o = addf(o3, o2)                   # t1*z3 + y3*t0
     z3o = addf(o5, o4)                   # z3*t4 + t0*t3
     return from_coords(x3, y3o, z3o)
+
+
+def madd(p: jnp.ndarray, xq: jnp.ndarray, yq: jnp.ndarray,
+         cc: CurveConsts) -> jnp.ndarray:
+    """Mixed addition p + (xq : yq : 1) — RCB15 Algorithm 8 (a=0, b3=9),
+    13 field muls (5 + 2 + 6) vs the 14 of the complete `add`, plus a
+    lazy-carry interior that keeps the accumulator's Y/Z coordinates in
+    lazy form ACROSS fold iterations (carries resolved once per chain by
+    `normalize_point`, not once per add).
+
+    Invariant (stable: outputs satisfy what inputs require):
+      p:  X canonical (< p); Y, Z lazy (limbs <= 2^16, value < 2p).
+      xq, yq: canonical Montgomery affine coordinates.
+    Complete for every projective p — including identity (0 : y : 0) and
+    p == +-Q — but NOT for Q at infinity: table digit 0 must be masked by
+    the caller (jnp.where on the digit), which is what keeps the fold
+    branch-free everywhere else.
+    """
+    ts = cc.ts
+    X1, Y1, Z1 = coords(p)
+    addf = lambda a, b: tf.add(a, b, ts)
+    subf = lambda a, b: tf.sub(a, b, ts)
+    subl = lambda a, b: tf.sub_lazy(a, b, ts)
+
+    # round 1 (5 muls): with Z2 = 1, t2 = Z1*Z2 is free and the Alg-7
+    # cross terms collapse: t4 = Y2*Z1 + Y1, y3 = X2*Z1 + X1.
+    s1 = tf.add_lazy(X1, Y1)             # lazy < 3p (X canonical)
+    s2 = addf(xq, yq)                    # exact (both canonical)
+    a1 = _cat([X1, Y1, s1, Z1, Z1])
+    b1 = _cat([xq, yq, s2, yq, xq])
+    m = tf.mont_mul(a1, b1, ts)
+    t0, t1, m2, m3, m4 = _split(m, 5)    # X1xq, Y1yq, s1*s2, Z1yq, Z1xq
+    t3 = subl(subl(m2, t0), t1)          # X1Y2 + X2Y1      (lazy, < 5p)
+    t4 = tf.add_lazy(m3, Y1)             # Y2Z1 + Y1        (lazy, < 3p)
+    y3 = tf.add_lazy(m4, X1)             # X2Z1 + X1        (lazy, < 2p)
+    t0 = addf(addf(t0, t0), t0)          # 3*X1X2 (exact)
+
+    # round 2 (2 muls): b3 scalings of t2 = Z1 (lazy) and y3 (lazy).
+    b3b = jnp.broadcast_to(cc.b3, t1.shape)
+    s = tf.mont_mul(_cat([Z1, y3]), _cat([b3b, b3b]), ts)
+    t2, y3 = _split(s, 2)
+    z3 = addf(t1, t2)                    # exact: z3 multiplies lazy t4
+    t1 = subf(t1, t2)                    # exact: t1 multiplies lazy t3
+
+    # round 3 (6 muls): each lane lazy x canonical.
+    a3 = _cat([t4, t3, y3, t1, t0, z3])
+    b3v = _cat([y3, t1, t0, z3, t3, t4])
+    o = tf.mont_mul(a3, b3v, ts)
+    o0, o1, o2, o3, o4, o5 = _split(o, 6)
+    x3 = subf(o1, o0)                    # canonical
+    y3o = tf.add_lazy(o3, o2)            # lazy < 2p
+    z3o = tf.add_lazy(o5, o4)            # lazy < 2p
+    return from_coords(x3, y3o, z3o)
+
+
+def normalize_point(p: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
+    """Resolve a madd-chain accumulator to fully canonical limbs.
+
+    X is already canonical under the madd invariant; Y and Z are lazy
+    with value < 2p — one carry_propagate + conditional subtract each."""
+    X, Y, Z = coords(p)
+    return from_coords(X, tf.normalize(Y, cc.ts), tf.normalize(Z, cc.ts))
 
 
 def tree_fold(p: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:

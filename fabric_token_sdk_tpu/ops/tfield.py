@@ -40,6 +40,16 @@ N = L.NLIMBS
 BITS = L.LIMB_BITS
 MASK = L.LIMB_MASK  # python int: never captured as a device constant
 
+#: Maximum limb magnitude a LAZY-form element may carry between ops.
+#: add_lazy / sub_lazy keep limbs <= 2^16 (one bit above canonical);
+#: anything that can exceed it must go through `normalize` first.
+LAZY_LIMB_MAX = 1 << BITS
+
+#: Maximum *value* (not limb) a lazy element may reach before it feeds
+#: mont_mul: with one operand < 5p the reduction output stays < 2p, so a
+#: single conditional subtract still canonicalizes (see mont_mul).
+LAZY_VALUE_MAX_P = 5
+
 
 class TSpec(NamedTuple):
     """Field constants in transposed layout (limb axis leading, lane=1).
@@ -48,6 +58,8 @@ class TSpec(NamedTuple):
     5-nibble-plane Toeplitz matrices (`_toeplitz_t`) accepting LAZY
     (17-bit) limb operands, so the in-kernel contraction is a plain
     (M,K)x(K,LANE) matmul. mod_int is a python int (jit-static).
+    `sub2p` holds 2*mod with pre-distributed borrows (`_sub2p_limbs`) so
+    sub_lazy needs no borrow lookahead at all.
     """
 
     mod: jnp.ndarray       # (N, 1) uint32
@@ -56,6 +68,24 @@ class TSpec(NamedTuple):
     w_nprime: jnp.ndarray  # (4, N, 5N)  int8: T_lo * N' mod 2^256
     w_mod: jnp.ndarray     # (4, 2N, 5N) int8: m * mod, full 2N limbs
     mod_int: int
+    sub2p: jnp.ndarray = None  # (N, 1) uint32 pre-borrowed 2*mod limbs
+
+
+def _sub2p_limbs(mod_int: int) -> np.ndarray:
+    """Limbs of 2*mod rearranged so every h_i - b_i >= 0 for canonical b.
+
+    h_0 = (2p)_0 + 2^16, h_i = (2p)_i - 1 + 2^16 for 0 < i < N-1,
+    h_{N-1} = (2p)_{N-1} - 1: the +2^16 at limb i is paid for by the -1
+    at limb i+1, so sum(h_i * 2^(16 i)) == 2p exactly, while each limb
+    majorizes any canonical (< p) subtrahend limb. Requires p < 2^255
+    (so 2p fits N limbs) and (2p)_i >= 1 for the interior limbs — both
+    hold for BN254's p and r."""
+    tp = [int(v) for v in L.int_to_limbs(2 * mod_int)]
+    h = [tp[0] + (1 << BITS)]
+    h += [tp[i] - 1 + (1 << BITS) for i in range(1, N - 1)]
+    h += [tp[N - 1] - 1]
+    assert all(v >= 0 for v in h) and h[N - 1] >= mod_int >> (BITS * (N - 1))
+    return np.array(h, dtype=np.uint32)
 
 
 def _toeplitz_t(const_limbs: tuple, out_cols: int) -> np.ndarray:
@@ -94,6 +124,7 @@ def make_tspec(spec) -> TSpec:
         w_nprime=jnp.asarray(_toeplitz_t(spec.nprime, N)),
         w_mod=jnp.asarray(_toeplitz_t(spec.mod, 2 * N)),
         mod_int=spec.mod_int,
+        sub2p=jnp.asarray(_sub2p_limbs(spec.mod_int)[:, None]),
     )
 
 
@@ -201,6 +232,140 @@ def sub(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
     return jnp.where(borrow != 0, fixed, diff)
 
 
+# --------------------------------------------------------------------------
+# lazy-carry arithmetic (Aranha et al., EUROCRYPT 2011 adapted to 16-bit
+# limbs): between ops, limbs may sit anywhere <= LAZY_LIMB_MAX (2^16) and
+# the represented VALUE anywhere < 5*mod. add_lazy/sub_lazy are single- or
+# double-ripple passes — no Kogge-Stone lookahead, no conditional subtract,
+# which is ~60% of the VPU work of an exact `add`. The chain must end at
+# `normalize` (or flow through mont_mul, whose reduction canonicalizes)
+# before the result is compared, hashed, or read back.
+#
+# Schedule rules (enforced statically by LimbBound + scripts/
+# check_lazy_bounds.py):
+#   R1  add_lazy takes at most ONE lazy operand (two 2^16 limbs would sum
+#       past the stable 2^16 bound);
+#   R2  sub_lazy's subtrahend must be CANONICAL (< mod);
+#   R3  mont_mul takes at most ONE lazy operand, value < 5*mod;
+#   R4  normalize accepts lazy values < 2*mod only.
+# --------------------------------------------------------------------------
+
+def add_lazy(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b in lazy form: ONE ripple pass, no lookahead, no mod subtract.
+
+    At most one operand may be lazy (limbs <= 2^16, the other canonical);
+    the sum value must stay < 2^256. Output limbs <= 2^16 (the ripple
+    carry is <= 1 on top of a <= 2^16 - 1 masked limb) and the output
+    value is a + b exactly — nothing is reduced."""
+    t = a + b
+    return (t & MASK) + _shift_down(t >> BITS, 1)
+
+
+def sub_lazy(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    """a + 2*mod - b in lazy form: two ripple passes, no borrow chain.
+
+    `a` may be lazy (limbs <= 2^16); `b` MUST be canonical (< mod) so the
+    pre-borrowed 2p limbs (`ts.sub2p`) majorize it per-limb and the
+    per-limb sums a_i + h_i - b_i never underflow. Output limbs <= 2^16;
+    output value = a + 2*mod - b (exact, congruent to a - b)."""
+    t = a + jnp.broadcast_to(ts.sub2p, a.shape) - jnp.broadcast_to(b, a.shape)
+    # t < 3*2^16 per limb -> two ripple passes reach the stable 2^16 bound
+    # (value < 2^256 keeps the top limb from ever generating a carry out).
+    return lazy_limbs(lazy_limbs(t, N), N)
+
+
+def normalize(a: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
+    """Lazy form (limbs <= 2^16, value < 2*mod) -> canonical (< mod)."""
+    return _cond_sub_mod(carry_propagate(a, N + 1), ts)
+
+
+class LimbBound:
+    """Static bound tracker for lazy-carry schedules.
+
+    Carries the worst-case per-limb magnitude and represented value
+    (in units of mod) through a schedule of field ops, raising
+    ValueError the moment a rule R1-R4 precondition breaks. Used by the
+    carry-bound exhaustion test to prove the kernels' add-chains can
+    never push a limb past LAZY_LIMB_MAX — and that the tracker itself
+    rejects schedules that would."""
+
+    def __init__(self, limb_max: int, value_p: float):
+        self.limb_max = int(limb_max)
+        self.value_p = float(value_p)   # value bound in multiples of mod
+
+    @classmethod
+    def canonical(cls) -> "LimbBound":
+        return cls(MASK, 1.0)
+
+    @property
+    def is_canonical(self) -> bool:
+        return self.limb_max <= MASK and self.value_p <= 1.0
+
+    def _check_lazy(self, who: str) -> None:
+        if self.limb_max > LAZY_LIMB_MAX:
+            raise ValueError(
+                f"{who}: operand limbs can reach {self.limb_max} > "
+                f"LAZY_LIMB_MAX={LAZY_LIMB_MAX}; insert normalize()")
+
+    def add_lazy(self, other: "LimbBound") -> "LimbBound":
+        self._check_lazy("add_lazy")
+        other._check_lazy("add_lazy")
+        if not (self.is_canonical or other.is_canonical):
+            raise ValueError(
+                "add_lazy: both operands lazy (R1) — limbs could reach "
+                f"{(self.limb_max & MASK) + 2} > LAZY_LIMB_MAX")
+        # one ripple: masked limb <= MASK plus carry-in <= 1
+        return self._with_value("add_lazy", self.value_p + other.value_p)
+
+    def sub_lazy(self, other: "LimbBound") -> "LimbBound":
+        self._check_lazy("sub_lazy")
+        if not other.is_canonical:
+            raise ValueError("sub_lazy: subtrahend must be canonical (R2)")
+        return self._with_value("sub_lazy", self.value_p + 2.0)
+
+    @staticmethod
+    def _with_value(who: str, value_p: float) -> "LimbBound":
+        # 2^256 / p for BN254: past this the (nonexistent) top carry-out
+        # of a ripple pass would silently drop value.
+        ceil_p = (1 << (BITS * N)) / L.P_INT
+        if value_p >= ceil_p:
+            raise ValueError(
+                f"{who}: value bound {value_p}p overflows 2^256 "
+                f"({ceil_p:.2f}p)")
+        return LimbBound(LAZY_LIMB_MAX, value_p)
+
+    def mont_mul(self, other: "LimbBound") -> "LimbBound":
+        self._check_lazy("mont_mul")
+        other._check_lazy("mont_mul")
+        if not (self.is_canonical or other.is_canonical):
+            raise ValueError("mont_mul: both operands lazy (R3)")
+        if max(self.value_p, other.value_p) > LAZY_VALUE_MAX_P:
+            raise ValueError(
+                f"mont_mul: operand value {max(self.value_p, other.value_p)}"
+                f"p exceeds {LAZY_VALUE_MAX_P}p (R3) — reduction output "
+                "would pass 2p and one conditional subtract no longer "
+                "canonicalizes")
+        return LimbBound.canonical()
+
+    def add(self, other: "LimbBound") -> "LimbBound":
+        if not (self.is_canonical and other.is_canonical):
+            raise ValueError("exact add requires canonical operands")
+        return LimbBound.canonical()
+
+    def sub(self, other: "LimbBound") -> "LimbBound":
+        if not (self.is_canonical and other.is_canonical):
+            raise ValueError("exact sub requires canonical operands")
+        return LimbBound.canonical()
+
+    def normalize(self) -> "LimbBound":
+        self._check_lazy("normalize")
+        if self.value_p > 2.0:
+            raise ValueError(
+                f"normalize: value {self.value_p}p > 2p (R4) — one "
+                "conditional subtract cannot canonicalize")
+        return LimbBound.canonical()
+
+
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
     """(..., K, LANE) -> (..., 1, LANE) bool."""
     return jnp.all(a == 0, axis=-2, keepdims=True)
@@ -289,7 +454,14 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
     < 2^256 * (1 + 2^-5), hence res < mod * (mod/2^256 + 1.04) < 1.3*mod
     for BN254's p, r ~ 0.19 * 2^256 — the single conditional subtract
     still canonicalizes. The batch-dim path (parity testing) stays fully
-    exact schoolbook."""
+    exact schoolbook.
+
+    Lazy-carry contract (R3): at most ONE operand may be in lazy form
+    (limbs <= LAZY_LIMB_MAX, the other canonical — two 2^16 limbs would
+    overflow the uint32 partial products) and its VALUE must be < 5*mod:
+    then T < 5*mod^2 and res < mod*(5*mod/2^256 + 1.04) < 2*mod for
+    BN254, so the single conditional subtract still lands canonical.
+    Output is always canonical — mont_mul is a normalization point."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)

@@ -155,7 +155,11 @@ def configure_jax_cache() -> None:
 
     raise_stack_limit()
     install_cache_size_guard()
-    base = os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache")
+    # BENCH_COMPILE_CACHE_DIR is the bench/serve opt-in for a cache that
+    # PERSISTS across container runs (bench.py points it at benchdata/);
+    # JAX_CACHE_DIR stays the generic override, /tmp the throwaway default.
+    base = os.environ.get("BENCH_COMPILE_CACHE_DIR") \
+        or os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache")
     # Segment by backend platform AND host CPU: the axon (remote-TPU)
     # client writes XLA:CPU AOT artifacts compiled on the REMOTE host into
     # the cache; loading those under the local cpu backend SIGILLs/aborts

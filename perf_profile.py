@@ -20,6 +20,13 @@ Modes (--mode):
            vs the pipelined wall time is the host/device overlap the
            pipeline buys. This is the only mode that injects fences —
            production spans never do.
+  fold     standalone fixed-base fold micro-bench: times the fused
+           Pallas kernels (fb_fold_t gather, fb_msm_t MSM) outside the
+           verify pipeline, and prints the XLA cost-analysis FLOP
+           comparison of the projective complete-add fold vs the
+           mixed-affine madd fold. On CPU the kernels run in Pallas
+           interpret mode (functionally exact, wall time not
+           representative), so the FLOP ratio is the headline number.
 
 Output: human-readable table on stderr, one JSON document on stdout.
 --trace <path> additionally writes the span tree as Chrome trace-event
@@ -163,9 +170,98 @@ def _mode_barrier(args, tracer, records) -> dict:
     return doc
 
 
+def _mode_fold(args, tracer, records) -> dict:
+    """Fixed-base fold kernels standalone (no corpus, no verifier).
+
+    Two artifacts:
+      1. Lower-only XLA cost analysis of the per-term fold at identical
+         gather shapes — projective complete-add path (96 planes, 14-mul
+         adds) vs mixed-affine madd path (64 planes, 13-mul madds, lazy
+         interior). This is backend-independent evidence that the madd
+         rework removed work per fold term.
+      2. Wall time of the fused Pallas kernels fb_fold_t (via
+         fixed_base_gather_fused) and fb_msm_t (fixed_base_msm_fused):
+         compiled Mosaic on TPU; interpret mode on CPU (bit-exact but
+         orders of magnitude slower — sizes are capped there).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fabric_token_sdk_tpu.crypto import bn254
+    from fabric_token_sdk_tpu.ops import ec, limbs, pallas_fb
+
+    cpu = jax.default_backend() != "tpu"
+    T = 2 if cpu else 8
+    B = min(args.batch, 2) if cpu else args.batch
+    rng = np.random.default_rng(7)
+    g = bn254.G1_GENERATOR
+    pts = jnp.asarray(limbs.points_to_projective_limbs(
+        [g * int(rng.integers(1, 2 ** 31)) for _ in range(T)]))
+    sc = jnp.asarray(np.stack([np.stack([
+        limbs.int_to_limbs(
+            int.from_bytes(rng.bytes(32), "little") % bn254.R)
+        for _ in range(T)]) for _ in range(B)]))
+
+    pd = ec.plane_dtype()
+    proj_sds = jax.ShapeDtypeStruct((T, 32, 256, 96), pd)
+    aff_sds = jax.ShapeDtypeStruct((T, 32, 256, 64), pd)
+    sc_sds = jax.ShapeDtypeStruct((B, T, limbs.NLIMBS), jnp.uint32)
+
+    def _flops(fn, *sds):
+        try:
+            c = jax.jit(fn).lower(*sds).cost_analysis()
+            if isinstance(c, (list, tuple)):
+                c = c[0] if c else None
+            return (c or {}).get("flops")
+        except Exception:
+            return None
+
+    f_proj = _flops(ec.fixed_base_gather, proj_sds, sc_sds)
+    f_mixed = _flops(ec.fixed_base_gather_mixed, aff_sds, sc_sds)
+    ratio = (round(f_mixed / f_proj, 4) if f_proj and f_mixed else None)
+    print(f"fold cost analysis (B={B}, T={T}): projective "
+          f"{f_proj} flops, mixed-affine {f_mixed} flops "
+          f"(ratio {ratio})", file=sys.stderr)
+    doc: dict = {"terms": T, "rows": B, "interpret": cpu,
+                 "cost_analysis": {
+                     "projective_gather_flops": f_proj,
+                     "mixed_gather_flops": f_mixed,
+                     "mixed_over_projective": ratio}}
+
+    print("building affine tables + first call (compiles)",
+          file=sys.stderr)
+    planes_t = pallas_fb.transpose_planes(ec.fixed_base_affine_planes(pts))
+    reps = 1 if cpu else max(1, args.reps)
+    t0 = time.perf_counter()
+    out = pallas_fb.fixed_base_gather_fused(planes_t, sc, interpret=cpu)
+    jax.block_until_ready(out)
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = pallas_fb.fixed_base_gather_fused(planes_t, sc,
+                                                interpret=cpu)
+    jax.block_until_ready(out)
+    fold_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        msm = pallas_fb.fixed_base_msm_fused(planes_t, sc, interpret=cpu)
+    jax.block_until_ready(msm)
+    msm_s = (time.perf_counter() - t0) / reps
+    doc.update({"fb_fold_s": round(fold_s, 4),
+                "fb_msm_s": round(msm_s, 4),
+                "first_call_s": round(first_s, 4),
+                "fold_terms_per_s":
+                    round(B * T / fold_s, 2) if fold_s else 0})
+    print(f"fb_fold_t {fold_s * 1e3:.1f} ms  fb_msm_t {msm_s * 1e3:.1f} "
+          f"ms  (first call {first_s:.1f} s, interpret={cpu})",
+          file=sys.stderr)
+    return doc
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=("range", "block", "barrier"),
+    ap.add_argument("--mode", choices=("range", "block", "barrier", "fold"),
                     default="range")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=3)
@@ -180,7 +276,7 @@ def main() -> None:
     if args.xprof:
         TRACER.profile_dir = args.xprof
     mode = {"range": _mode_range, "block": _mode_block,
-            "barrier": _mode_barrier}[args.mode]
+            "barrier": _mode_barrier, "fold": _mode_fold}[args.mode]
     doc = mode(args, TRACER, RECORDS)
     doc["mode"] = args.mode
     doc["batch"] = args.batch

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Lint: every lazy-carry producer at a readback boundary must normalize.
+
+The lazy-carry limb discipline (ops/tfield.py rules R1-R4) keeps field
+elements with limbs <= 2^16 and values < 2p BETWEEN ops, resolving
+carries once per add-chain instead of once per add. The failure mode is
+silent: a lazy value that escapes to a readback boundary — a Pallas
+kernel's out_ref store, or a public mixed-fold entry point whose result
+feeds byte serialization / transcript hashing — COMPARES unequal to its
+canonical twin while being the same field element, breaking the
+bit-identical verdict contract.
+
+This lint walks the AST of the ops kernels (and every other module that
+touches the lazy API) and enforces one function-level rule:
+
+  a function that CALLS a lazy producer
+      (add_lazy / sub_lazy / lazy_limbs / madd / madd_masked)
+  and sits at a readback boundary
+      (stores to a ``*_ref`` — a Pallas kernel output — or is a public
+      ``*_mixed`` fold entry point, or lives outside ops/)
+  must also CALL a normalizer
+      (normalize / normalize_point / carry_propagate / _carry_propagate
+       / _cond_sub_mod)
+
+Interior helpers (tec.add's lazy interior, madd itself) are exempt: they
+are not boundaries — their canonical-out contracts are covered by the
+parity/property tests, and madd's lazy-out contract is the point.
+
+Runnable standalone (``python scripts/check_lazy_bounds.py`` — exits 1
+with the offender list) and imported by tests/test_lazy_bounds_lint.py
+as a tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "fabric_token_sdk_tpu"
+
+#: ops whose RESULT is in lazy form (limbs may reach 2^16 / value >= p)
+LAZY_PRODUCERS = frozenset({
+    "add_lazy", "sub_lazy", "lazy_limbs", "madd", "madd_masked",
+})
+
+#: ops that resolve carries AND reduce below p (canonicalization points)
+NORMALIZERS = frozenset({
+    "normalize", "normalize_point", "carry_propagate", "_carry_propagate",
+    "_cond_sub_mod",
+})
+
+
+def _source_files() -> list[Path]:
+    return sorted(PKG.rglob("*.py"))
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    """Bare or attribute-terminal names of every call inside ``fn``
+    (``tec.madd(...)`` and ``madd(...)`` both yield ``madd``)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            out.add(f.attr)
+        elif isinstance(f, ast.Name):
+            out.add(f.id)
+    return out
+
+
+def _stores_to_ref(fn: ast.AST) -> bool:
+    """True when the function assigns into a ``*_ref[...]`` subscript —
+    the Pallas kernel output-write idiom (readback boundary)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id.endswith("_ref")):
+                return True
+    return False
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def scan_boundaries() -> dict[str, dict]:
+    """{``file::function``: info} for every function the lint treats as a
+    readback boundary that calls into the lazy API. ``info`` carries the
+    producer/normalizer call sets for reporting and the guard test."""
+    found: dict[str, dict] = {}
+    for path in _source_files():
+        rel = path.relative_to(REPO)
+        in_ops = rel.parts[:2] == ("fabric_token_sdk_tpu", "ops")
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:  # pragma: no cover - tree must stay parseable
+            continue
+        for fn in _functions(tree):
+            calls = _called_names(fn)
+            producers = calls & LAZY_PRODUCERS
+            if not producers:
+                continue
+            if fn.name in LAZY_PRODUCERS:
+                continue  # the producers themselves are lazy-out by design
+            boundary = (_stores_to_ref(fn)
+                        or fn.name.endswith("_mixed")
+                        or not in_ops)
+            if not boundary:
+                continue
+            found[f"{rel}::{fn.name}"] = {
+                "line": fn.lineno,
+                "producers": sorted(producers),
+                "normalizers": sorted(calls & NORMALIZERS),
+            }
+    return found
+
+
+def find_offenders() -> dict[str, dict]:
+    """Boundary functions using lazy producers without any normalizer."""
+    return {name: info for name, info in scan_boundaries().items()
+            if not info["normalizers"]}
+
+
+def main() -> int:
+    offenders = find_offenders()
+    if offenders:
+        print("lazy-carry values reach a readback boundary without a "
+              "normalization point:", file=sys.stderr)
+        for name, info in sorted(offenders.items()):
+            print(f"  {name} (line {info['line']}): calls "
+                  f"{','.join(info['producers'])} but none of "
+                  f"{','.join(sorted(NORMALIZERS))}", file=sys.stderr)
+        return 1
+    n = len(scan_boundaries())
+    print(f"ok: {n} lazy-API boundary function(s), all normalized")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

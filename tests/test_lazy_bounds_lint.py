@@ -1,0 +1,63 @@
+"""Tier-1 wrapper around scripts/check_lazy_bounds.py: no lazy-carry
+value may reach a readback boundary (Pallas out_ref store, public
+*_mixed fold entry point, or any call site outside ops/) without a
+normalization point in the same function.
+
+The standalone script is the pre-commit entry point; this test makes the
+invariant part of the suite so a new kernel that forgets its final
+normalize_point fails CI, not just the linter nobody ran.
+"""
+
+import importlib.util
+import pathlib
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+           / "check_lazy_bounds.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_lazy_bounds",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_lazy_boundary_normalizes():
+    mod = _load()
+    offenders = mod.find_offenders()
+    assert not offenders, (
+        "lazy-form values escape to a readback boundary without a "
+        "normalization point (add tec.normalize_point / tf.normalize "
+        f"before the store/return): {offenders}")
+
+
+def test_linter_sees_the_lazy_boundaries():
+    """Guard the guard: the lint must be finding the real boundary set —
+    the fused fold kernels and the mixed XLA entry points — or a rename
+    would turn it into a silent no-op."""
+    mod = _load()
+    found = mod.scan_boundaries()
+    kernels = [k for k in found if "pallas_fb.py" in k]
+    mixed = [k for k in found if k.endswith("_mixed")]
+    assert len(kernels) >= 2, found  # _fb_fold_kernel, _fb_msm_kernel
+    assert len(mixed) >= 1, found    # fixed_base_gather_mixed
+    # and every one it found is currently clean
+    assert all(info["normalizers"] for info in found.values()), found
+
+
+def test_linter_catches_a_missing_normalize(tmp_path):
+    """A synthetic boundary function without a normalizer must trip the
+    scan logic (exercise the rule itself, not just today's clean tree)."""
+    mod = _load()
+    import ast
+
+    bad = ast.parse(
+        "def _bad_kernel(x_ref, out_ref):\n"
+        "    acc = add_lazy(x_ref[0], x_ref[1])\n"
+        "    out_ref[0] = acc\n")
+    fn = next(mod._functions(bad))
+    assert mod._stores_to_ref(fn)
+    calls = mod._called_names(fn)
+    assert calls & mod.LAZY_PRODUCERS
+    assert not (calls & mod.NORMALIZERS)

@@ -1,0 +1,332 @@
+"""Mixed addition (tec.madd) + lazy-carry limb arithmetic properties.
+
+Three layers of defense for the bit-identical-verdict contract:
+
+  1. madd parity vs the complete add and the host oracle over the
+     adversarial corner inputs where mixed-addition formulas classically
+     break: identity accumulator, P + P (doubling through madd),
+     P + (-P) -> identity, and accumulators whose Y/Z coordinates arrive
+     in maximum-magnitude lazy form (a limb at exactly 2^16).
+  2. Numeric checks of the lazy field ops at their documented bound
+     edges (mont_mul at operand value 5p-eps, sub_lazy output value,
+     normalize at < 2p).
+  3. A carry-bound exhaustion walk of the madd/add schedules through
+     tfield.LimbBound: the tracker raises the moment any rule R1-R4
+     precondition breaks, so the schedule COMPLETING is a proof that no
+     intermediate limb can exceed LAZY_LIMB_MAX = 2^16 — and the
+     violation tests prove the tracker itself rejects schedules that
+     would.
+"""
+
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254
+from fabric_token_sdk_tpu.ops import field, limbs as L, tec
+from fabric_token_sdk_tpu.ops import tfield as tf
+
+P = L.P_INT
+R_INV = pow(2 ** 256, -1, P)
+
+
+def _digits(v: int) -> list[int]:
+    return [(v >> (16 * i)) & 0xFFFF for i in range(L.NLIMBS)]
+
+
+def _val(col) -> int:
+    # L.limbs_to_int uses OR packing and silently corrupts limbs >= 2^16;
+    # lazy values need the weighted sum.
+    return sum(int(v) << (16 * i) for i, v in enumerate(col))
+
+
+def _spiked_value(base: int):
+    """(value, digits) for a lazy representation with one limb at exactly
+    LAZY_LIMB_MAX = 2^16 and value <= base: move one unit of the top
+    nonzero digit down as 2^16, overwriting the digit below (the value
+    can only shrink, by < 2^16(i-1) * 2^16)."""
+    d = _digits(base)
+    for i in range(L.NLIMBS - 1, 0, -1):
+        if d[i] >= 1:
+            d[i] -= 1
+            d[i - 1] = 1 << 16
+            return _val(d), d
+    raise AssertionError(f"value {base} too small to spike")
+
+
+def _same(p: bn254.G1, q: bn254.G1) -> bool:
+    return (p.inf and q.inf) or (not p.inf and not q.inf
+                                 and p.x == q.x and p.y == q.y)
+
+
+def _rand_pts(n):
+    return [bn254.g1_mul(bn254.G1_GENERATOR, secrets.randbelow(bn254.R))
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def cc():
+    return tec.make_consts()
+
+
+# --------------------------------------------------------------------------
+# 1. madd parity over adversarial inputs
+# --------------------------------------------------------------------------
+
+class TestMaddParity:
+    def _affine_t(self, pts):
+        """Points -> canonical Montgomery affine (16, B) coordinate pair
+        (madd's table-entry operand form). No identities allowed here —
+        digit 0 is masked by the callers, not by madd."""
+        xs = [np.array(L.int_to_limbs(L.fp_to_mont_int(p.x)),
+                       dtype=np.uint32) for p in pts]
+        ys = [np.array(L.int_to_limbs(L.fp_to_mont_int(p.y)),
+                       dtype=np.uint32) for p in pts]
+        return (jnp.asarray(np.stack(xs).T), jnp.asarray(np.stack(ys).T))
+
+    def _acc_t(self, pts):
+        arr = L.points_to_projective_limbs(pts)          # (B, 3, 16)
+        return jnp.asarray(arr.reshape(len(pts), 48).T)  # (48, B)
+
+    def test_corner_cases_match_oracle_and_complete_add(self, cc):
+        base = _rand_pts(4)
+        q_pts = [base[0], base[1], base[1], base[2],
+                 base[3], bn254.G1_GENERATOR]
+        acc_pts = [base[1],                    # generic
+                   bn254.G1_IDENTITY,          # identity accumulator
+                   base[1],                    # P + P (doubling)
+                   bn254.g1_neg(base[2]),      # P + (-P) -> identity
+                   base[0], base[2]]
+        acc = self._acc_t(acc_pts)
+        xq, yq = self._affine_t(q_pts)
+        out = tec.normalize_point(tec.madd(acc, xq, yq, cc), cc)
+        # complete-add reference on the same lanes
+        q_proj = self._acc_t(q_pts)
+        ref = tec.add(acc, q_proj, cc)
+        out_np, ref_np = np.asarray(out), np.asarray(ref)
+        assert int(out_np.max()) <= 0xFFFF      # canonical after normalize
+        for i, (a, q) in enumerate(zip(acc_pts, q_pts)):
+            want = bn254.g1_add(a, q)
+            got = L.projective_limbs_to_point(out_np[:, i].reshape(3, 16))
+            also = L.projective_limbs_to_point(ref_np[:, i].reshape(3, 16))
+            assert _same(got, want), f"lane {i} vs oracle"
+            assert _same(also, want), f"lane {i} complete add vs oracle"
+
+    def test_lazy_accumulator_representation(self, cc):
+        """madd must accept Y/Z in any legal lazy form: the value-
+        equivalent representation add_lazy(Y, p) (value Y + p < 2p,
+        ripple-carry limb layout, limbs can hit 2^16) must produce the
+        bit-identical canonical result."""
+        [p1], [q] = _rand_pts(1), _rand_pts(1)
+        acc = np.asarray(self._acc_t([p1])).copy()       # (48, 1)
+        mod = jnp.asarray(np.array(_digits(P), dtype=np.uint32)[:, None])
+        y_lazy = np.asarray(tf.add_lazy(jnp.asarray(acc[16:32]), mod))
+        z_lazy = np.asarray(tf.add_lazy(jnp.asarray(acc[32:48]), mod))
+        assert _val(y_lazy[:, 0]) == _val(acc[16:32, 0]) + P
+        assert _val(z_lazy[:, 0]) == _val(acc[32:48, 0]) + P
+        lazy = acc.copy()
+        lazy[16:32] = y_lazy
+        lazy[32:48] = z_lazy
+        xq, yq = self._affine_t([q])
+        want = np.asarray(tec.normalize_point(
+            tec.madd(jnp.asarray(acc), xq, yq, cc), cc))
+        got = np.asarray(tec.normalize_point(
+            tec.madd(jnp.asarray(lazy), xq, yq, cc), cc))
+        assert (want == got).all()
+        assert _same(
+            L.projective_limbs_to_point(got[:, 0].reshape(3, 16)),
+            bn254.g1_add(p1, q))
+
+    def test_chain_keeps_invariant(self, cc):
+        """Five madd steps WITHOUT normalization: Y/Z limbs stay
+        <= 2^16 and values < 2p at every step (the kernel fold's
+        steady-state invariant), and the final normalized value is
+        acc + 5q."""
+        [p1], [q] = _rand_pts(1), _rand_pts(1)
+        acc = self._acc_t([p1])
+        xq, yq = self._affine_t([q])
+        for step in range(5):
+            acc = tec.madd(acc, xq, yq, cc)
+            a = np.asarray(acc)
+            assert int(a.max()) <= (1 << 16), step
+            assert _val(a[16:32, 0]) < 2 * P, step
+            assert _val(a[32:48, 0]) < 2 * P, step
+        out = np.asarray(tec.normalize_point(acc, cc))
+        want = bn254.g1_add(p1, bn254.g1_mul(q, 5))
+        assert _same(
+            L.projective_limbs_to_point(out[:, 0].reshape(3, 16)), want)
+
+
+# --------------------------------------------------------------------------
+# 2. lazy field ops at their bound edges
+# --------------------------------------------------------------------------
+
+class TestLazyFieldOps:
+    def test_add_lazy_sub_lazy_normalize_values(self, cc):
+        ts = cc.ts
+        a_int = secrets.randbelow(P)
+        b_int = secrets.randbelow(P)
+        a = jnp.asarray(np.array(L.int_to_limbs(a_int),
+                                 dtype=np.uint32)[:, None])
+        b = jnp.asarray(np.array(L.int_to_limbs(b_int),
+                                 dtype=np.uint32)[:, None])
+        s = np.asarray(tf.add_lazy(a, b))[:, 0]
+        assert _val(s) == a_int + b_int                  # no reduction
+        assert int(s.max()) <= (1 << 16)
+        d = np.asarray(tf.sub_lazy(a, b, ts))[:, 0]
+        assert _val(d) == a_int + 2 * P - b_int
+        assert int(d.max()) <= (1 << 16)
+        n = np.asarray(tf.normalize(jnp.asarray(
+            np.array(s, dtype=np.uint32)[:, None]), ts))[:, 0]
+        assert _val(n) == (a_int + b_int) % P
+
+    def test_mont_mul_lazy_operand_at_bound(self, cc):
+        """One lazy operand at value ~5p-1 with a limb spiked to 2^16:
+        output must still be the exact canonical Montgomery product."""
+        ts = cc.ts
+        v, d = _spiked_value(tf.LAZY_VALUE_MAX_P * P - 1)
+        assert 4 * P < v < 5 * P and max(d) == 1 << 16
+        b_int = secrets.randbelow(P)
+        a = jnp.asarray(np.array(d, dtype=np.uint32)[:, None])
+        b = jnp.asarray(np.array(L.int_to_limbs(b_int),
+                                 dtype=np.uint32)[:, None])
+        out = np.asarray(tf.mont_mul(a, b, ts))[:, 0]
+        assert L.limbs_to_int(out) == v * b_int * R_INV % P
+        assert int(out.max()) <= 0xFFFF                  # canonical
+
+    def test_field_module_lazy_ops(self):
+        """ops/field.py (2-D row layout) twins of the lazy ops."""
+        a_int = secrets.randbelow(P)
+        b_int = secrets.randbelow(P)
+        a = jnp.asarray(np.array(L.int_to_limbs(a_int),
+                                 dtype=np.uint32)[None])
+        b = jnp.asarray(np.array(L.int_to_limbs(b_int),
+                                 dtype=np.uint32)[None])
+        s = np.asarray(field.add_lazy(a, b))[0]
+        assert _val(s) == a_int + b_int
+        d = np.asarray(field.sub_lazy(a, b, field.FP))[0]
+        assert _val(d) == a_int + 2 * P - b_int
+        # normalize is an R4 op: value must be < 2p — the add_lazy result
+        # qualifies, a sub_lazy result (a + 2p - b, up to 3p) does NOT.
+        n = np.asarray(field.normalize(
+            jnp.asarray(np.array(s, dtype=np.uint32)[None]), field.FP))[0]
+        assert _val(n) == (a_int + b_int) % P
+        v, dd = _spiked_value(3 * P - 1)
+        m = np.asarray(field.mont_mul(
+            jnp.asarray(np.array(dd, dtype=np.uint32)[None]), b,
+            field.FP))[0]
+        assert L.limbs_to_int(m) == v * b_int * R_INV % P
+
+
+# --------------------------------------------------------------------------
+# 3. carry-bound exhaustion: LimbBound schedule walk
+# --------------------------------------------------------------------------
+
+LB = tf.LimbBound
+
+
+def _walk_madd(X, Y, Z):
+    """tec.madd's exact op schedule in LimbBound space. Any R1-R4 break
+    raises inside the tracker."""
+    can = LB.canonical()
+    s1 = X.add_lazy(Y)
+    s2 = can.add(can)
+    t0 = X.mont_mul(can)
+    t1 = Y.mont_mul(can)
+    m2 = s1.mont_mul(s2)
+    m3 = Z.mont_mul(can)
+    m4 = Z.mont_mul(can)
+    t3 = m2.sub_lazy(t0).sub_lazy(t1)
+    t4 = m3.add_lazy(Y)
+    y3 = m4.add_lazy(X)
+    t0 = t0.add(t0).add(t0)
+    t2 = Z.mont_mul(can)                 # b3 * Z1
+    y3 = y3.mont_mul(can)                # b3 * y3
+    z3 = t1.add(t2)
+    t1 = t1.sub(t2)
+    o0 = t4.mont_mul(y3)
+    o1 = t3.mont_mul(t1)
+    o2 = y3.mont_mul(t0)
+    o3 = t1.mont_mul(z3)
+    o4 = t0.mont_mul(t3)
+    o5 = z3.mont_mul(t4)
+    return o1.sub(o0), o3.add_lazy(o2), o5.add_lazy(o4)
+
+
+def _walk_add(P1, P2):
+    """tec.add's lazified interior (canonical-in/canonical-out)."""
+    a_sums = [P1[i].add_lazy(P1[j]) for i, j in ((0, 1), (1, 2), (0, 2))]
+    b_sums = [P2[i].add(P2[j]) for i, j in ((0, 1), (1, 2), (0, 2))]
+    t0 = P1[0].mont_mul(P2[0])
+    t1 = P1[1].mont_mul(P2[1])
+    t2 = P1[2].mont_mul(P2[2])
+    m3 = a_sums[0].mont_mul(b_sums[0])
+    m4 = a_sums[1].mont_mul(b_sums[1])
+    m5 = a_sums[2].mont_mul(b_sums[2])
+    t3 = m3.sub_lazy(t0).sub_lazy(t1)
+    t4 = m4.sub_lazy(t1).sub_lazy(t2)
+    y3 = m5.sub_lazy(t0).sub_lazy(t2)
+    t0 = t0.add(t0).add(t0)
+    t2 = t2.mont_mul(LB.canonical())
+    y3 = y3.mont_mul(LB.canonical())
+    z3 = t1.add(t2)
+    t1 = t1.sub(t2)
+    outs = [t4.mont_mul(y3), t3.mont_mul(t1), y3.mont_mul(t0),
+            t1.mont_mul(z3), t0.mont_mul(t3), z3.mont_mul(t4)]
+    return (outs[1].sub(outs[0]), outs[3].add(outs[2]),
+            outs[5].add(outs[4]))
+
+
+class TestCarryBoundExhaustion:
+    def test_madd_invariant_is_a_fixed_point(self):
+        """Start at the fold invariant (X canonical; Y, Z lazy < 2p),
+        iterate the schedule: bounds must come back AT OR BELOW the
+        invariant every time — carries can never accumulate across fold
+        iterations. Completing without ValueError proves no intermediate
+        limb exceeds LAZY_LIMB_MAX."""
+        X = LB.canonical()
+        Y = Z = LB(tf.LAZY_LIMB_MAX, 2.0)
+        for it in range(32):
+            X, Y, Z = _walk_madd(X, Y, Z)
+            assert X.is_canonical, it
+            assert Y.limb_max <= tf.LAZY_LIMB_MAX and Y.value_p <= 2.0, it
+            assert Z.limb_max <= tf.LAZY_LIMB_MAX and Z.value_p <= 2.0, it
+        # the chain terminator is legal: < 2p normalizes (R4)
+        Y.normalize()
+        Z.normalize()
+
+    def test_add_schedule_canonical_out(self):
+        p1 = [LB.canonical()] * 3
+        p2 = [LB.canonical()] * 3
+        x, y, z = _walk_add(p1, p2)
+        assert x.is_canonical and y.is_canonical and z.is_canonical
+
+    def test_violating_schedules_raise(self):
+        can = LB.canonical()
+        lazy2 = LB(tf.LAZY_LIMB_MAX, 2.0)
+        with pytest.raises(ValueError, match="R1|both operands lazy"):
+            lazy2.add_lazy(lazy2)            # R1: both lazy
+        with pytest.raises(ValueError, match="R2|canonical"):
+            can.sub_lazy(lazy2)              # R2: lazy subtrahend
+        with pytest.raises(ValueError, match="R3|both operands lazy"):
+            lazy2.mont_mul(lazy2)            # R3: both lazy
+        with pytest.raises(ValueError, match="R3|exceeds"):
+            LB(tf.LAZY_LIMB_MAX, 5.5).mont_mul(can)   # R3: value > 5p
+        with pytest.raises(ValueError, match="R4|2p"):
+            LB(tf.LAZY_LIMB_MAX, 3.0).normalize()     # R4: value > 2p
+        with pytest.raises(ValueError, match="LAZY_LIMB_MAX"):
+            LB(tf.LAZY_LIMB_MAX + 1, 1.0).add_lazy(can)   # limb > 2^16
+        with pytest.raises(ValueError, match="overflow"):
+            # un-normalized accumulation blows past 2^256/p
+            LB(tf.LAZY_LIMB_MAX, 4.0).sub_lazy(can).sub_lazy(can)
+
+    def test_skipping_the_madd_mask_invariant_breaks_loudly(self):
+        """Feeding a LAZY value where madd requires canonical X (e.g.
+        reusing an un-normalized accumulator X slot) trips the walk —
+        the exhaustion test would catch a mis-threaded kernel."""
+        bad_X = LB(tf.LAZY_LIMB_MAX, 2.0)
+        Y = Z = LB(tf.LAZY_LIMB_MAX, 2.0)
+        with pytest.raises(ValueError):
+            _walk_madd(bad_X, Y, Z)

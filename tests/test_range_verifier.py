@@ -133,3 +133,63 @@ def test_verify_emits_span_tree_and_batch_record(pp):
     assert rec.total_s > 0 and rec.host_prep_s >= 0
     s = RECORDS.summary()
     assert s["batches"] == 1 and s["cold_compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fixed-base table cache (FTS_TABLE_CACHE_DIR)
+# ---------------------------------------------------------------------------
+
+def test_table_cache_roundtrip(tmp_path, monkeypatch):
+    """uint8 .npz round-trip is bit-exact in both directions and inert
+    when the env opt-in is absent or the digest/flavor differs."""
+    import jax.numpy as jnp
+
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.ops import ec
+
+    monkeypatch.setenv("FTS_TABLE_CACHE_DIR", str(tmp_path))
+    raw = np.random.default_rng(3).integers(
+        0, 256, size=(2, 32, 4, 96), dtype=np.uint8)
+    planes = jnp.asarray(raw).astype(ec.plane_dtype())
+    rv._table_cache_save(16, "cafef00d", "proj", planes)
+    assert list(tmp_path.glob("fbtables_n16_cafef00d_proj.npz"))
+    got = rv._table_cache_load(16, "cafef00d", "proj")
+    assert got is not None and got.dtype == ec.plane_dtype()
+    assert (np.asarray(got.astype(jnp.float32)).astype(np.uint8)
+            == raw).all()
+    # misses: wrong flavor, wrong digest, empty digest
+    assert rv._table_cache_load(16, "cafef00d", "affine") is None
+    assert rv._table_cache_load(16, "0badd00d", "proj") is None
+    assert rv._table_cache_load(16, "", "proj") is None
+    # corrupt file degrades to a rebuild, not a crash
+    f = next(tmp_path.glob("*.npz"))
+    f.write_bytes(b"not an npz")
+    assert rv._table_cache_load(16, "cafef00d", "proj") is None
+    # opt-in absent -> loader and saver are inert
+    monkeypatch.delenv("FTS_TABLE_CACHE_DIR")
+    rv._table_cache_save(16, "cafef00d", "proj", planes)
+    assert rv._table_cache_load(16, "cafef00d", "proj") is None
+
+
+def test_from_pp_serves_tables_from_cache(pp, monkeypatch):
+    """A cache hit must skip the device table build entirely (the >= 2x
+    repeat-run warm-up win) and wire the cached planes straight into the
+    params object."""
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+
+    real = rv._params_for(pp).tables  # built once by the module fixture
+    seen = []
+
+    def fake_load(n, digest, flavor):
+        seen.append((n, digest, flavor))
+        return real
+
+    def boom(*_a, **_k):
+        raise AssertionError("table kernel ran despite a cache hit")
+
+    monkeypatch.setattr(rv, "_table_cache_load", fake_load)
+    monkeypatch.setattr(rv, "_tables_kernel", boom)
+    monkeypatch.setattr(rv, "_raw_tables_kernel", boom)
+    params = rv.RangeVerifierParams.from_pp(pp, cache_digest="cachetest")
+    assert params.tables is real
+    assert seen and seen[0] == (BIT_LENGTH, "cachetest", "proj")
