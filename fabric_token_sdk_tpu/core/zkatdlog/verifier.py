@@ -124,10 +124,11 @@ class ZKVerifier:
         return self._range.kernel_cost(batch_size)
 
     def kernel_cost_fused(self, batch_size: int) -> dict | None:
-        """Fused Pallas kernel cost analysis (mixed-affine fb_msm_t +
-        msm_var_fused) at a bucket; None on CPU/XLA backends where the
-        fused path is off. Duck-typed by the device profiler like
-        ``kernel_cost``."""
+        """Fused device-program cost analysis at a bucket: the merged
+        single-program chunk pipeline (``pass12_fused``, every backend)
+        plus the individual Pallas kernels (``fb_msm_t`` +
+        ``msm_var_fused``, TPU only). Duck-typed by the device profiler
+        like ``kernel_cost``."""
         if self._range is None:
             return None
         return self._range.kernel_cost_fused(batch_size)

@@ -27,6 +27,14 @@ whole batch of proofs:
     (soundness: a false accept requires predicting the weights; failure
     probability <= 2/r per invalid proof, standard batch verification).
 
+    Single-chip, pass 2's var-MSM partial is NOT a separate dispatch:
+    none of its scalars depend on the pass-1 digests (the var terms need
+    x, z, the round challenges — recoverable on device from the round
+    digests — and the RLC weights, drawn at dispatch time), so the whole
+    chunk runs as ONE fused device program with ONE packed upload
+    (_pass12_fused_fn). Only the fixed-generator accumulation and the
+    finalize fold stay split (they sum ACROSS chunks).
+
   Pass 2, exact path: when the combined check rejects — or when the caller
     asks — per-proof windowed MSM identity checks give the bit-exact
     accept/reject vector of the host oracle, proof by proof.
@@ -69,6 +77,27 @@ _FRNATIVE = load_frmont()
 #: dispatched before any sync, so host stage-2 of chunk k overlaps the
 #: device's pass-1 of chunks k+1... (the round-4 profile's host wall).
 _CHUNK_ROWS = max(1, int(os.environ.get("FTS_VERIFY_CHUNK", "256")))
+
+#: test/profiling seam: when set to a callable, the single-chip verify
+#: path reports every host->device upload and device program launch as
+#: _DISPATCH_HOOK(kind), kind in {"chunk_upload", "chunk_dispatch",
+#: "finalize"}. perf_profile.py --mode pipeline and the range_verifier
+#: single-dispatch test install a counter here (monkeypatched, None in
+#: production — zero overhead).
+_DISPATCH_HOOK = None
+
+
+def _count(kind: str) -> None:
+    if _DISPATCH_HOOK is not None:
+        _DISPATCH_HOOK(kind)
+
+
+def _fused_pipeline_enabled() -> bool:
+    """Single-program chunk pipeline (pass-2 var partial merged into the
+    pass-1 chunk program): default on for single-chip on every backend;
+    FTS_NO_FUSED_PIPELINE=1 restores the split per-pass dispatches (the
+    mesh path always keeps them — its var MSM shards over devices)."""
+    return not os.environ.get("FTS_NO_FUSED_PIPELINE")
 
 
 # --------------------------------------------------------------------------
@@ -225,7 +254,7 @@ def _k_pass_kernel(tables, k_idx, k_fixed_sc, dc_pts, dc_sc):
     the jit (k_idx) so no second device-resident copy of the tables exists.
     """
     fixed = ec.fixed_base_msm(jnp.take(tables, k_idx, axis=0), k_fixed_sc)
-    var = ec.msm_windowed(dc_pts, dc_sc)
+    var = ec.msm_var_mixed(dc_pts, dc_sc)
     return ec.add(fixed, var)
 
 
@@ -237,9 +266,13 @@ def _rgp_gather_kernel(tables, rgp_idx, scalars):
 
 @jax.jit
 def _exact_pass_kernel(eq1_pts, eq1_sc, eq2_pts, eq2_sc):
-    """Two per-proof MSM identity checks; returns (B,) bool accept vector."""
-    ok1 = ec.is_identity(ec.msm_windowed(eq1_pts, eq1_sc))
-    ok2 = ec.is_identity(ec.msm_windowed(eq2_pts, eq2_sc))
+    """Two per-proof MSM identity checks; returns (B,) bool accept vector.
+
+    Round 7: the interior is the lazy-carry mixed-affine MSM
+    (ec.msm_var_mixed) — all inputs here are host-marshalled affine
+    points / identities (Z in {1, 0}), its precondition."""
+    ok1 = ec.is_identity(ec.msm_var_mixed(eq1_pts, eq1_sc))
+    ok2 = ec.is_identity(ec.msm_var_mixed(eq2_pts, eq2_sc))
     return jnp.logical_and(ok1, ok2)
 
 
@@ -250,13 +283,19 @@ def _exact_var_tail_kernel(f1_pt, f2_pt, eq1_pts, eq1_sc, eq2_pts, eq2_sc):
     The deterministic exact pass is the adversarial DoS floor (one forged
     proof forces it for its chunk); 87% of its terms are fixed generators,
     so those ride the accumulated Pallas fixed-base kernel and only the
-    ~15 per-proof points stay on the XLA windowed path."""
-    ok1 = ec.is_identity(ec.add(f1_pt, ec.msm_windowed(eq1_pts, eq1_sc)))
-    ok2 = ec.is_identity(ec.add(f2_pt, ec.msm_windowed(eq2_pts, eq2_sc)))
+    ~15 per-proof points stay variable-base — since round 7 on the
+    lazy-carry mixed-affine walk (ec.msm_var_mixed; inputs are
+    host-marshalled affine points, Z in {1, 0})."""
+    ok1 = ec.is_identity(ec.add(f1_pt, ec.msm_var_mixed(eq1_pts, eq1_sc)))
+    ok2 = ec.is_identity(ec.add(f2_pt, ec.msm_var_mixed(eq2_pts, eq2_sc)))
     return jnp.logical_and(ok1, ok2)
 
 
-_var_partial_kernel = jax.jit(ec.msm_windowed)
+# standalone var-MSM dispatch: the legacy split pipeline's pass-2 partial
+# (FTS_NO_FUSED_PIPELINE) and the mesh bisect path. Round 7 swaps the
+# eager one-hot walk for the lazy-carry mixed-affine interior; every
+# caller feeds _reconstruct_points / host-marshalled points (Z in {1, 0}).
+_var_partial_kernel = jax.jit(ec.msm_var_mixed)
 
 
 @jax.jit
@@ -724,57 +763,160 @@ def _round_digests(xy_m, inf, rounds: int):
         msg.reshape(B * rounds, 320)).reshape(B, rounds, 8)
 
 
-_PASS1_FUSED_FNS: dict = {}
+@functools.partial(jax.jit, static_argnames=("rounds",))
+def _derive_var_scalars(sc4, w12, rdig, rounds: int):
+    """Weighted pass-2 var-MSM scalars ON DEVICE — the derivation that
+    lets the var partial ride the pass-1 chunk program: every var term's
+    scalar is a product of phase-a challenges (x, z from sc4), IPA round
+    challenges (recovered from the device round digests, no host round
+    trip) and the per-proof RLC weights (w12, drawn host-side at
+    dispatch time). Nothing here touches the pass-1 x_ipa digests — only
+    the FIXED-generator scalars do, which is why the merge is sound.
+
+    sc4:  (B, 4, 16) plain limbs (y^-1, z, delta, x) — the stage-1 row.
+    w12:  (B, 2, 16) plain limbs (w1, w2); all-zero on padded rows.
+    rdig: (B, rounds, 8) u32 big-endian digest words of the round hashes
+          (_round_digests output).
+
+    Returns (B, nv, 16) plain limbs in the _weight_equations var order
+    [D, C, L_r.., R_r.., T1, T2, Com]:
+        [-x*w2, -w2, -xr^2*w2 .., -xr^-2*w2 .., -x*w1, -x^2*w1, -z^2*w1]
+    bit-identical to the host fr_mul(w, fr_sub(0, s)) path: the round
+    challenge is digest mod R, the Fermat inverse equals fr_batch_inv's,
+    and padded rows carry w = 0 so every scalar there is 0 (their points
+    are identity — exact MSM no-ops).
+    """
+    from ..ops import field
+
+    FR = field.FR
+    B = sc4.shape[0]
+    z_m = field.to_mont(sc4[:, 1], FR)
+    x_m = field.to_mont(sc4[:, 3], FR)
+    w1_m = field.to_mont(w12[:, 0], FR)
+    w2_m = field.to_mont(w12[:, 1], FR)
+
+    # digest words (BE, word 0 most significant) -> 16-bit LE limbs:
+    # limb 2k = lo(word 7-k), limb 2k+1 = hi(word 7-k). The raw 256-bit
+    # value is < 2^256 ~ 5.3R; one conditional subtract brings it under
+    # 2^256 - R < 5R, inside mont_mul's single-lazy-operand value bound
+    # (rule R3, ops/field.py), so to_mont lands exactly on
+    # to_mont(digest mod R) — no full reduction needed.
+    lim = jnp.stack([rdig & 0xFFFF, rdig >> 16], axis=-1)
+    lim = lim[..., ::-1, :].reshape(B, rounds, limbs.NLIMBS)
+    dig = field._cond_sub_mod(
+        jnp.concatenate(
+            [lim, jnp.zeros((B, rounds, 1), dtype=jnp.uint32)], axis=-1),
+        FR)
+    xr_m = field.to_mont(dig, FR)
+    xrinv_m = field.inv(xr_m, FR)     # one vectorized Fermat chain
+
+    w2b = jnp.broadcast_to(w2_m[:, None], xr_m.shape)
+    head = jnp.stack([field.mont_mul(x_m, w2_m, FR), w2_m], axis=1)
+    mid = jnp.concatenate(
+        [field.mont_mul(field.mont_mul(xr_m, xr_m, FR), w2b, FR),
+         field.mont_mul(field.mont_mul(xrinv_m, xrinv_m, FR), w2b, FR)],
+        axis=1)
+    tail = jnp.stack(
+        [field.mont_mul(x_m, w1_m, FR),
+         field.mont_mul(field.mont_mul(x_m, x_m, FR), w1_m, FR),
+         field.mont_mul(field.mont_mul(z_m, z_m, FR), w1_m, FR)], axis=1)
+    prod_m = jnp.concatenate([head, mid, tail], axis=1)   # (B, nv, 16)
+    # every var term is the NEGATIVE of the product above; neg commutes
+    # with from_mont, so one uniform neg covers the whole layout
+    return field.from_mont(field.neg(prod_m, FR), FR)
 
 
-def _pass1_fused_fn(params):
-    """ONE jitted device program for a whole chunk's pass-1 (TPU path):
-    unpack the single uploaded u32 row -> derive scalar vectors -> Pallas
-    fixed-base folds -> affine bytes -> transcript SHA. Collapses ~12
-    dispatches + 4 uploads per chunk into 1 + 1 — per-call tunnel latency
+_PASS12_FUSED_FNS: dict = {}
+
+
+def _pass12_fused_fn(params):
+    """ONE jitted device program for a whole chunk's pass-1 AND its
+    pass-2 var-MSM partial (the single-program chunk pipeline): unpack
+    the single uploaded u32 row -> derive pass-1 scalar vectors ->
+    fixed-base folds -> affine bytes -> transcript SHA -> round digests
+    -> weighted var scalars -> var-MSM partial. One dispatch + one
+    packed upload per chunk where the round-6 pipeline issued ~3 calls
+    + 1 upload (fused pass-1 program, then a weighted-scalar upload and
+    a var-MSM dispatch after the host sync) — per-call tunnel latency
     (measured ~2.5 ms/dispatch, ~6.5 ms/device_put) was the next wall.
 
+    Both backends share the program STRUCTURE; only the kernel bodies
+    switch: TPU runs the Pallas VMEM kernels, CPU/XLA the gather +
+    msm_var_mixed twins — so the merged pipeline (including the device
+    round-digest and var-scalar derivations) is exercised by the CPU CI,
+    not only on chip.
+
     Packed row layout (u32): [sc4 64 | xy-as-u16-pairs nv*2*8 | inf nv |
-    ip 8]. Returns ((B, 8) digests, (B, nv, 3, 16) projective points).
+    ip 8 | w12 32]. Returns ((B, 8) x_ipa digests, (B, rounds, 8) round
+    digests, (B, nv, 3, 16) projective points, (3, 16) var partial).
     """
-    key = (params.bit_length, params.q_bytes, params.left_gen_bytes)
-    if key in _PASS1_FUSED_FNS:
-        return _PASS1_FUSED_FNS[key]
-    from ..ops import pallas_fb
+    pallas_on = params.tables_t_rgp is not None
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes,
+           pallas_on)
+    if key in _PASS12_FUSED_FNS:
+        return _PASS12_FUSED_FNS[key]
 
     n = params.bit_length
-    nv = 2 + 2 * params.rounds + 3
+    rr = params.rounds
+    nv = 2 + 2 * rr + 3
     xipa = _xipa_device_fn(params)
     o_xy = 64
     o_inf = o_xy + nv * 16
     o_ip = o_inf + nv
+    o_w = o_ip + 8
 
-    @jax.jit
-    def run(tables_t_rgp, tables_t_k, packed):
+    def body(packed, rgp_fn, kfixed_fn, mul2_fn, var_fn):
         B = packed.shape[0]
         sc4 = packed[:, :o_xy].reshape(B, 4, limbs.NLIMBS)
         xyw = packed[:, o_xy:o_inf].reshape(B, nv, 2, 8)
         xy = jnp.stack([xyw & 0xFFFF, xyw >> 16], axis=-1).reshape(
             B, nv, 2, limbs.NLIMBS)
         inf = packed[:, o_inf:o_ip].astype(jnp.uint8)
-        ipw = packed[:, o_ip:o_ip + 8]
+        ipw = packed[:, o_ip:o_w]
         ip_u8 = jnp.stack(
             [ipw & 0xFF, (ipw >> 8) & 0xFF, (ipw >> 16) & 0xFF,
              ipw >> 24], axis=-1).reshape(B, 32).astype(jnp.uint8)
+        w12 = packed[:, o_w:].reshape(B, 2, limbs.NLIMBS)
 
         yinv, k_fixed, dc_sc = _derive_pass1_scalars(sc4, n)
         pts = _reconstruct_points(xy, inf)
-        rgp_pts = pallas_fb.fixed_base_gather_fused(tables_t_rgp, yinv)
-        k_pt = ec.add(
-            pallas_fb.fixed_base_msm_fused(tables_t_k, k_fixed),
-            pallas_fb.mul2_rows_fused(pts[:, :2], dc_sc))
-        digests = xipa(_limbs_to_bytes_dev(ec.to_affine_batch(rgp_pts)),
-                       _limbs_to_bytes_dev(ec.to_affine(k_pt)), ip_u8)
-        rdig = _round_digests(xy, inf, params.rounds)
-        return digests, rdig, pts
+        k_pt = ec.add(kfixed_fn(k_fixed), mul2_fn(pts[:, :2], dc_sc))
+        digests = xipa(
+            _limbs_to_bytes_dev(ec.to_affine_batch(rgp_fn(yinv))),
+            _limbs_to_bytes_dev(ec.to_affine(k_pt)), ip_u8)
+        rdig = _round_digests(xy, inf, rr)
+        var_sc = _derive_var_scalars(sc4, w12, rdig, rr)
+        partial = var_fn(pts.reshape(B * nv, 3, limbs.NLIMBS),
+                         var_sc.reshape(B * nv, limbs.NLIMBS))
+        return digests, rdig, pts, partial
 
-    _PASS1_FUSED_FNS[key] = (run, nv, o_inf, o_ip)
-    return _PASS1_FUSED_FNS[key]
+    if pallas_on:
+        from ..ops import pallas_fb
+
+        @jax.jit
+        def run(t_rgp, t_k, packed):
+            return body(
+                packed,
+                lambda yinv: pallas_fb.fixed_base_gather_fused(t_rgp,
+                                                               yinv),
+                lambda kf: pallas_fb.fixed_base_msm_fused(t_k, kf),
+                pallas_fb.mul2_rows_fused,
+                pallas_fb.msm_var_fused)
+    else:
+
+        @jax.jit
+        def run(tables, rgp_idx, k_idx, packed):
+            return body(
+                packed,
+                lambda yinv: ec.fixed_base_gather(
+                    jnp.take(tables, rgp_idx, axis=0), yinv),
+                lambda kf: ec.fixed_base_msm(
+                    jnp.take(tables, k_idx, axis=0), kf),
+                ec.msm_var_mixed,
+                ec.msm_var_mixed)
+
+    _PASS12_FUSED_FNS[key] = (run, nv, o_inf, o_ip, o_w)
+    return _PASS12_FUSED_FNS[key]
 
 
 @jax.jit
@@ -969,6 +1111,25 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
     return _ProofEquations(fixed=fixed, var=var)
 
 
+@dataclass
+class _ChunkStage:
+    """Stage-1 state of one chunk in the single-program pipeline.
+
+    ``partial``/``weights`` are populated only on the merged path
+    (_pass12_fused_fn): the pass-2 var-MSM partial is already computed by
+    the stage-1 dispatch, and the RLC weights it used (drawn host-side at
+    dispatch time) are kept so stage 2 can accumulate the matching
+    fixed-generator scalars. On the legacy split path both are None and
+    stage 2 dispatches _combined_chunk as before."""
+
+    transcripts: dict
+    digests_dev: object          # (B, 8) x_ipa digest words, device
+    rdig_dev: object | None      # (B, rounds, 8) round digests, device
+    pts_dev: object              # (B, nv, 3, 16) projective proof points
+    partial: object | None       # (3, 16) weighted var-MSM chunk partial
+    weights: dict | None         # {proof_idx: (w1, w2)} ints
+
+
 def _make_sharded_combined(mesh, fused: bool = False):
     """Sharded RLC pass: var-MSM terms sharded over EVERY mesh device;
     each device runs the windowed MSM on its term shard, partial points
@@ -990,7 +1151,7 @@ def _make_sharded_combined(mesh, fused: bool = False):
 
             partial = pallas_fb.msm_var_fused(pts, sc)  # local term shard
         else:
-            partial = ec.msm_windowed(pts, sc)
+            partial = ec.msm_var_mixed(pts, sc)
         gathered = jax.lax.all_gather(partial, axes)  # (ndev, 3, 16)
         total = ec._tree_sum_shrink(gathered)
         return ec.is_identity(ec.add(fixed_pt, total))
@@ -1064,13 +1225,19 @@ class BatchRangeVerifier:
         self._combined_sharded = (
             _make_sharded_combined(mesh, fused=self._fused_sharded)
             if mesh is not None else None)
-        #: which pass-2 strategy the last verify() used ("combined",
-        #: "exact", or "structure-only"); exposed for tests/metrics.
+        #: which verification strategy decided the last verify():
+        #: "combined" (the RLC identity — computed inside the stage-1
+        #: merged chunk program on the default single-chip path, or by
+        #: the split dispatch under a mesh / FTS_NO_FUSED_PIPELINE),
+        #: "exact" (per-proof checks ran, whether requested or forced by
+        #: a rejecting RLC), or "structure-only" (nothing reached the
+        #: device). Exposed for tests/metrics.
         self.last_path: str | None = None
 
     def _put_rows(self, arr: np.ndarray) -> jnp.ndarray:
         """Upload with the batch axis sharded over the whole mesh (or
         plain device_put single-chip)."""
+        _count("chunk_upload")
         if self.mesh is None:
             return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1113,9 +1280,16 @@ class BatchRangeVerifier:
         return out
 
     def kernel_cost(self, batch_size: int) -> dict | None:
-        """XLA cost analysis (FLOPs, bytes accessed) of the dominant
-        pass-2 kernel — the per-chunk variable-base windowed MSM — at the
-        padded chunk bucket covering ``batch_size``.
+        """XLA cost analysis (FLOPs, bytes accessed) of the standalone
+        per-chunk variable-base MSM (``ec.msm_var_mixed``) at the padded
+        chunk bucket covering ``batch_size``.
+
+        Since round 7 this kernel is no longer a separate hot-path
+        dispatch — the default single-chip pipeline computes the var
+        partial inside the merged chunk program (see
+        ``kernel_cost_fused``'s ``pass12_fused`` kind for that cost) —
+        but the same MSM body still runs standalone on the mesh, bisect
+        and FTS_NO_FUSED_PIPELINE paths, so its roofline stays tracked.
 
         Lowering only, never compiles: ``jit(...).lower`` traces the
         kernel against ShapeDtypeStructs and ``Lowered.cost_analysis``
@@ -1136,7 +1310,7 @@ class BatchRangeVerifier:
                 cost = cost[0] if cost else None
             if not isinstance(cost, dict):
                 return None
-            return {"kernel": "msm_windowed", "chunk_rows": rows,
+            return {"kernel": "msm_var_mixed", "chunk_rows": rows,
                     "points": rows * nv,
                     "flops": cost.get("flops"),
                     "bytes_accessed": cost.get(
@@ -1145,42 +1319,72 @@ class BatchRangeVerifier:
             return None
 
     def kernel_cost_fused(self, batch_size: int) -> dict | None:
-        """Cost analysis of the fused Pallas kernels (mixed-affine
-        fixed-base MSM ``fb_msm_t`` and the variable-base ``msm_var_fused``)
-        at the padded chunk bucket covering ``batch_size``.
+        """Cost analysis of the fused device programs at the padded chunk
+        bucket covering ``batch_size``.
 
-        Same lower-only discipline as ``kernel_cost``; each kernel's
-        estimate is published on the stable ``profile_bucket_*`` families
-        under its own ``kind`` label (obs/profiling.py). Returns
-        ``{kind: cost_dict}`` for whichever kernels lowered, or None when
-        the fused path is off (CPU/XLA backends)."""
+        Kinds: ``pass12_fused`` is the merged single-program chunk
+        pipeline (pass-1 + weighted pass-2 var partial, one dispatch) —
+        available on EVERY backend, since the CPU/XLA flavor runs the
+        same program structure with XLA kernel bodies; ``fb_msm_t`` and
+        ``msm_var_fused`` are the individual Pallas kernels and lower on
+        the TPU path only.
+
+        Same lower-only discipline as ``kernel_cost``; each estimate is
+        published on the stable ``profile_bucket_*`` families under its
+        own ``kind`` label (obs/profiling.py) — no new metric family.
+        Returns ``{kind: cost_dict}`` for whichever programs lowered, or
+        None."""
         params = self.params
-        if params.tables_t_k is None:
-            return None
         try:
             from ..obs.profiling import PROFILER
-            from ..ops import pallas_fb
 
             rows = _bucket_rows(min(int(batch_size), _CHUNK_ROWS))
-            tk = jax.ShapeDtypeStruct(params.tables_t_k.shape,
-                                      params.tables_t_k.dtype)
-            sc_k = jax.ShapeDtypeStruct(
-                (rows, params.tables_t_k.shape[0], limbs.NLIMBS),
-                jnp.uint32)
             nv = 2 + 2 * params.rounds + 3
-            vp = jax.ShapeDtypeStruct((rows * nv, 3, limbs.NLIMBS),
-                                      jnp.uint32)
-            vs = jax.ShapeDtypeStruct((rows * nv, limbs.NLIMBS),
-                                      jnp.uint32)
             out = {}
-            c = PROFILER.capture_kernel_cost(
-                "fb_msm_t", rows, pallas_fb.fixed_base_msm_fused, tk, sc_k)
+
+            run, _nv, _oi, _op, o_w = _pass12_fused_fn(params)
+            packed = jax.ShapeDtypeStruct((rows, o_w + 32), jnp.uint32)
+            if params.tables_t_rgp is not None:
+                args = (jax.ShapeDtypeStruct(params.tables_t_rgp.shape,
+                                             params.tables_t_rgp.dtype),
+                        jax.ShapeDtypeStruct(params.tables_t_k.shape,
+                                             params.tables_t_k.dtype),
+                        packed)
+            else:
+                args = (jax.ShapeDtypeStruct(params.tables.shape,
+                                             params.tables.dtype),
+                        jax.ShapeDtypeStruct(params.rgp_idx.shape,
+                                             params.rgp_idx.dtype),
+                        jax.ShapeDtypeStruct(params.k_idx.shape,
+                                             params.k_idx.dtype),
+                        packed)
+            c = PROFILER.capture_kernel_cost("pass12_fused", rows, run,
+                                             *args)
             if c is not None:
-                out["fb_msm_t"] = c
-            c = PROFILER.capture_kernel_cost(
-                "msm_var_fused", rows, pallas_fb.msm_var_fused, vp, vs)
-            if c is not None:
-                out["msm_var_fused"] = c
+                out["pass12_fused"] = c
+
+            if params.tables_t_k is not None:
+                from ..ops import pallas_fb
+
+                tk = jax.ShapeDtypeStruct(params.tables_t_k.shape,
+                                          params.tables_t_k.dtype)
+                sc_k = jax.ShapeDtypeStruct(
+                    (rows, params.tables_t_k.shape[0], limbs.NLIMBS),
+                    jnp.uint32)
+                vp = jax.ShapeDtypeStruct((rows * nv, 3, limbs.NLIMBS),
+                                          jnp.uint32)
+                vs = jax.ShapeDtypeStruct((rows * nv, limbs.NLIMBS),
+                                          jnp.uint32)
+                c = PROFILER.capture_kernel_cost(
+                    "fb_msm_t", rows, pallas_fb.fixed_base_msm_fused,
+                    tk, sc_k)
+                if c is not None:
+                    out["fb_msm_t"] = c
+                c = PROFILER.capture_kernel_cost(
+                    "msm_var_fused", rows, pallas_fb.msm_var_fused,
+                    vp, vs)
+                if c is not None:
+                    out["msm_var_fused"] = c
             return out or None
         except Exception:
             return None
@@ -1194,11 +1398,13 @@ class BatchRangeVerifier:
         (or when exact=True).
 
         Single-chip, the batch runs as a PIPELINE of row chunks: every
-        chunk's pass-1 kernels are dispatched up front (async), so the
-        host's challenge hashing + scalar expansion for chunk k overlaps
-        the device's pass-1 of chunks k+1... and each chunk's weighted
-        var-MSM partial is dispatched as soon as its scalars exist. The
-        mesh path keeps one chunk (rows shard over devices instead).
+        chunk goes up as ONE packed upload + ONE fused device program
+        that covers pass-1 AND the chunk's weighted var-MSM partial
+        (dispatched async up front), so the host's challenge hashing +
+        fixed-scalar accumulation for chunk k overlaps the device's work
+        on chunks k+1... Only the cross-chunk finalize fold stays a
+        separate dispatch. The mesh path keeps one chunk (rows shard
+        over devices instead) and the split per-stage dispatches.
 
         Observability: each call produces one span tree (root
         "range_verify" with host_prep / device_execute / result_fetch
@@ -1278,8 +1484,19 @@ class BatchRangeVerifier:
                 equations.update(eqs_ch)
                 if not exact and self.mesh is None:
                     acc = zero_acc if zero_acc is not None else [0] * n_fixed
-                    acc, part = self._combined_chunk(
-                        proofs, commitments, ch, eqs_ch, acc, st[3])
+                    if st.partial is not None:
+                        # merged pipeline: the chunk's var partial was
+                        # computed by the stage-1 dispatch; only the
+                        # fixed-generator accumulation (host scalar
+                        # arithmetic, same weights) happens here.
+                        acc, _, _ = self._weight_equations(
+                            proofs, commitments, ch, eqs_ch, acc,
+                            weights=st.weights, want_var=False)
+                        part = st.partial
+                    else:
+                        acc, part = self._combined_chunk(
+                            proofs, commitments, ch, eqs_ch, acc,
+                            st.pts_dev)
                     chunk_rlc.append((ch, acc, part))
 
         # ---- pass 2
@@ -1337,10 +1554,18 @@ class BatchRangeVerifier:
 
     # ------------------------------------------------------------------
     def _dispatch_pass1(self, proofs, commitments, ch):
-        """Host phase-a + marshal for one chunk, then async dispatch of the
-        pass-1 kernels; returns (transcripts, digests_dev (B, 8) x_ipa
-        digest words, pts_proj (B, nv, 3, 16) device-resident proof
-        points) with the digest device->host copy already in flight."""
+        """Host phase-a + marshal for one chunk, then async dispatch of
+        the chunk's device work; returns a _ChunkStage with the digest
+        device->host copies already in flight.
+
+        Single-chip with the pipeline enabled (default) this is ONE
+        packed upload + ONE fused device program covering pass-1 AND the
+        chunk's weighted pass-2 var-MSM partial — the RLC weights are
+        drawn here, ride the packed row, and are kept on the stage for
+        the host-side fixed-scalar accumulation in stage 2. The mesh
+        path and the FTS_NO_FUSED_PIPELINE escape keep the split
+        uploads/dispatches (partial=None -> stage 2 runs
+        _combined_chunk)."""
         params = self.params
         n = params.bit_length
         xyz = _phase_a_challenges_batch(proofs, commitments, ch)
@@ -1390,22 +1615,41 @@ class BatchRangeVerifier:
             b"".join(ser.zr_to_bytes(proofs[i].data.inner_product)
                      for i in ch), dtype=np.uint8).reshape(len(ch), 32)
 
-        if params.tables_t_rgp is not None and self.mesh is None:
-            # TPU fast path: ONE packed upload + ONE fused device program
-            # per chunk (per-call tunnel latency is a measured cost)
-            run, nv_, o_inf, o_ip = _pass1_fused_fn(params)
-            packed = np.zeros((len(ch), o_ip + 8), dtype=np.uint32)
+        partial = weights = None
+        if self.mesh is None and _fused_pipeline_enabled():
+            # single-program chunk pipeline: ONE packed upload + ONE
+            # fused device program per chunk covering pass-1 AND the
+            # weighted pass-2 var partial (per-call tunnel latency is a
+            # measured cost). The RLC weights are drawn NOW — none of
+            # the var scalars need the pass-1 digests, which is what
+            # makes the merge sound (see _derive_var_scalars).
+            weights = {i: (1 + secrets.randbelow(R - 1),
+                           1 + secrets.randbelow(R - 1)) for i in ch}
+            run, nv_, o_inf, o_ip, o_w = _pass12_fused_fn(params)
+            packed = np.zeros((len(ch), o_w + 32), dtype=np.uint32)
             packed[:, :64] = sc4_np.reshape(len(ch), 64)
             xyu16 = proj[:, :, :2].astype("<u2")          # (L, nv, 2, 16)
             packed[:, 64:o_inf] = np.ascontiguousarray(
                 xyu16.reshape(len(ch), -1)).view("<u4")
             packed[:, o_inf:o_ip] = inf_np
-            packed[:, o_ip:] = np.ascontiguousarray(ip_np).view("<u4")
-            pad_row = np.zeros(o_ip + 8, dtype=np.uint32)
-            pad_row[o_inf:o_ip] = 1                        # identity points
-            digests_dev, rdig_dev, pts_proj = run(
-                params.tables_t_rgp, params.tables_t_k,
-                jnp.asarray(_pad_rows(packed, b_bucket, pad_row)))
+            packed[:, o_ip:o_w] = np.ascontiguousarray(ip_np).view("<u4")
+            packed[:, o_w:] = limbs.packed_to_limbs(
+                b"".join(w1.to_bytes(32, "little")
+                         + w2.to_bytes(32, "little")
+                         for w1, w2 in (weights[i] for i in ch))
+            ).reshape(len(ch), 32)
+            pad_row = np.zeros(o_w + 32, dtype=np.uint32)
+            pad_row[o_inf:o_ip] = 1        # identity points, zero weights
+            _count("chunk_upload")
+            packed_dev = jnp.asarray(_pad_rows(packed, b_bucket, pad_row))
+            _count("chunk_dispatch")
+            if params.tables_t_rgp is not None:     # Pallas kernel bodies
+                digests_dev, rdig_dev, pts_proj, partial = run(
+                    params.tables_t_rgp, params.tables_t_k, packed_dev)
+            else:                                   # XLA twin bodies
+                digests_dev, rdig_dev, pts_proj, partial = run(
+                    params.tables, params.rgp_idx, params.k_idx,
+                    packed_dev)
         else:
             rdig_dev = None
             sc4 = self._put_rows(_pad_rows(sc4_np, b_bucket, zero_sc))
@@ -1420,9 +1664,12 @@ class BatchRangeVerifier:
             yinv, k_fixed, dc_sc = _derive_pass1_scalars(sc4, n)
             pts_proj = _reconstruct_points(xy, inf)      # (B, nv, 3, 16)
             dc_pts = pts_proj[:, :2]
+            for _ in range(2):          # derive + reconstruct dispatches
+                _count("chunk_dispatch")
 
             if self._pass1_sharded is not None:
                 # fused Pallas kernels per device under the mesh
+                _count("chunk_dispatch")
                 digests_dev = self._pass1_sharded(
                     params.tables_t_rgp, params.tables_t_k, yinv, k_fixed,
                     dc_pts, dc_sc, ip_dev)
@@ -1434,12 +1681,15 @@ class BatchRangeVerifier:
                 digests_dev = _xipa_device_fn(params)(
                     _affine_bytes_rows_kernel(rgp_pts),
                     _affine_bytes_kernel(k_pt), ip_dev)
+                for _ in range(5):      # gather, K, 2x bytes, xipa
+                    _count("chunk_dispatch")
         for arr in (digests_dev, rdig_dev):
             try:
                 arr.copy_to_host_async()
             except (AttributeError, NotImplementedError, TypeError):
                 pass
-        return transcripts, digests_dev, rdig_dev, pts_proj
+        return _ChunkStage(transcripts, digests_dev, rdig_dev, pts_proj,
+                           partial, weights)
 
     def _host_stage2(self, proofs, ch, st) -> dict:
         """Challenges (vectorized) + per-proof scalar expansion for one
@@ -1448,7 +1698,8 @@ class BatchRangeVerifier:
 
         params = self.params
         rr = params.rounds
-        transcripts, digests_dev, rdig_dev, _pts = st
+        transcripts = st.transcripts
+        digests_dev, rdig_dev = st.digests_dev, st.rdig_dev
         if rdig_dev is None:
             # XLA/mesh path: round challenges hashed on host (proof bytes
             # only — run BEFORE blocking on the device transfer)
@@ -1478,37 +1729,53 @@ class BatchRangeVerifier:
         return eqs
 
     def _weight_equations(self, proofs, commitments, ch, equations,
-                          fixed_acc):
-        """RLC-weight one row set: per-proof fresh (w1, w2), fixed-generator
+                          fixed_acc, weights=None, want_var=True):
+        """RLC-weight one row set: per-proof (w1, w2), fixed-generator
         scalars accumulated into fixed_acc on host, weighted var scalars
         collected. Returns (fixed_acc, var_pts, var_scalar_limbs_fn).
 
         Shared by the single-chip chunk pipeline and the sharded full
-        pass — the weight layout lives HERE only.
+        pass — the weight layout lives HERE only. ``weights`` (a
+        {proof_idx: (w1, w2)} dict) replays the weights a merged stage-1
+        dispatch already committed to on device; in that case the var
+        scalars were derived there too, so callers pass want_var=False
+        and get (fixed_acc, None, None) — host work drops to the fixed
+        accumulation only. Without ``weights``, fresh per-proof randoms
+        are drawn here (legacy split path, mesh path).
         """
         params = self.params
         n = params.bit_length
         n_eq2 = 2 + 2 * params.rounds
 
         var_pts: list = []
-        for i in ch:
-            d = proofs[i].data
-            var_pts.extend([d.D, d.C] + proofs[i].ipa.L + proofs[i].ipa.R
-                           + [d.T1, d.T2, commitments[i]])
+        if want_var:
+            for i in ch:
+                d = proofs[i].data
+                var_pts.extend([d.D, d.C] + proofs[i].ipa.L
+                               + proofs[i].ipa.R
+                               + [d.T1, d.T2, commitments[i]])
+
+        def draw(i):
+            if weights is not None:
+                return weights[i]
+            return (1 + secrets.randbelow(R - 1),
+                    1 + secrets.randbelow(R - 1))
 
         if _FRNATIVE is not None:
             var_sc_packed: list[bytes] = []
             zero32 = bytes(32)
             for i in ch:
-                w1 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
-                w2 = (1 + secrets.randbelow(R - 1)).to_bytes(32, "little")
+                w1i, w2i = draw(i)
+                w1 = w1i.to_bytes(32, "little")
+                w2 = w2i.to_bytes(32, "little")
                 eq = equations[i]
                 # fixed layout: G(n), H(n), P, Q @ w2 | cg0, cg1 @ w1 | S_G
-                weights = w2 * (2 * n + 2) + w1 * 2 + zero32
+                wts = w2 * (2 * n + 2) + w1 * 2 + zero32
                 fixed_acc = _FRNATIVE.addmul_many(
-                    fixed_acc, eq.fixed_packed, weights)
-                var_sc_packed.append(_FRNATIVE.mul_many(
-                    eq.var_packed, w2 * n_eq2 + w1 * 3))
+                    fixed_acc, eq.fixed_packed, wts)
+                if want_var:
+                    var_sc_packed.append(_FRNATIVE.mul_many(
+                        eq.var_packed, w2 * n_eq2 + w1 * 3))
             sc_blob = b"".join(var_sc_packed)
 
             def var_scalar_limbs(n_pad: int) -> np.ndarray:
@@ -1516,8 +1783,7 @@ class BatchRangeVerifier:
         else:
             var_sc: list[int] = []
             for i in ch:
-                w1 = 1 + secrets.randbelow(R - 1)
-                w2 = 1 + secrets.randbelow(R - 1)
+                w1, w2 = draw(i)
                 eq = equations[i]
                 for j in range(2 * n + 2):
                     fixed_acc[j] = fr_add(fixed_acc[j],
@@ -1525,29 +1791,37 @@ class BatchRangeVerifier:
                 for j in (2 * n + 2, 2 * n + 3):
                     fixed_acc[j] = fr_add(fixed_acc[j],
                                           fr_mul(w1, eq.fixed[j]))
-                weights = [w2] * n_eq2 + [w1] * 3
-                var_sc.extend(fr_mul(w, s)
-                              for w, s in zip(weights, equations[i].var))
+                if want_var:
+                    wts = [w2] * n_eq2 + [w1] * 3
+                    var_sc.extend(fr_mul(w, s)
+                                  for w, s in zip(wts, equations[i].var))
 
             def var_scalar_limbs(n_pad: int) -> np.ndarray:
                 return limbs.scalars_to_limbs(var_sc + [0] * n_pad)
 
+        if not want_var:
+            return fixed_acc, None, None
         return fixed_acc, var_pts, var_scalar_limbs
 
     def _combined_chunk(self, proofs, commitments, ch, equations,
                         fixed_acc, pts_dev):
-        """Weight one chunk's equations into the running RLC and dispatch
-        the chunk's var-MSM partial on device. The var POINTS are the
-        stage-1 device upload (pts_dev (b_bucket, 17, 3, 16), identity on
-        padded rows) — only the weighted scalars go up here. Returns
-        (fixed_acc, partial_device_point)."""
+        """LEGACY split pass-2 (mesh / FTS_NO_FUSED_PIPELINE): weight one
+        chunk's equations into the running RLC and dispatch the chunk's
+        var-MSM partial on device. The var POINTS are the stage-1 device
+        upload (pts_dev (b_bucket, 17, 3, 16), identity on padded rows) —
+        only the weighted scalars go up here. Returns (fixed_acc,
+        partial_device_point). The default single-chip path computes the
+        partial inside the stage-1 merged program instead
+        (_pass12_fused_fn) and never reaches this."""
         params = self.params
         fixed_acc, var_pts, var_scalar_limbs = self._weight_equations(
             proofs, commitments, ch, equations, fixed_acc)
 
         b_bucket, nv = pts_dev.shape[0], pts_dev.shape[1]
         n_pad = b_bucket * nv - len(var_pts)
+        _count("chunk_upload")
         sc = jnp.asarray(var_scalar_limbs(n_pad))
+        _count("chunk_dispatch")
         flat_pts = pts_dev.reshape(b_bucket * nv, 3, limbs.NLIMBS)
         if params.tables_t_rgp is not None:
             from ..ops import pallas_fb
@@ -1563,6 +1837,7 @@ class BatchRangeVerifier:
         fixed_np = (limbs.packed_to_limbs(fixed_acc)
                     if _FRNATIVE is not None
                     else limbs.scalars_to_limbs(fixed_acc))
+        _count("finalize")
         parts = jnp.stack(partials)
         return bool(_finalize_kernel(self.params.tables,
                                      jnp.asarray(fixed_np), parts))

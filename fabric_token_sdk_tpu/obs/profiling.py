@@ -18,9 +18,13 @@ Exported families (stable names, see ROADMAP):
   profile_device_peak_bytes{device}        allocator watermark (peak)
   profile_compile_cache_total{kind,event}  hit/miss at dispatch
 
-The fused Pallas kernels (mixed-affine ``fb_msm_t``, ``msm_var_fused``)
-report on the same families under their own ``kind`` label values —
-never as new families (the exposition names are a stable contract).
+The fused device programs report on the same families under their own
+``kind`` label values — never as new families (the exposition names are
+a stable contract): ``pass12_fused`` is the merged single-program chunk
+pipeline (pass-1 + weighted var-MSM partial, one dispatch; available on
+every backend since the CPU flavor runs the same program with XLA kernel
+bodies), while the individual Pallas kernels (mixed-affine ``fb_msm_t``,
+``msm_var_fused``) lower on the TPU path only.
 """
 
 from __future__ import annotations
@@ -126,13 +130,15 @@ class DeviceProfiler:
         return cost
 
     def capture_fused_costs(self, zk, bucket: int) -> dict | None:
-        """Capture the fused Pallas kernel estimates at a bucket, when the
-        verifier runs the mixed-affine Pallas path (duck-typed
-        ``kernel_cost_fused``). Each kernel publishes on the SAME stable
-        ``profile_bucket_*`` families as the XLA path, under its own kind
-        label (``kind="fb_msm_t"`` / ``kind="msm_var_fused"``) — new label
-        values, not new families. None on CPU/XLA backends or shims
-        without the hook."""
+        """Capture the fused device-program estimates at a bucket
+        (duck-typed ``kernel_cost_fused``). Each program publishes on the
+        SAME stable ``profile_bucket_*`` families as the standalone
+        kernels, under its own kind label — new label values, not new
+        families: ``kind="pass12_fused"`` (the merged single-program
+        chunk pipeline; published on EVERY backend, the CPU flavor runs
+        the same program structure with XLA kernel bodies) plus
+        ``kind="fb_msm_t"`` / ``kind="msm_var_fused"`` where the Pallas
+        path is on (TPU). None only on shims without the hook."""
         fn = getattr(zk, "kernel_cost_fused", None)
         if not callable(fn):
             return None

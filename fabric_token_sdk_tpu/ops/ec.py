@@ -47,22 +47,16 @@ def is_identity(p: jnp.ndarray) -> jnp.ndarray:
     return field.is_zero(p[..., _Z, :])
 
 
-def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Complete projective addition (RCB15 Algorithm 7, a=0, b3=9).
+def _add_complete(p: jnp.ndarray, q: jnp.ndarray,
+                  z_lazy_out: bool) -> jnp.ndarray:
+    """Shared interior of `add` / `add_zlazy` (RCB15 Alg 7, 6+2+6 muls).
 
-    Valid unconditionally for all inputs, including p == q (doubling),
-    p == -q (yields the identity), and either operand the identity.
-
-    The 14 field multiplications are grouped into THREE stacked mont_mul
-    calls (6 + 2 + 6 independent products batched along a new leading axis):
-    the traced graph shrinks ~3x — which is what keeps the 256-step
-    scalar/MSM loop bodies fast to compile — and the wider batches fill
-    VPU lanes better at small batch sizes.
-
-    Canonical limbs in/out, but the interior runs in lazy-carry form
-    (field.add_lazy / sub_lazy, rules R1-R4 in ops/tfield.py): the
-    a1-side sums and t3/t4/y3 skip the carry lookahead + conditional
-    subtract and enter the next mont_mul as its single lazy operand.
+    Accepts p with Z in LAZY form (limbs <= 2^16, value < 2p): Z1 enters
+    mont_mul as its single lazy operand (rule R3) and the a1-side sums
+    add_lazy it against a canonical coordinate (rule R1, < 3p). q must
+    be fully canonical (its sums ride the exact adder on the b1 side).
+    With z_lazy_out the output Z skips the exact carry resolution and
+    stays lazy (< 2p) for the next chained `add_zlazy`.
     """
     X1, Y1, Z1 = p[..., _X, :], p[..., _Y, :], p[..., _Z, :]
     X2, Y2, Z2 = q[..., _X, :], q[..., _Y, :], q[..., _Z, :]
@@ -95,8 +89,47 @@ def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     o = field.mont_mul(a3, b3v, FP)
     x3 = subf(o[1], o[0])                # t3*t1 - t4*y3
     y3o = addf(o[3], o[2])               # t1*z3 + y3*t0
-    z3o = addf(o[5], o[4])               # z3*t4 + t0*t3
+    if z_lazy_out:
+        z3o = field.add_lazy(o[5], o[4])  # z3*t4 + t0*t3  (lazy, < 2p)
+    else:
+        z3o = addf(o[5], o[4])           # z3*t4 + t0*t3
     return jnp.stack([x3, y3o, z3o], axis=-2)
+
+
+def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Complete projective addition (RCB15 Algorithm 7, a=0, b3=9).
+
+    Valid unconditionally for all inputs, including p == q (doubling),
+    p == -q (yields the identity), and either operand the identity.
+
+    The 14 field multiplications are grouped into THREE stacked mont_mul
+    calls (6 + 2 + 6 independent products batched along a new leading axis):
+    the traced graph shrinks ~3x — which is what keeps the 256-step
+    scalar/MSM loop bodies fast to compile — and the wider batches fill
+    VPU lanes better at small batch sizes.
+
+    Canonical limbs in/out, but the interior runs in lazy-carry form
+    (field.add_lazy / sub_lazy, rules R1-R4 in ops/tfield.py): the
+    a1-side sums and t3/t4/y3 skip the carry lookahead + conditional
+    subtract and enter the next mont_mul as its single lazy operand.
+    """
+    return _add_complete(p, q, z_lazy_out=False)
+
+
+def add_zlazy(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Complete addition with a Z-LAZY accumulator: the chained form of
+    `add` for sequential folds acc <- acc + term (XLA-layout mirror of
+    tec.add_zlazy — invariant documented there).
+
+      p:  X, Y canonical (< p); Z lazy (limbs <= 2^16, value < 2p).
+      q:  fully canonical.
+
+    The accumulator's Z carry resolution is deferred across the whole
+    chain (one `normalize_point` at the chain end) instead of one exact
+    carry-lookahead + conditional-subtract per add. Same complete RCB15
+    formulas, so identity and p == +-q lanes remain valid throughout.
+    """
+    return _add_complete(p, q, z_lazy_out=True)
 
 
 def double(p: jnp.ndarray) -> jnp.ndarray:
@@ -434,17 +467,17 @@ def _select_onehot(tables_planes: jnp.ndarray, digits: jnp.ndarray,
     return _from_byte_planes(sel)
 
 
-def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
-    """Windowed batched MSM: (..., T, 3, 16) x (..., T, 16) -> (..., 3, 16).
+def _windowed_walk(tables_planes: jnp.ndarray,
+                   digits: jnp.ndarray) -> jnp.ndarray:
+    """The round-6 EAGER-CARRY Horner interior, kept as the comparison
+    baseline for `perf_profile.py --mode pipeline`.
 
-    Builds a 16-entry multiple table per term (15 sequential adds, T-wide),
-    then scans 64 4-bit windows MSB-first: 4 shared doublings + per-term
-    one-hot table select (MXU) + tree-sum per window.
+    tables_planes: (..., T, 16, 96) projective multiple-table byte planes;
+    digits: (..., T, 64) LSB-first 4-bit digits. Scans the 64 windows
+    MSB-first: 4 shared doublings + one-hot select + a TREE fold over the
+    term axis whose complete adds resolve carries exactly at every level.
     """
-    batch = points.shape[:-3]
-    tables = _multiple_table(points, 16)           # (..., T, 16, 3, 16)
-    tables_planes = _to_byte_planes(tables)        # (..., T, 16, 96)
-    digits = window_digits4(scalars)               # (..., T, 64)
+    batch = tables_planes.shape[:-3]
 
     def body(i, acc):
         for _ in range(4):
@@ -456,6 +489,138 @@ def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
         return add(acc, term)
 
     return jax.lax.fori_loop(0, _W4_WINDOWS, body, identity(batch))
+
+
+def msm_windowed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Windowed batched MSM: (..., T, 3, 16) x (..., T, 16) -> (..., 3, 16).
+
+    Builds a 16-entry multiple table per term (15 sequential complete
+    adds, T-wide), then runs the eager-carry Horner walk. General —
+    accepts ANY projective input points. Hot paths whose points are
+    affine-or-identity (everything the verifier uploads) use the lazified
+    `msm_var_mixed` twin instead; this form is the round-6 baseline.
+    """
+    tables = _multiple_table(points, 16)           # (..., T, 16, 3, 16)
+    tables_planes = _to_byte_planes(tables)        # (..., T, 16, 96)
+    digits = window_digits4(scalars)               # (..., T, 64)
+    return _windowed_walk(tables_planes, digits)
+
+
+#: lanes the Z-lazy chain fold keeps live (see _chain_sum_zlazy).
+_CHAIN_KEEP = 8
+
+
+def _chain_sum_zlazy(pts: jnp.ndarray) -> jnp.ndarray:
+    """Sum over the term axis with a Z-LAZY chained accumulator.
+
+    pts: (..., T, 3, 16) canonical -> (..., 3, 16). Keeps _CHAIN_KEEP
+    lanes live and folds the rest in a constant-shape fori chain of
+    `add_zlazy` (accumulator Z stays lazy across the whole chain; the
+    chunk operands are canonical table selects), resolves the deferred
+    carries ONCE via normalize_point, then tree-sums the kept lanes.
+    Same lane-add count as the halving tree it replaces; the per-add
+    exact Z carry resolution is what the lazy chain removes.
+    """
+    T = pts.shape[-3]
+    batch = pts.shape[:-3]
+    keep = min(_CHAIN_KEEP, T)
+    rem = T % keep
+    if rem:
+        pts = jnp.concatenate(
+            [pts, identity(batch + (keep - rem,))], axis=-3)
+        T = pts.shape[-3]
+    chunks = T // keep
+    if chunks > 1:
+        def body(c, acc):
+            q = jax.lax.dynamic_slice_in_dim(pts, c * keep, keep, axis=-3)
+            return add_zlazy(acc, q)
+
+        acc = jax.lax.fori_loop(1, chunks, body, pts[..., :keep, :, :])
+        acc = normalize_point(acc)
+    else:
+        acc = pts
+    return _tree_sum_shrink(acc)
+
+
+def _multiple_table_mixed(aff: jnp.ndarray, inf: jnp.ndarray,
+                          entries: int) -> jnp.ndarray:
+    """v*P multiple tables from AFFINE-or-identity inputs via mixed adds.
+
+    aff: (..., T, 2, 16) canonical Montgomery affine coordinates;
+    inf: (..., T) bool identity mask. Returns (..., T, entries, 3, 16)
+    CANONICAL projective entries.
+
+    The chain tbl[e] = tbl[e-1] + P runs on the 13-mul RCB15 mixed add
+    (madd_masked: identity lanes keep tbl[e-1] = O) with the
+    accumulator's Y/Z in LAZY form ACROSS the whole entries-2 step scan
+    — one vectorized normalize_point over the finished table resolves
+    every deferred carry, vs one exact resolution per add in the
+    complete-add chain of `_multiple_table`.
+    """
+    zero = jnp.zeros_like(aff[..., 0, :])
+    one = jnp.broadcast_to(FP.r1_arr, zero.shape)
+    infc = inf[..., None]
+    # entry 1: the point itself, with identity lanes forced to the clean
+    # (0 : 1 : 0) encoding regardless of their affine placeholder coords.
+    base = jnp.stack([jnp.where(infc, zero, aff[..., 0, :]),
+                      jnp.where(infc, one, aff[..., 1, :]),
+                      jnp.where(infc, zero, one)], axis=-2)
+
+    def step(cur, _):
+        nxt = madd_masked(cur, aff, inf)
+        return nxt, nxt
+
+    _, chain = jax.lax.scan(step, base, None, length=entries - 2)
+    chain = jnp.moveaxis(chain, 0, -3)             # (..., T, entries-2, 3, 16)
+    idp = identity(base.shape[:-2])
+    tbl = jnp.concatenate(
+        [idp[..., None, :, :], base[..., None, :, :], chain], axis=-3)
+    # entries 0/1 are already canonical (normalize is idempotent there);
+    # the chain entries carry lazy Y/Z — resolved here, once, vectorized.
+    return normalize_point(tbl)
+
+
+def _windowed_walk_lazy(tables_planes: jnp.ndarray,
+                        digits: jnp.ndarray) -> jnp.ndarray:
+    """The LAZIFIED Horner interior: same MSB-first window scan as
+    `_windowed_walk`, but the per-window term fold is the Z-lazy chain
+    (`_chain_sum_zlazy`) — carries in the fold accumulator resolve once
+    per window instead of once per add level."""
+    batch = tables_planes.shape[:-3]
+
+    def body(i, acc):
+        for _ in range(4):
+            acc = add(acc, acc)
+        d = jax.lax.dynamic_slice_in_dim(
+            digits, _W4_WINDOWS - 1 - i, 1, axis=-1)   # (..., T, 1)
+        sel = _select_onehot(tables_planes, d[..., 0].astype(jnp.int32), 16)
+        term = _chain_sum_zlazy(sel)
+        return add(acc, term)
+
+    return jax.lax.fori_loop(0, _W4_WINDOWS, body, identity(batch))
+
+
+def msm_var_mixed(points: jnp.ndarray, scalars: jnp.ndarray) -> jnp.ndarray:
+    """Lazified windowed var-base MSM for AFFINE-OR-IDENTITY inputs.
+
+    points: (..., T, 3, 16) Montgomery projective with Z in {1, 0} — i.e.
+    affine points or the identity, which is exactly what every verifier
+    path holds (packed uploads reconstruct Z = 1, host marshalling emits
+    Z = 1, pad rows are the identity); scalars: (..., T, 16) plain limbs.
+    Returns (..., 3, 16), canonical.
+
+    XLA twin of the Pallas `_msm_var_kernel` v2: multiple tables built by
+    13-mul madd chains with lazy Y/Z across the chain (ONE normalize per
+    table build), then the Z-lazy Horner walk. For general projective
+    inputs (arbitrary Z) use `msm_windowed` — madd needs an affine second
+    operand.
+    """
+    inf = is_identity(points)                      # (..., T)
+    aff = points[..., :2, :]                       # canonical mont affine
+    tables = _multiple_table_mixed(aff, inf, 16)   # (..., T, 16, 3, 16)
+    tables_planes = _to_byte_planes(tables)        # (..., T, 16, 96)
+    digits = window_digits4(scalars)               # (..., T, 64)
+    return _windowed_walk_lazy(tables_planes, digits)
 
 
 def fixed_base_tables(points: jnp.ndarray) -> jnp.ndarray:

@@ -310,7 +310,10 @@ def sub(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
 # ops/tfield.py lazy layer (rules R1-R4 documented there; ops/tfield.py
 # also hosts the LimbBound schedule tracker). Limbs may sit <= 2^16
 # between ops and the value < 5*mod; chains end at `normalize` or flow
-# through mont_mul, which canonicalizes.
+# through mont_mul, which canonicalizes. Round 7 rides the same rules
+# through the XLA point chains (ec.madd / ec.madd_masked table walks,
+# ec.add_zlazy Z-lazy window folds) — one normalize_point per chain at
+# the readback boundary, enforced by scripts/check_lazy_bounds.py.
 # --------------------------------------------------------------------------
 
 #: see tfield.LAZY_LIMB_MAX — the stable inter-op limb bound.

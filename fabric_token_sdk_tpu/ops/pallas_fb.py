@@ -314,7 +314,9 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
                     windows: int, keep: int = _VAR_KEEP):
     """One term-block: 4-bit-window Horner over a VMEM multiple table.
 
-    pts_ref:    (48, VAR_BLOCK) uint32 transposed projective points.
+    pts_ref:    (48, VAR_BLOCK) uint32 transposed projective points with
+        Z in {1, 0} — affine points or the identity (what every verifier
+        var path uploads; the madd table chain needs affine operands).
     digits_ref: (windows, 1, VAR_BLOCK) int32 — 4-bit digits, LSB-first
         window index on the LEADING axis (dynamic indexing inside the
         window loop must hit a non-tiled dim).
@@ -324,10 +326,17 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         halving fold makes lane i the per-row pair sum — the
         mul2_rows_fused grouping).
 
-    Per window (MSB-first): 16-entry masked select per lane, halving
-    adds down to `keep` lanes, then acc = 16*acc + partial. The whole
-    walk — table build, selects, folds, doublings — stays in VMEM; the
-    XLA path materializes each of these in HBM.
+    LAZIFIED interiors (the round-7 treatment, twin of ec.msm_var_mixed):
+    the multiple table is a 13-mul madd chain whose Y/Z stay lazy ACROSS
+    all 14 steps (identity lanes ride the madd_masked lane mask), with
+    one normalize_point per entry at the chain boundary; the per-window
+    fold down to `keep` lanes is a Z-lazy `add_zlazy` chunk chain — same
+    lane-add count as the halving tree it replaces, carries resolved
+    once per window instead of once per add. Then acc = 16*acc + partial
+    per window (complete adds: the Horner accumulator doubles against
+    itself, so it must stay canonical). The whole walk — table build,
+    selects, folds, doublings — stays in VMEM; the XLA path materializes
+    each of these in HBM.
     """
     cc = tec.CurveConsts(
         ts=tf.TSpec(mod=mod_ref[...], nprime=nprime_ref[...],
@@ -336,11 +345,20 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         b3=b3_ref[...])
     pts = pts_ref[...]
     bV = pts.shape[-1]
+    xq, yq, _ = tec.coords(pts)                           # canonical affine
+    inf = tec.is_identity(pts)                            # (1, bV)
 
-    # 16-entry multiple table: tbl[e] = e * P per lane (15 sequential adds)
-    tbl = [tec.identity(bV, cc), pts]
+    # 16-entry multiple table via the madd chain: tbl[e] = e * P per
+    # lane. Entry 1 forces identity lanes onto the clean (0 : 1 : 0)
+    # encoding; entries 2..15 carry lazy Y/Z across the whole chain and
+    # resolve once each at the chain boundary.
+    base = jnp.where(inf, tec.identity(bV, cc), pts)
+    tbl = [tec.identity(bV, cc), base]
+    cur = base
     for _ in range(2, 16):
-        tbl.append(tec.add(tbl[-1], pts, cc))
+        cur = tec.madd_masked(cur, xq, yq, inf, cc)
+        tbl.append(cur)
+    tbl = tbl[:2] + [tec.normalize_point(t, cc) for t in tbl[2:]]
 
     def body(i, acc):
         w = windows - 1 - i
@@ -348,11 +366,18 @@ def _msm_var_kernel(pts_ref, digits_ref, mod_ref, nprime_ref, r1_ref,
         sel = tbl[0]
         for e in range(1, 16):
             sel = jnp.where(d[None, :] == e, tbl[e], sel)
-        lanes = bV
-        while lanes > keep:                               # halving folds
-            half = lanes // 2
-            sel = tec.add(sel[..., :half], sel[..., half:lanes], cc)
-            lanes = half
+        if bV > keep:
+            nchunks = bV // keep
+            if nchunks == 2:
+                # a single fold add has no carry to defer
+                sel = tec.add(sel[..., :keep], sel[..., keep:], cc)
+            else:
+                # Z-lazy chunk chain: accumulator Z stays lazy across
+                # the chunks, one normalize resolves it per window.
+                accf = sel[..., :keep]
+                for s in range(keep, bV, keep):
+                    accf = tec.add_zlazy(accf, sel[..., s:s + keep], cc)
+                sel = tec.normalize_point(accf, cc)
         for _ in range(4):                                # acc *= 16
             acc = tec.add(acc, acc, cc)
         return tec.add(acc, sel, cc)

@@ -71,16 +71,16 @@ def _split(m: jnp.ndarray, k: int):
     return tuple(m[..., i * lanes:(i + 1) * lanes] for i in range(k))
 
 
-def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
-    """Complete projective addition, valid for every input pair.
+def _add_complete(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts,
+                  z_lazy_out: bool) -> jnp.ndarray:
+    """Shared interior of `add` / `add_zlazy` (RCB15 Alg 7, 6+2+6 muls).
 
-    Mirrors ec.add's three grouped multiplication rounds (6 + 2 + 6
-    products), batched along the LANE axis. Canonical limbs in, canonical
-    limbs out — but the INTERIOR runs in lazy-carry form (tf.add_lazy /
-    tf.sub_lazy): the a1-side cross sums and the t3/t4/y3 linear
-    combinations skip the Kogge-Stone lookahead + conditional subtract
-    and flow into the next mont_mul as its single lazy operand (rule R3;
-    every round-3 lane pairs one lazy input with one canonical input).
+    Accepts p with Z in LAZY form (limbs <= 2^16, value < 2p): Z1 feeds
+    mont_mul as its single lazy operand (rule R3) and the a1-side cross
+    sums add_lazy it against a canonical coordinate (rule R1, < 3p). q
+    must be fully canonical (its sums ride the exact adder on the b1
+    side). With z_lazy_out the output Z skips the exact carry resolve
+    and stays lazy (< 2p) for the next chained `add_zlazy`.
     """
     ts = cc.ts
     X1, Y1, Z1 = coords(p)
@@ -117,8 +117,43 @@ def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
     o0, o1, o2, o3, o4, o5 = _split(o, 6)
     x3 = subf(o1, o0)                    # t3*t1 - t4*y3
     y3o = addf(o3, o2)                   # t1*z3 + y3*t0
-    z3o = addf(o5, o4)                   # z3*t4 + t0*t3
+    if z_lazy_out:
+        z3o = tf.add_lazy(o5, o4)        # z3*t4 + t0*t3  (lazy, < 2p)
+    else:
+        z3o = addf(o5, o4)               # z3*t4 + t0*t3
     return from_coords(x3, y3o, z3o)
+
+
+def add(p: jnp.ndarray, q: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
+    """Complete projective addition, valid for every input pair.
+
+    Mirrors ec.add's three grouped multiplication rounds (6 + 2 + 6
+    products), batched along the LANE axis. Canonical limbs in, canonical
+    limbs out — but the INTERIOR runs in lazy-carry form (tf.add_lazy /
+    tf.sub_lazy): the a1-side cross sums and the t3/t4/y3 linear
+    combinations skip the Kogge-Stone lookahead + conditional subtract
+    and flow into the next mont_mul as its single lazy operand (rule R3;
+    every round-3 lane pairs one lazy input with one canonical input).
+    """
+    return _add_complete(p, q, cc, z_lazy_out=False)
+
+
+def add_zlazy(p: jnp.ndarray, q: jnp.ndarray,
+              cc: CurveConsts) -> jnp.ndarray:
+    """Complete addition with a Z-LAZY accumulator: the chained form of
+    `add` for sequential folds acc <- acc + term.
+
+    Invariant (stable: outputs satisfy what inputs require):
+      p:  X, Y canonical (< p); Z lazy (limbs <= 2^16, value < 2p).
+      q:  fully canonical (the fold terms, e.g. straight out of a table
+          select over normalized entries).
+    The accumulator's Z carry resolution is deferred across the whole
+    chain — one `normalize_point` at the chain end restores canonical
+    limbs — instead of one exact carry-lookahead + conditional subtract
+    per add. Same complete RCB15 formulas, so identity and p == +-q
+    lanes remain valid throughout.
+    """
+    return _add_complete(p, q, cc, z_lazy_out=True)
 
 
 def madd(p: jnp.ndarray, xq: jnp.ndarray, yq: jnp.ndarray,
@@ -172,6 +207,19 @@ def madd(p: jnp.ndarray, xq: jnp.ndarray, yq: jnp.ndarray,
     y3o = tf.add_lazy(o3, o2)            # lazy < 2p
     z3o = tf.add_lazy(o5, o4)            # lazy < 2p
     return from_coords(x3, y3o, z3o)
+
+
+def madd_masked(p: jnp.ndarray, xq: jnp.ndarray, yq: jnp.ndarray,
+                q_inf: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:
+    """`madd` with the Q-at-infinity gap closed by a lane mask.
+
+    q_inf: (..., 1, LANE) bool — lanes where Q is the identity keep p
+    unchanged (p + 0 = p), which also preserves whatever lazy form p is
+    in; the transposed twin of ec.madd_masked. This is what lets an
+    affine multiple-table chain tbl[e] = tbl[e-1] + Q run branch-free
+    over a batch that contains identity points.
+    """
+    return jnp.where(q_inf, p, madd(p, xq, yq, cc))
 
 
 def normalize_point(p: jnp.ndarray, cc: CurveConsts) -> jnp.ndarray:

@@ -248,6 +248,16 @@ def sub(a: jnp.ndarray, b: jnp.ndarray, ts: TSpec) -> jnp.ndarray:
 #   R2  sub_lazy's subtrahend must be CANONICAL (< mod);
 #   R3  mont_mul takes at most ONE lazy operand, value < 5*mod;
 #   R4  normalize accepts lazy values < 2*mod only.
+#
+# Round 7 extends the same rules across POINT-op chains: `madd` keeps its
+# result's Y/Z lazy so the next madd in a multiple-table chain consumes
+# them under R1/R3 (one normalize_point per table entry, not per step),
+# and `add_zlazy` is a complete add whose accumulator Z stays lazy
+# (< 2*mod) across a whole per-window fold chain — X/Y of the
+# accumulator and the fresh operand stay canonical, so every interior
+# mul still sees at most one lazy input. Both chains terminate in ONE
+# normalize_point at the kernel's readback boundary (the lint above
+# checks exactly that).
 # --------------------------------------------------------------------------
 
 def add_lazy(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -80,7 +80,9 @@ class PrewarmManager:
                 # backend without kernel_cost contributes nothing)
                 PROFILER.record_compile("serve_prewarm", bucket, elapsed)
                 PROFILER.capture_bucket_cost(self.zk, bucket)
-                # fused Pallas kernels (TPU): same families, own kinds
+                # fused device programs: same families, own kinds —
+                # pass12_fused (merged chunk pipeline, every backend)
+                # plus the Pallas kernels on TPU
                 PROFILER.capture_fused_costs(self.zk, bucket)
             PROFILER.record_memory_watermark()
         self.total_s += time.perf_counter() - t0

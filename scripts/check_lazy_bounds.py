@@ -14,13 +14,25 @@ This lint walks the AST of the ops kernels (and every other module that
 touches the lazy API) and enforces one function-level rule:
 
   a function that CALLS a lazy producer
-      (add_lazy / sub_lazy / lazy_limbs / madd / madd_masked)
+      (add_lazy / sub_lazy / lazy_limbs / madd / madd_masked /
+       add_zlazy) — directly, or transitively through helpers DEFINED IN
+      THE SAME MODULE (the call set is closed over locally defined
+      function names; cross-module attribute calls stay shallow)
   and sits at a readback boundary
       (stores to a ``*_ref`` — a Pallas kernel output — or is a public
       ``*_mixed`` fold entry point, or lives outside ops/)
   must also CALL a normalizer
       (normalize / normalize_point / carry_propagate / _carry_propagate
        / _cond_sub_mod)
+
+A call to a ``*_mixed`` entry point additionally counts as lazy-API
+usage (it makes the exact-pass / pass-2 kernels that consume the
+round-7 lazified MSM interiors visible to the scan), and — only when
+the function touches NO raw producer itself — as a normalization point:
+the ``*_mixed`` entry points are canonical-out by contract (checked
+here on their own defining module), so a caller that merely consumes
+them is clean, while one that also leaks a raw ``add_lazy`` result
+still needs its own normalizer.
 
 Interior helpers (tec.add's lazy interior, madd itself) are exempt: they
 are not boundaries — their canonical-out contracts are covered by the
@@ -43,6 +55,7 @@ PKG = REPO / "fabric_token_sdk_tpu"
 #: ops whose RESULT is in lazy form (limbs may reach 2^16 / value >= p)
 LAZY_PRODUCERS = frozenset({
     "add_lazy", "sub_lazy", "lazy_limbs", "madd", "madd_masked",
+    "add_zlazy",
 })
 
 #: ops that resolve carries AND reduce below p (canonicalization points)
@@ -93,6 +106,25 @@ def _functions(tree: ast.AST):
             yield node
 
 
+def _closed_calls(fn: ast.AST, direct: dict[str, set[str]]) -> set[str]:
+    """``fn``'s called names, closed transitively over helpers defined in
+    the same module (``direct`` maps local function name -> its direct
+    call set). Cross-module attribute calls stay shallow — the callee's
+    module is linted on its own."""
+    calls = set(_called_names(fn))
+    frontier = [c for c in calls if c in direct]
+    seen = {getattr(fn, "name", None)}
+    while frontier:
+        callee = frontier.pop()
+        if callee in seen:
+            continue
+        seen.add(callee)
+        new = direct[callee] - calls
+        calls |= new
+        frontier.extend(c for c in new if c in direct)
+    return calls
+
+
 def scan_boundaries() -> dict[str, dict]:
     """{``file::function``: info} for every function the lint treats as a
     readback boundary that calls into the lazy API. ``info`` carries the
@@ -105,9 +137,13 @@ def scan_boundaries() -> dict[str, dict]:
             tree = ast.parse(path.read_text())
         except SyntaxError:  # pragma: no cover - tree must stay parseable
             continue
+        direct = {fn.name: _called_names(fn) for fn in _functions(tree)}
         for fn in _functions(tree):
-            calls = _called_names(fn)
-            producers = calls & LAZY_PRODUCERS
+            calls = _closed_calls(fn, direct)
+            raw = calls & LAZY_PRODUCERS
+            mixed = {c for c in calls
+                     if c.endswith("_mixed") and c != fn.name}
+            producers = raw | mixed
             if not producers:
                 continue
             if fn.name in LAZY_PRODUCERS:
@@ -117,10 +153,15 @@ def scan_boundaries() -> dict[str, dict]:
                         or not in_ops)
             if not boundary:
                 continue
+            normalizers = calls & NORMALIZERS
+            if not raw:
+                # canonical-out *_mixed entry points self-normalize for
+                # pure consumers; a raw producer leak still needs its own
+                normalizers = normalizers | mixed
             found[f"{rel}::{fn.name}"] = {
                 "line": fn.lineno,
                 "producers": sorted(producers),
-                "normalizers": sorted(calls & NORMALIZERS),
+                "normalizers": sorted(normalizers),
             }
     return found
 

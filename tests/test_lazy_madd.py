@@ -1,6 +1,6 @@
 """Mixed addition (tec.madd) + lazy-carry limb arithmetic properties.
 
-Three layers of defense for the bit-identical-verdict contract:
+Four layers of defense for the bit-identical-verdict contract:
 
   1. madd parity vs the complete add and the host oracle over the
      adversarial corner inputs where mixed-addition formulas classically
@@ -15,7 +15,13 @@ Three layers of defense for the bit-identical-verdict contract:
      precondition breaks, so the schedule COMPLETING is a proof that no
      intermediate limb can exceed LAZY_LIMB_MAX = 2^16 — and the
      violation tests prove the tracker itself rejects schedules that
-     would.
+     would. Round 7 adds the add_zlazy window-fold schedule and a
+     composed walk of the full _msm_var_kernel chain structure.
+  4. Oracle parity of the round-7 lazified variable-base MSM
+     (ec.msm_var_mixed) over the classic corner inputs — identity row,
+     zero scalar, scalar one, duplicate point — in both the flat and the
+     batched (exact-tail / fused-chunk) forms, plus the canonical-limb
+     readback contract.
 """
 
 import secrets
@@ -25,7 +31,7 @@ import numpy as np
 import pytest
 
 from fabric_token_sdk_tpu.crypto import bn254
-from fabric_token_sdk_tpu.ops import field, limbs as L, tec
+from fabric_token_sdk_tpu.ops import ec, field, limbs as L, tec
 from fabric_token_sdk_tpu.ops import tfield as tf
 
 P = L.P_INT
@@ -255,6 +261,35 @@ def _walk_madd(X, Y, Z):
     return o1.sub(o0), o3.add_lazy(o2), o5.add_lazy(o4)
 
 
+def _walk_add_zlazy(P1, P2):
+    """tec.add_zlazy's exact op schedule (``_add_complete`` with
+    ``z_lazy_out=True``) in LimbBound space: the accumulator's Z arrives
+    lazy (< 2p) from the previous fold step, X/Y canonical, the chunk
+    partial ``P2`` fully canonical — and Z leaves lazy again via the
+    final ``add_lazy`` while X/Y leave canonical. Round 7's window-fold
+    chain iterates exactly this shape."""
+    a_sums = [P1[i].add_lazy(P1[j]) for i, j in ((0, 1), (1, 2), (0, 2))]
+    b_sums = [P2[i].add(P2[j]) for i, j in ((0, 1), (1, 2), (0, 2))]
+    t0 = P1[0].mont_mul(P2[0])
+    t1 = P1[1].mont_mul(P2[1])
+    t2 = P1[2].mont_mul(P2[2])
+    m3 = a_sums[0].mont_mul(b_sums[0])
+    m4 = a_sums[1].mont_mul(b_sums[1])
+    m5 = a_sums[2].mont_mul(b_sums[2])
+    t3 = m3.sub_lazy(t0).sub_lazy(t1)
+    t4 = m4.sub_lazy(t1).sub_lazy(t2)
+    y3 = m5.sub_lazy(t0).sub_lazy(t2)
+    t0 = t0.add(t0).add(t0)
+    t2 = t2.mont_mul(LB.canonical())
+    y3 = y3.mont_mul(LB.canonical())
+    z3 = t1.add(t2)
+    t1 = t1.sub(t2)
+    outs = [t4.mont_mul(y3), t3.mont_mul(t1), y3.mont_mul(t0),
+            t1.mont_mul(z3), t0.mont_mul(t3), z3.mont_mul(t4)]
+    return (outs[1].sub(outs[0]), outs[3].add(outs[2]),
+            outs[5].add_lazy(outs[4]))
+
+
 def _walk_add(P1, P2):
     """tec.add's lazified interior (canonical-in/canonical-out)."""
     a_sums = [P1[i].add_lazy(P1[j]) for i, j in ((0, 1), (1, 2), (0, 2))]
@@ -303,6 +338,52 @@ class TestCarryBoundExhaustion:
         x, y, z = _walk_add(p1, p2)
         assert x.is_canonical and y.is_canonical and z.is_canonical
 
+    def test_add_zlazy_invariant_is_a_fixed_point(self):
+        """The window-fold invariant (X/Y canonical, Z lazy < 2p) must be
+        a fixed point of the add_zlazy schedule: chaining folds can never
+        grow the Z bound, and the chain terminator normalize is legal
+        (R4)."""
+        acc = [LB.canonical(), LB.canonical(), LB(tf.LAZY_LIMB_MAX, 2.0)]
+        part = [LB.canonical()] * 3
+        for it in range(32):
+            x, y, z = _walk_add_zlazy(acc, part)
+            assert x.is_canonical and y.is_canonical, it
+            assert z.limb_max <= tf.LAZY_LIMB_MAX and z.value_p <= 2.0, it
+            acc = [x, y, z]
+        acc[2].normalize()
+
+    def test_add_zlazy_rejects_illegal_inputs(self):
+        """The schedule's preconditions are load-bearing: a lazy chunk
+        partial (q side feeds exact adds) or a lazy accumulator X (two
+        lazy operands meet in the cross sums) must trip the tracker."""
+        lazy = LB(tf.LAZY_LIMB_MAX, 2.0)
+        good = [LB.canonical(), LB.canonical(), lazy]
+        with pytest.raises(ValueError, match="canonical"):
+            _walk_add_zlazy(good, [LB.canonical(), LB.canonical(), lazy])
+        with pytest.raises(ValueError, match="R1|both operands lazy"):
+            _walk_add_zlazy([lazy, LB.canonical(), lazy],
+                            [LB.canonical()] * 3)
+
+    def test_var_kernel_chain_schedule(self):
+        """_msm_var_kernel's full lazy-chain structure end to end in
+        LimbBound space: the 14-step madd table chain (Y/Z lazy across
+        steps, one normalize at the table-entry store), then the
+        add_zlazy window-fold chain (Z lazy across chunks, one normalize
+        at the fold store). Completing proves no interior limb of the
+        round-7 lazified Horner walk can pass LAZY_LIMB_MAX."""
+        # table build: entry k = entry k-1 + base, madd chain of 14
+        X, Y, Z = LB.canonical(), LB.canonical(), LB.canonical()
+        for step in range(14):
+            X, Y, Z = _walk_madd(X, Y, Z)
+            assert Y.value_p <= 2.0 and Z.value_p <= 2.0, step
+        X, Y, Z = X, Y.normalize(), Z.normalize()   # per-entry store
+        assert X.is_canonical and Y.is_canonical and Z.is_canonical
+        # window fold: chunk-partial chain through add_zlazy
+        acc = [X, Y, LB(tf.LAZY_LIMB_MAX, 2.0)]
+        for _ in range(8):
+            acc = list(_walk_add_zlazy(acc, [LB.canonical()] * 3))
+        acc[2].normalize()                          # fold store
+
     def test_violating_schedules_raise(self):
         can = LB.canonical()
         lazy2 = LB(tf.LAZY_LIMB_MAX, 2.0)
@@ -330,3 +411,51 @@ class TestCarryBoundExhaustion:
         Y = Z = LB(tf.LAZY_LIMB_MAX, 2.0)
         with pytest.raises(ValueError):
             _walk_madd(bad_X, Y, Z)
+
+
+# --------------------------------------------------------------------------
+# 4. round-7 lazified var-MSM: oracle parity + canonical-out contract
+# --------------------------------------------------------------------------
+
+class TestVarMsmLazyParity:
+    """ec.msm_var_mixed is the XLA twin of the Pallas _msm_var_kernel:
+    madd table chains + add_zlazy window folds, one normalize_point per
+    chain. It now carries the K pass, the exact-pass var tails AND the
+    fused chunk partial — parity over the classic MSM corner inputs plus
+    the canonical-limb readback contract is what keeps verdicts
+    bit-identical to the host verifier."""
+
+    def _corner_case(self):
+        T = 7
+        pts = _rand_pts(T - 2) + [bn254.G1_IDENTITY]
+        pts.append(pts[0])                   # duplicate (doubling in fold)
+        sc = [secrets.randbelow(bn254.R) for _ in range(T)]
+        sc[2] = 0                            # zero scalar
+        sc[3] = 1                            # scalar one
+        return pts, sc
+
+    def test_oracle_parity_corner_inputs(self):
+        pts, sc = self._corner_case()
+        proj = jnp.asarray(L.points_to_projective_limbs(pts))
+        scl = jnp.asarray(L.scalars_to_limbs(sc))
+        got = np.asarray(ec.msm_var_mixed(proj, scl))
+        want = bn254.msm(pts, sc)
+        gp = L.projective_limbs_to_point(got)
+        assert not want.inf and _same(gp, want)
+        # readback boundary contract: fully canonical limbs
+        assert int(got.max()) <= 0xFFFF
+
+    def test_batched_matches_per_row(self):
+        """The (B, T, ...) form the exact-pass tails and the fused chunk
+        partial use must agree row-by-row with the flat form."""
+        pts, sc = self._corner_case()
+        proj = jnp.asarray(L.points_to_projective_limbs(pts))
+        scl = jnp.asarray(L.scalars_to_limbs(sc))
+        flat = np.asarray(ec.msm_var_mixed(proj, scl))
+        B = 2
+        batched = np.asarray(ec.msm_var_mixed(
+            jnp.broadcast_to(proj, (B,) + proj.shape),
+            jnp.broadcast_to(scl, (B,) + scl.shape)))
+        assert int(batched.max()) <= 0xFFFF
+        for b in range(B):
+            assert (batched[b] == flat).all(), b

@@ -193,3 +193,127 @@ def test_from_pp_serves_tables_from_cache(pp, monkeypatch):
     params = rv.RangeVerifierParams.from_pp(pp, cache_digest="cachetest")
     assert params.tables is real
     assert seen and seen[0] == (BIT_LENGTH, "cachetest", "proj")
+
+
+# ---------------------------------------------------------------------------
+# round-7 fused chunk pipeline: 1 packed upload + 1 device program per chunk
+# ---------------------------------------------------------------------------
+
+def _hook_counts(monkeypatch):
+    """Install a dispatch-count recorder on the verifier's seam."""
+    import collections
+
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+
+    counts = collections.Counter()
+    monkeypatch.setattr(rv, "_DISPATCH_HOOK",
+                        lambda kind: counts.update((kind,)))
+    return rv, counts
+
+
+def test_fused_pipeline_single_dispatch_per_chunk(pp, monkeypatch):
+    """The round-7 acceptance gate: on the single-host hot path a chunk
+    costs exactly ONE packed host->device upload and ONE fused device
+    program (pass-1 + round digests + derived var scalars + the pass-2
+    combined-RLC partial), with only the cross-chunk finalize left as a
+    separate dispatch."""
+    rv, counts = _hook_counts(monkeypatch)
+    proofs, coms = zip(*[_prove_one(pp, v) for v in (5, 17, 650)])
+    verifier = BatchRangeVerifier(pp)
+    assert verifier.mesh is None and rv._fused_pipeline_enabled()
+    assert verifier.verify(list(proofs), list(coms)).all()
+    assert verifier.last_path == "combined"
+    assert counts["chunk_upload"] == 1, counts
+    assert counts["chunk_dispatch"] == 1, counts
+    assert counts["finalize"] == 1, counts
+
+
+def test_fused_pipeline_multi_chunk(pp, monkeypatch):
+    """Chunked batches scale the invariant linearly: N chunks -> N
+    uploads + N dispatches, still one finalize (same 16-row bucket as
+    the single-chunk test, so no extra compile)."""
+    rv, counts = _hook_counts(monkeypatch)
+    monkeypatch.setattr(rv, "_CHUNK_ROWS", 2)
+    proofs, coms = zip(*[_prove_one(pp, v) for v in (1, 2, 3, 4)])
+    assert BatchRangeVerifier(pp).verify(list(proofs), list(coms)).all()
+    assert counts["chunk_upload"] == 2, counts
+    assert counts["chunk_dispatch"] == 2, counts
+    assert counts["finalize"] == 1, counts
+
+
+def test_split_pipeline_escape_matches_verdicts(pp, monkeypatch):
+    """FTS_NO_FUSED_PIPELINE keeps the legacy split pass-1/pass-2 path
+    alive (the mesh / debug escape): verdicts identical, but the chunk
+    costs multiple uploads + dispatches again."""
+    rv, counts = _hook_counts(monkeypatch)
+    monkeypatch.setenv("FTS_NO_FUSED_PIPELINE", "1")
+    assert not rv._fused_pipeline_enabled()
+    good, gcom = _prove_one(pp, 7)
+    bad, bcom = _prove_one(pp, 9)
+    bad.data.tau = bn254.fr_add(bad.data.tau, 1)
+    got = BatchRangeVerifier(pp).verify([good, bad], [gcom, bcom])
+    assert got[0] and not got[1]
+    assert counts["chunk_upload"] > 1 or counts["chunk_dispatch"] > 1
+
+
+def test_kernel_cost_fused_exposes_pass12_on_cpu(pp):
+    """kernel_cost_fused must lower the merged chunk program and report
+    it under the pass12_fused kind on EVERY backend (the CPU flavor runs
+    the same program structure with XLA kernel bodies) — this is what
+    prewarm publishes on the stable profile_* families."""
+    costs = BatchRangeVerifier(pp).kernel_cost_fused(3)
+    assert costs is not None and "pass12_fused" in costs
+    assert costs["pass12_fused"].get("flops", 0) > 0
+
+
+def test_derive_var_scalars_matches_host(pp):
+    """On-device var-scalar derivation (the enabler for folding pass-2
+    into pass-1) is bit-identical to host Fr arithmetic for all seven
+    scalar kinds — including the round challenges recovered from the
+    device-computed digests and their Fermat inverses — and maps the
+    all-zero pad row to all-zero scalars."""
+    import jax.numpy as jnp
+
+    from fabric_token_sdk_tpu.crypto.bn254 import fr_mul, fr_sub
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.ops import limbs
+
+    R = bn254.R
+    B, rr = 3, 4
+    vals = {}
+    sc4 = np.zeros((B, 4, 16), dtype=np.uint32)
+    w12 = np.zeros((B, 2, 16), dtype=np.uint32)
+    for b in range(B):
+        yinv, z, delta, x = [rng.randrange(R) for _ in range(4)]
+        w1, w2 = 1 + rng.randrange(R - 1), 1 + rng.randrange(R - 1)
+        vals[b] = (z, x, w1, w2)
+        for j, v in enumerate((yinv, z, delta, x)):
+            sc4[b, j] = limbs.int_to_limbs(v)
+        w12[b, 0] = limbs.int_to_limbs(w1)
+        w12[b, 1] = limbs.int_to_limbs(w2)
+    rdig = np.random.default_rng(11).integers(
+        0, 1 << 32, size=(B, rr, 8), dtype=np.uint32)
+    sc4[B - 1] = 0          # pad-row convention: all-zero row in,
+    w12[B - 1] = 0          # all-zero scalars out (identity no-ops)
+    vals[B - 1] = (0, 0, 0, 0)
+
+    got = np.asarray(rv._derive_var_scalars(
+        jnp.asarray(sc4), jnp.asarray(w12), jnp.asarray(rdig), rr))
+    assert got.shape == (B, 2 + 2 * rr + 3, 16)
+    for b in range(B):
+        z, x, w1, w2 = vals[b]
+        xrs = [int.from_bytes(
+            b"".join(int(w).to_bytes(4, "big") for w in rdig[b, r]),
+            "big") % R for r in range(rr)]
+        xinvs = [pow(xr, R - 2, R) for xr in xrs]
+        want = [fr_mul(w2, fr_sub(0, x)), fr_mul(w2, R - 1)]
+        want += [fr_mul(w2, fr_sub(0, fr_mul(xr, xr))) for xr in xrs]
+        want += [fr_mul(w2, fr_sub(0, fr_mul(xi, xi))) for xi in xinvs]
+        want += [fr_mul(w1, fr_sub(0, x)),
+                 fr_mul(w1, fr_sub(0, fr_mul(x, x))),
+                 fr_mul(w1, fr_sub(0, fr_mul(z, z)))]
+        if b == B - 1:
+            assert all(v == 0 for v in want)   # sanity on the reference
+        for t in range(2 + 2 * rr + 3):
+            g = limbs.limbs_to_int(got[b, t])
+            assert g == want[t], (b, t, hex(g), hex(want[t]))

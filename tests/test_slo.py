@@ -197,6 +197,30 @@ def test_capture_bucket_cost_duck_types_and_never_raises():
     assert _val(provider, "profile_bucket_bytes") == 3.0
 
 
+def test_capture_fused_costs_duck_types_and_never_raises():
+    """The fused-program capture mirrors capture_bucket_cost's contract:
+    duck-typed hook, None on shims without it or on backend failure,
+    passthrough of the {kind: cost} map (pass12_fused et al. publish on
+    the existing profile_* families inside kernel_cost_fused itself)."""
+    prof = DeviceProfiler(provider=MetricsProvider())
+
+    class _NoHook:
+        pass
+
+    class _Raises:
+        def kernel_cost_fused(self, bucket):
+            raise RuntimeError("backend exploded")
+
+    class _Fused:
+        def kernel_cost_fused(self, bucket):
+            return {"pass12_fused": {"flops": 5.0}}
+
+    assert prof.capture_fused_costs(_NoHook(), 16) is None
+    assert prof.capture_fused_costs(_Raises(), 16) is None
+    assert prof.capture_fused_costs(_Fused(), 16) == {
+        "pass12_fused": {"flops": 5.0}}
+
+
 def test_memory_watermark_never_raises_on_cpu():
     provider = MetricsProvider()
     prof = DeviceProfiler(provider=provider)
