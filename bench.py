@@ -303,6 +303,21 @@ def _start_bench_telemetry(svc):
     return server
 
 
+def _configure_bench_journal() -> None:
+    """With BENCH_JOURNAL_DIR=<dir> set, arm the flight recorder: every
+    admit/shed/batch/dispatch/breaker/SLO event spills to journal.jsonl
+    there, and any SLO fast-burn trip, breaker force-open or watchdog
+    abandon during the run drops an incident snapshot (journal tail +
+    all-thread stacks + open spans) alongside it."""
+    from fabric_token_sdk_tpu.obs import JOURNAL, configure_journal_from_env
+
+    directory = configure_journal_from_env(JOURNAL)
+    if directory:
+        print(f"bench: flight recorder armed at {directory} "
+              "(incident snapshots on SLO fast-burn / breaker latch)",
+              file=sys.stderr)
+
+
 def _write_trace_out() -> None:
     """With BENCH_TRACE_OUT=<path> set, export the tracer's completed
     root spans (serve.request trees with linked serve.batch spans) as a
@@ -356,6 +371,7 @@ def _bench_serve():
         trace_every=int(os.environ.get("BENCH_TRACE_EVERY", "100")))
     zk = ZKVerifier(pp, device=True)
     slo = SloMonitor()
+    _configure_bench_journal()
     svc = VerificationService(
         zk, config=cfg,
         resilience=ResilienceConfig(watchdog_timeout_s=120.0), slo=slo)
@@ -481,6 +497,7 @@ def _bench_chaos():
     # failure accounting (no bind_breaker): a fast-burn force-open would
     # change the fault-recovery behaviour the chaos bench measures.
     from fabric_token_sdk_tpu.obs import SloMonitor
+    _configure_bench_journal()
     svc = VerificationService(faulty, config=cfg, resilience=resil,
                               slo=SloMonitor())
     telemetry = _start_bench_telemetry(svc)

@@ -254,11 +254,27 @@ class NodeSpec:
     #                              artifacts); empty -> fresh key at boot
 
 
-def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
+def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
+               fleet_spool_dir=None):
     """Entry point of one node process."""
     import os
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # fleet observability: publish this process's exposition into the
+    # platform spool (obs/aggregate.py) and stamp lifecycle phases into a
+    # per-node heartbeat file, so the orchestrator can federate N node
+    # registries on one /metrics and see which node stopped progressing
+    publisher = hb = None
+    if fleet_spool_dir:
+        from ..obs.aggregate import SpoolPublisher
+        from ..obs.heartbeat import Heartbeat
+
+        publisher = SpoolPublisher(fleet_spool_dir, spec_dict["name"],
+                                   interval_s=0.5).start()
+        hb = Heartbeat(os.path.join(fleet_spool_dir,
+                                    f"{spec_dict['name']}.hb.jsonl"))
+        hb.beat("generate")
 
     from ..core.registry import default_registry
     from ..services.auditor import AuditorNode
@@ -280,6 +296,8 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
     control["out"].put(("identity", spec.name, bytes(keys.identity)))
 
     # wait for SETUP: pp bytes + go signal
+    if hb is not None:
+        hb.beat("setup_wait")
     cmd, pp_raw, extra = control["in"].get()
     assert cmd == "start"
 
@@ -320,12 +338,18 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies):
     dispatcher.start()
 
     # RUN phase: command loop from the orchestrator
+    if hb is not None:
+        hb.beat("run")
     while True:
         cmd, *args = control["in"].get()
         try:
             if cmd == "stop":
                 stop_event.set()
                 delivery.stop()
+                if hb is not None:
+                    hb.beat("stopped")
+                if publisher is not None:
+                    publisher.stop()  # final publish: exit totals land
                 control["out"].put(("stopped", spec.name, None))
                 return
             elif cmd == "issue":
@@ -372,12 +396,14 @@ class Platform:
 
     def __init__(self, specs: list[NodeSpec], precision: int = 64,
                  driver: str = "fabtoken", bit_length: int = 16,
-                 pp_raw: bytes | None = None):
+                 pp_raw: bytes | None = None,
+                 fleet_spool_dir: str | None = None):
         self.specs = specs
         self.precision = precision
         self.driver = driver
         self.bit_length = bit_length
         self._pp_override = pp_raw   # tokengen-artifacts pp, if any
+        self.fleet_spool_dir = fleet_spool_dir
         self._ctx = mp.get_context("spawn")
         self._mgr = self._ctx.Manager()
         self._procs: dict[str, mp.Process] = {}
@@ -411,11 +437,16 @@ class Platform:
         self._address = sock.getsockname()
         sock.close()
 
+        if self.fleet_spool_dir:
+            import os
+
+            os.makedirs(self.fleet_spool_dir, exist_ok=True)
         for s in self.specs:
             self._procs[s.name] = self._ctx.Process(
                 target=_node_main,
                 args=(s.__dict__, list(self._address), self._authkey,
-                      inboxes, self._controls[s.name], replies),
+                      inboxes, self._controls[s.name], replies,
+                      self.fleet_spool_dir),
                 daemon=True)
             self._procs[s.name].start()
 
@@ -526,6 +557,28 @@ class Platform:
 
     def balance(self, node: str, token_type: str) -> int:
         return self.call(node, "balance", token_type)
+
+    # ------------------------------------------------------------ fleet obs
+    def fleet_aggregator(self, provider=None):
+        """A FleetAggregator over the platform spool (requires
+        ``fleet_spool_dir``)."""
+        if not self.fleet_spool_dir:
+            raise RuntimeError("Platform started without fleet_spool_dir")
+        from ..obs.aggregate import FleetAggregator
+
+        return FleetAggregator(self.fleet_spool_dir, provider=provider)
+
+    def fleet_telemetry(self, config=None, provider=None):
+        """Start a TelemetryServer whose /metrics federates every node
+        process's exposition (``node``-labelled) and whose /fleetz shows
+        per-node spool freshness. Caller stops it."""
+        from ..obs.telemetry import TelemetryConfig, TelemetryServer
+
+        server = TelemetryServer(config or TelemetryConfig(port=0),
+                                 provider=provider)
+        server.attach_federator(self.fleet_aggregator(provider=provider))
+        server.start()
+        return server
 
     def wait_tx(self, node: str, tx_id: str, timeout: float = 10.0) -> str:
         return self.call(node, "wait_tx", tx_id, timeout)

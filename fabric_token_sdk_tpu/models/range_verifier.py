@@ -1156,11 +1156,14 @@ def _make_sharded_combined(mesh, fused: bool = False):
         total = ec._tree_sum_shrink(gathered)
         return ec.is_identity(ec.add(fixed_pt, total))
 
-    sharded = jax.shard_map(
+    from ..parallel.mesh import _shard_map
+
+    # version-skew shim (check_vma on new jax, check_rep on old): the
+    # identity-point constants are unvarying either way
+    sharded = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(axes, None, None), P(axes, None)),
         out_specs=P(),
-        check_vma=False,  # identity-point constants are unvarying
     )
 
     @jax.jit
@@ -1191,14 +1194,15 @@ def _make_sharded_pass1(mesh, params):
         return xipa(_limbs_to_bytes_dev(ec.to_affine_batch(rgp)),
                     _limbs_to_bytes_dev(ec.to_affine(k)), ip_bytes)
 
-    sharded = jax.shard_map(
+    from ..parallel.mesh import _shard_map
+
+    sharded = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(),
                   P(axes, None, None), P(axes, None, None),
                   P(axes, None, None, None), P(axes, None, None),
                   P(axes, None)),
         out_specs=P(axes, None),
-        check_vma=False,
     )
     return jax.jit(sharded)
 

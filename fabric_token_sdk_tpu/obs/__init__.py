@@ -30,6 +30,12 @@ from .report import bench_snapshot, write_bench_report
 from .slo import SloMonitor, SloPolicy
 from .profiling import DeviceProfiler, PROFILER
 from .telemetry import TelemetryConfig, TelemetryServer, serve_telemetry
+from .journal import (EVENT_KINDS, JOURNAL, Journal,
+                      configure_from_env as configure_journal_from_env)
+from .heartbeat import (FileHeartbeatReader, Heartbeat, StallDetector,
+                        incident_on_stall, read_last as read_last_heartbeat)
+from .aggregate import (FleetAggregator, SpoolPublisher, merge_expositions,
+                        parse_exposition)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsProvider", "GLOBAL",
@@ -42,4 +48,9 @@ __all__ = [
     "SloMonitor", "SloPolicy",
     "DeviceProfiler", "PROFILER",
     "TelemetryConfig", "TelemetryServer", "serve_telemetry",
+    "Journal", "JOURNAL", "EVENT_KINDS", "configure_journal_from_env",
+    "Heartbeat", "StallDetector", "FileHeartbeatReader",
+    "incident_on_stall", "read_last_heartbeat",
+    "FleetAggregator", "SpoolPublisher", "merge_expositions",
+    "parse_exposition",
 ]

@@ -1,0 +1,319 @@
+"""Multi-process metrics federation over a spool directory.
+
+The telemetry plane (obs/telemetry.py) is strictly single-process: an
+NWO platform runs N node processes and each one's registry is invisible
+to the others. Real fleets solve this with a scrape fan-out; inside one
+host we do not need sockets — a spool directory is enough:
+
+- every child process runs a :class:`SpoolPublisher` that atomically
+  writes its full exposition to ``<spool>/<node>.prom`` (tmp +
+  ``os.replace``, so a reader never sees a torn file);
+- the parent's :class:`FleetAggregator` reads every ``*.prom``, injects
+  a ``node="<name>"`` label into each sample, and merges the documents
+  into one grammar-valid exposition — family names are NEVER rewritten,
+  so the stable-family inventory is unchanged and an existing dashboard
+  query picks up the new ``node`` dimension for free.
+
+Merge semantics (both tested directly):
+
+- HELP/TYPE conflicts: first document wins, the conflict is counted in
+  ``fleet_merge_conflicts_total{kind="help"|"type"}`` — a fleet must
+  not serve two HELP lines for one family.
+- label collisions: a sample that already carries a ``node`` label (a
+  child federating its own children, or a user label) has it renamed to
+  ``node_orig`` and counted under ``kind="label"`` — the injected fleet
+  dimension must stay authoritative.
+
+The aggregator also publishes the federation's own health as new
+``fleet_*`` families (node count, merged samples, per-node spool age)
+and a JSON summary for the new ``/fleetz`` endpoint.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+import time
+
+from .metrics import (GLOBAL, MetricsProvider, escape_help_text,
+                      escape_label_value, sanitize_label_name)
+
+_FLEET_FAMILIES = {
+    "fleet_nodes":
+        "Node expositions merged in the most recent federation collect.",
+    "fleet_samples":
+        "Samples in the most recent federated exposition.",
+    "fleet_merge_conflicts_total":
+        "Federation merge conflicts, by kind (help, type, label, parse).",
+    "fleet_node_age_seconds":
+        "Age of each node's spool exposition at the last collect.",
+}
+
+_HELP_LINE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_LINE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (\w+)$")
+_SAMPLE_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$")
+_LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+
+
+def _unescape(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Exposition text -> ``{family: {"help", "type", "samples"}}`` where
+    each sample is ``(sample_name, [(label, value), ...], value_str)``.
+
+    Values stay strings (``NaN``/``+Inf``/float reprs) so a
+    parse-then-render round trip cannot reformat a number. Histogram
+    ``_bucket``/``_sum``/``_count`` samples attach to their base family.
+    Malformed lines raise ``ValueError`` — the publisher wrote this text
+    with our own renderer, so leniency would only hide corruption."""
+    families: dict[str, dict] = {}
+
+    def fam(name: str) -> dict:
+        return families.setdefault(
+            name, {"help": None, "type": None, "samples": []})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _HELP_LINE.match(line)
+            if m:
+                f = fam(m.group(1))
+                if f["help"] is None:
+                    f["help"] = m.group(2)
+                continue
+            m = _TYPE_LINE.match(line)
+            if m:
+                f = fam(m.group(1))
+                if f["type"] is None:
+                    f["type"] = m.group(2)
+                continue
+            continue  # other comments are legal exposition, dropped
+        m = _SAMPLE_LINE.match(line)
+        if not m:
+            raise ValueError(f"malformed sample line: {line!r}")
+        sample_name, label_blob, value = m.groups()
+        labels = [(k, _unescape(v))
+                  for k, v in _LABEL_PAIR.findall(label_blob or "")]
+        base = sample_name
+        stripped = _SUFFIX.sub("", sample_name)
+        if stripped in families:
+            base = stripped
+        fam(base)["samples"].append((sample_name, labels, value))
+    return families
+
+
+class _Merge:
+    """Accumulator for one federation pass."""
+
+    def __init__(self):
+        self.families: dict[str, dict] = {}
+        self.conflicts: dict[str, int] = {}
+        self.samples = 0
+
+    def _conflict(self, kind: str) -> None:
+        self.conflicts[kind] = self.conflicts.get(kind, 0) + 1
+
+    def add(self, doc: dict[str, dict], node: str | None) -> None:
+        for name, f in doc.items():
+            mine = self.families.setdefault(
+                name, {"help": f["help"], "type": f["type"], "samples": []})
+            if f["help"] is not None and mine["help"] is None:
+                mine["help"] = f["help"]
+            elif (f["help"] is not None and mine["help"] is not None
+                  and f["help"] != mine["help"]):
+                self._conflict("help")
+            if f["type"] is not None and mine["type"] is None:
+                mine["type"] = f["type"]
+            elif (f["type"] is not None and mine["type"] is not None
+                  and f["type"] != mine["type"]):
+                self._conflict("type")
+            for sample_name, labels, value in f["samples"]:
+                if node is not None:
+                    out = []
+                    for k, v in labels:
+                        if k == "node":
+                            self._conflict("label")
+                            k = "node_orig"
+                        out.append((k, v))
+                    labels = out + [("node", node)]
+                mine["samples"].append((sample_name, labels, value))
+                self.samples += 1
+
+    def render(self) -> str:
+        lines = []
+        for name in sorted(self.families):
+            f = self.families[name]
+            if not f["samples"]:
+                continue
+            lines.append(
+                f"# HELP {name} "
+                f"{escape_help_text(f['help'] if f['help'] is not None else name)}")
+            lines.append(f"# TYPE {name} {f['type'] or 'gauge'}")
+            for sample_name, labels, value in f["samples"]:
+                if labels:
+                    blob = ",".join(
+                        f'{sanitize_label_name(k)}="{escape_label_value(v)}"'
+                        for k, v in labels)
+                    lines.append(f"{sample_name}{{{blob}}} {value}")
+                else:
+                    lines.append(f"{sample_name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+def merge_expositions(docs: dict[str, str],
+                      self_text: str | None = None) -> tuple[str, _Merge]:
+    """Merge ``{node: exposition_text}`` into one document. ``self_text``
+    (the federating process's own exposition) is merged WITHOUT a node
+    label — the parent is the scrape target itself, not a fleet member.
+    Returns ``(text, merge_stats)``."""
+    merge = _Merge()
+    if self_text is not None:
+        merge.add(parse_exposition(self_text), node=None)
+    for node in sorted(docs):
+        try:
+            merge.add(parse_exposition(docs[node]), node=node)
+        except ValueError:
+            merge._conflict("parse")
+    return merge.render(), merge
+
+
+class SpoolPublisher:
+    """Child-side half: atomically publish this process's exposition to
+    ``<spool>/<node>.prom``. ``publish()`` on demand, or ``start()`` for
+    a daemon-thread cadence (NWO node processes)."""
+
+    def __init__(self, spool_dir: str | os.PathLike, node: str,
+                 provider: MetricsProvider | None = None,
+                 interval_s: float = 2.0):
+        self.spool_dir = os.fspath(spool_dir)
+        self.node = node
+        self.provider = provider or GLOBAL
+        self.interval_s = interval_s
+        self.path = os.path.join(self.spool_dir, f"{node}.prom")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(self.spool_dir, exist_ok=True)
+
+    def publish(self) -> str:
+        text = self.provider.prometheus_text()
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+        return self.path
+
+    def start(self) -> "SpoolPublisher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"fts-spool-{self.node}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.publish()
+            except OSError:
+                pass  # spool dir raced away (teardown); keep serving
+
+    def stop(self, final_publish: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_publish:
+            try:
+                self.publish()
+            except OSError:
+                pass
+
+
+class FleetAggregator:
+    """Parent-side half: merge every spool exposition (+ the parent's own
+    registry) into one federated document, and account the federation
+    itself in ``fleet_*`` families."""
+
+    def __init__(self, spool_dir: str | os.PathLike,
+                 provider: MetricsProvider | None = None,
+                 clock=time.time):
+        self.spool_dir = os.fspath(spool_dir)
+        self.provider = provider or GLOBAL
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._last: dict | None = None
+        for fam, help_text in _FLEET_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    def _read_spool(self) -> tuple[dict[str, str], dict[str, float]]:
+        docs: dict[str, str] = {}
+        ages: dict[str, float] = {}
+        now = self.clock()
+        for path in sorted(glob.glob(os.path.join(self.spool_dir,
+                                                  "*.prom"))):
+            node = os.path.splitext(os.path.basename(path))[0]
+            try:
+                with open(path) as f:
+                    docs[node] = f.read()
+                ages[node] = max(0.0, now - os.path.getmtime(path))
+            except OSError:
+                continue  # torn down between glob and read
+        return docs, ages
+
+    def collect(self) -> str:
+        """One federation pass -> merged exposition text.
+
+        fleet_* instruments are updated BEFORE the parent's own registry
+        renders, so the federated document already describes this very
+        collect (same self-observation convention as telemetry
+        scrapes)."""
+        docs, ages = self._read_spool()
+        # pre-pass for the sample/conflict gauges: merge children only,
+        # cheap relative to the exposition sizes at fleet scale
+        _, pre = merge_expositions(docs)
+        self.provider.gauge("fleet_nodes").set(float(len(docs)))
+        self.provider.gauge("fleet_samples").set(float(pre.samples))
+        for kind, n in pre.conflicts.items():
+            self.provider.counter("fleet_merge_conflicts_total",
+                                  kind=kind).add(n)
+        for node, age in ages.items():
+            self.provider.gauge("fleet_node_age_seconds",
+                                node=node).set(round(age, 3))
+        text, merge = merge_expositions(
+            docs, self_text=self.provider.prometheus_text())
+        with self._lock:
+            self._last = {
+                "ts": self.clock(),
+                "nodes": {
+                    node: {"age_s": round(ages.get(node, 0.0), 3),
+                           "bytes": len(docs[node])}
+                    for node in docs},
+                "samples": merge.samples,
+                "conflicts": pre.conflicts,
+            }
+        return text
+
+    def summary(self) -> dict:
+        """JSON view for /fleetz (runs a fresh spool scan so the page is
+        live even if nothing scraped /metrics recently)."""
+        docs, ages = self._read_spool()
+        with self._lock:
+            last = self._last
+        return {
+            "spool_dir": self.spool_dir,
+            "nodes": {
+                node: {"age_s": round(ages.get(node, 0.0), 3),
+                       "bytes": len(docs[node])}
+                for node in sorted(docs)},
+            "last_collect": last,
+        }

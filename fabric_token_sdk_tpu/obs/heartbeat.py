@@ -1,0 +1,244 @@
+"""Per-phase heartbeat stamps + a stall detector with phase deadlines.
+
+The multichip dryrun has timed out five driver rounds in a row with
+``rc=124, tail=""`` — the process died silently somewhere between "jax
+initialized" and "verify done" and nothing recorded where. A heartbeat
+file turns that class of failure into a phase-attributed artifact: the
+running process stamps every phase transition (append-only, flushed per
+line, so a SIGKILL loses at most nothing), and any OTHER process — or a
+watchdog thread in the same one — can read the last stamp and say which
+phase the victim was in and for how long.
+
+Two halves, both clock-injectable:
+
+- :class:`Heartbeat` — the writer. ``beat(phase, detail)`` appends one
+  JSON line ``{"t": wall, "phase", "detail", "pid"}`` to the heartbeat
+  file (when one is configured), mirrors the event into the flight
+  recorder (kind ``heartbeat``), and bumps ``hb_beats_total{phase}``.
+- :class:`StallDetector` — the reader. ``check()`` compares the age of
+  the last beat against the current phase's deadline
+  (``deadlines[phase]``, else ``default_deadline_s``) and fires
+  ``on_stall(phase, age_s)`` edge-triggered (latched until the
+  heartbeat advances past the stalled stamp). ``start()`` runs it on a
+  daemon thread; tests drive ``check()`` with a fake clock instead.
+
+Cross-process use (the dryrun monitor): the child writes with
+:class:`Heartbeat`, the parent constructs ``StallDetector(reader=
+FileHeartbeatReader(path))`` — wall-clock timestamps are the shared
+timebase.
+
+Stable families: ``hb_beats_total{phase}``, ``hb_last_age_seconds``,
+``hb_stalls_total{phase}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .journal import EVENT_HEARTBEAT, JOURNAL, Journal
+from .metrics import GLOBAL, MetricsProvider
+
+_HB_FAMILIES = {
+    "hb_beats_total": "Heartbeat stamps written, by phase.",
+    "hb_last_age_seconds":
+        "Seconds since the most recent heartbeat stamp (set on beat and "
+        "by the stall detector on every check).",
+    "hb_stalls_total":
+        "Stall-detector trips (heartbeat older than the phase deadline), "
+        "by phase.",
+}
+
+
+class Heartbeat:
+    """Append-only phase progress stamps.
+
+    ``path=None`` keeps the heartbeat purely in-memory (journal + metrics
+    still see every beat). The file is opened lazily and every line is
+    flushed: the whole point is surviving an external SIGKILL.
+    """
+
+    def __init__(self, path: str | os.PathLike | None = None,
+                 provider: MetricsProvider | None = None,
+                 journal: Journal | None = None, clock=time.time):
+        self.path = None if path is None else os.fspath(path)
+        self.provider = provider or GLOBAL
+        self.journal = journal if journal is not None else JOURNAL
+        self.clock = clock
+        self._file = None
+        self._lock = threading.Lock()
+        self._last: dict | None = None
+        for fam, help_text in _HB_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    def beat(self, phase: str, detail: str = "") -> dict:
+        """Stamp a phase transition (or intra-phase progress)."""
+        stamp = {"t": round(self.clock(), 6), "phase": phase,
+                 "detail": detail, "pid": os.getpid()}
+        with self._lock:
+            self._last = stamp
+            if self.path is not None:
+                if self._file is None:
+                    self._file = open(self.path, "a")
+                self._file.write(json.dumps(stamp) + "\n")
+                self._file.flush()
+        self.provider.counter("hb_beats_total", phase=phase).add()
+        self.provider.gauge("hb_last_age_seconds").set(0.0)
+        if self.journal is not None:
+            self.journal.record(EVENT_HEARTBEAT, phase=phase,
+                                detail=detail)
+        return stamp
+
+    def last(self) -> dict | None:
+        """The most recent stamp written by THIS object (None before the
+        first beat)."""
+        with self._lock:
+            return self._last
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def read_last(path: str | os.PathLike) -> dict | None:
+    """Last complete stamp in a heartbeat file, from any process.
+
+    Tolerates a torn final line (the writer died mid-write): scans back
+    for the last line that parses. Returns None for a missing/empty
+    file."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    for line in reversed(data.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+class FileHeartbeatReader:
+    """StallDetector reader over a heartbeat file written by another
+    process (the dryrun monitor's view of its child)."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+
+    def __call__(self) -> dict | None:
+        return read_last(self.path)
+
+
+class StallDetector:
+    """Edge-triggered per-phase deadline watch over a heartbeat source.
+
+    ``reader`` is any ``() -> stamp-dict-or-None`` (a
+    :class:`Heartbeat`'s ``last`` method, or a
+    :class:`FileHeartbeatReader`). A phase whose last beat is older than
+    its deadline trips ``on_stall(phase, age_s)`` once; the latch clears
+    when a NEWER stamp appears (any phase), so a recovered run can trip
+    again later. ``None`` from the reader before ``grace_s`` has elapsed
+    is "not started yet", after it, a stall of phase ``"(no
+    heartbeat)"``.
+    """
+
+    NO_HEARTBEAT = "(no heartbeat)"
+
+    def __init__(self, reader, deadlines: dict[str, float] | None = None,
+                 default_deadline_s: float = 120.0,
+                 grace_s: float = 60.0, on_stall=None,
+                 provider: MetricsProvider | None = None,
+                 clock=time.time, poll_s: float = 1.0):
+        self.reader = reader
+        self.deadlines = dict(deadlines or {})
+        self.default_deadline_s = default_deadline_s
+        self.grace_s = grace_s
+        self.on_stall = on_stall
+        self.provider = provider or GLOBAL
+        self.clock = clock
+        self.poll_s = poll_s
+        self.stalls = 0
+        self._started_t: float | None = None
+        self._latched_t: float | None = None   # stamp time already fired on
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        for fam, help_text in _HB_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    def deadline_for(self, phase: str) -> float:
+        return self.deadlines.get(phase, self.default_deadline_s)
+
+    def check(self) -> tuple[str, float] | None:
+        """One detection pass; returns ``(phase, age_s)`` when it fires
+        (and calls ``on_stall``), else None. Pure given ``clock`` and
+        ``reader`` — the fake-clock test surface."""
+        now = self.clock()
+        if self._started_t is None:
+            self._started_t = now
+        stamp = self.reader()
+        if stamp is None:
+            if now - self._started_t < self.grace_s:
+                return None
+            phase, age, stamp_t = (self.NO_HEARTBEAT,
+                                   now - self._started_t, self._started_t)
+            if self._latched_t == stamp_t:
+                return None
+        else:
+            phase = stamp.get("phase", "?")
+            stamp_t = float(stamp.get("t", 0.0))
+            age = max(0.0, now - stamp_t)
+            self.provider.gauge("hb_last_age_seconds").set(round(age, 3))
+            if self._latched_t is not None and stamp_t > self._latched_t:
+                self._latched_t = None   # progress since the last trip
+            if age < self.deadline_for(phase) or self._latched_t is not None:
+                return None
+        self._latched_t = stamp_t
+        self.stalls += 1
+        self.provider.counter("hb_stalls_total", phase=phase).add()
+        if self.on_stall is not None:
+            self.on_stall(phase, age)
+        return phase, age
+
+    # ------------------------------------------------------ thread runner
+    def start(self) -> "StallDetector":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fts-stall-detector", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check()
+            except Exception:  # a broken reader must not kill the watch
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def incident_on_stall(journal: Journal | None = None,
+                      trigger: str = "heartbeat_stall"):
+    """An ``on_stall`` callback that dumps an incident snapshot — the
+    default wiring for in-process stall watching (the dryrun monitor
+    builds a richer report instead)."""
+    j = journal if journal is not None else JOURNAL
+
+    def _on_stall(phase: str, age_s: float) -> None:
+        j.incident(trigger,
+                   reason=f"phase {phase!r} heartbeat {age_s:.1f}s old")
+
+    return _on_stall

@@ -1,0 +1,285 @@
+"""Flight recorder: a bounded, thread-safe journal of typed events with
+JSONL spill and automatic incident snapshots.
+
+The telemetry plane (obs/telemetry.py) answers "how is the node doing
+right now"; the journal answers the question that matters once a run has
+*stopped making progress*: "what was the last thing every subsystem did,
+and what was it waiting on when it died". Every MULTICHIP_r0*.json so far
+reads ``rc=124, tail=""`` — a hang with zero diagnostic output — which is
+exactly the failure mode a flight recorder exists for.
+
+Event model: one process-global :class:`Journal` (``JOURNAL``) holds the
+last ``capacity`` events in a ring. An event is a small dict —
+``{"seq", "ts", "kind", ...attrs}`` — with ``kind`` drawn from the typed
+inventory below (admission, batching, dispatch, compiles, breaker
+transitions, SLO burns, fallbacks, heartbeats, watchdog abandons).
+Recording is cheap (one deque append + one counter bump under a lock) so
+the ring is always on; configuring a directory additionally spills every
+event as a JSON line (``journal.jsonl``) and enables incident snapshots.
+
+Incident snapshots: ``incident(trigger, ...)`` writes one self-contained
+JSON artifact with the journal tail, a ``faulthandler`` dump of every
+thread's stack, the tracer's still-open ("active") spans — a stalled
+dispatch is an open ``serve.dispatch`` span — and the outputs of any
+registered status sources. Triggers wired in this codebase: circuit
+breaker ``force_open``, SLO fast-burn, watchdog abandon, heartbeat
+stall. Snapshots are rate-limited (``min_interval_s``) so a flapping
+trigger cannot fill the disk.
+
+Stable families: ``journal_events_total{kind}``,
+``journal_dropped_total``, ``journal_incidents_total{trigger}``.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+
+from .metrics import GLOBAL, MetricsProvider
+
+# ------------------------------------------------------------ event kinds
+EVENT_REQUEST_ADMITTED = "request_admitted"
+EVENT_REQUEST_SHED = "request_shed"
+EVENT_BATCH_FORMED = "batch_formed"
+EVENT_DISPATCH_START = "dispatch_start"
+EVENT_DISPATCH_END = "dispatch_end"
+EVENT_COMPILE_START = "compile_start"
+EVENT_COMPILE_END = "compile_end"
+EVENT_BREAKER_TRANSITION = "breaker_transition"
+EVENT_SLO_BURN = "slo_burn"
+EVENT_FALLBACK = "fallback"
+EVENT_HEARTBEAT = "heartbeat"
+EVENT_WATCHDOG_ABANDON = "watchdog_abandon"
+EVENT_INCIDENT = "incident"
+
+EVENT_KINDS = (
+    EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED, EVENT_BATCH_FORMED,
+    EVENT_DISPATCH_START, EVENT_DISPATCH_END, EVENT_COMPILE_START,
+    EVENT_COMPILE_END, EVENT_BREAKER_TRANSITION, EVENT_SLO_BURN,
+    EVENT_FALLBACK, EVENT_HEARTBEAT, EVENT_WATCHDOG_ABANDON,
+    EVENT_INCIDENT,
+)
+
+_JOURNAL_FAMILIES = {
+    "journal_events_total": "Flight-recorder events recorded, by kind.",
+    "journal_dropped_total":
+        "Events evicted from the bounded journal ring (oldest-first).",
+    "journal_incidents_total":
+        "Incident snapshots written, by trigger.",
+}
+
+#: Events included in an incident snapshot's journal tail.
+_SNAPSHOT_TAIL = 512
+
+
+def _dump_all_thread_stacks() -> str:
+    """Every thread's Python stack via ``faulthandler`` (it walks the
+    interpreter's thread states directly, so it sees threads that are
+    blocked in C — a dispatch wedged inside an XLA call included, which
+    a pure-`traceback` walk can misattribute). faulthandler needs a real
+    file descriptor, so dump through an unlinked temp file."""
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        return f.read()
+
+
+class Journal:
+    """Bounded ring of typed events + spill + incident snapshots.
+
+    ``record`` is the single write path and is safe from any thread
+    (serve event loop, executor threads, scrape threads, stall-detector
+    threads). ``configure(dir)`` turns on the JSONL spill and gives
+    incident snapshots a home; without it the ring still records and
+    ``incident`` degrades to an :data:`EVENT_INCIDENT` ring entry (tests
+    and library users stay hermetic by default).
+    """
+
+    def __init__(self, capacity: int = 4096,
+                 provider: MetricsProvider | None = None,
+                 clock=time.time, min_interval_s: float = 30.0):
+        self.capacity = capacity
+        self.provider = provider or GLOBAL
+        self.clock = clock
+        self.min_interval_s = min_interval_s
+        self.dropped = 0
+        self.incidents = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._spill_path: str | None = None
+        self._spill_file = None
+        self._incident_dir: str | None = None
+        self._last_incident_t: float | None = None
+        self._status_sources: dict[str, object] = {}
+        for fam, help_text in _JOURNAL_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    # ------------------------------------------------------------- wiring
+    def configure(self, directory: str | os.PathLike,
+                  spill: bool = True) -> None:
+        """Point the journal at a directory: events spill to
+        ``journal.jsonl`` (append) and incident snapshots land as
+        ``incident_<trigger>_<seq>.json``. Idempotent; re-configuring
+        switches directories (the old spill file is closed)."""
+        directory = os.fspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            if self._spill_file is not None:
+                self._spill_file.close()
+                self._spill_file = None
+            self._incident_dir = directory
+            self._spill_path = (os.path.join(directory, "journal.jsonl")
+                                if spill else None)
+
+    def add_status_source(self, name: str, fn) -> None:
+        """Register a ``fn() -> JSON-serializable`` snapshot to embed in
+        every incident (same contract as TelemetryServer /statusz)."""
+        self._status_sources[name] = fn
+
+    @property
+    def spill_path(self) -> str | None:
+        return self._spill_path
+
+    @property
+    def incident_dir(self) -> str | None:
+        return self._incident_dir
+
+    # ------------------------------------------------------------ writing
+    def record(self, kind: str, **attrs) -> dict:
+        """Append one typed event; returns the event dict."""
+        with self._lock:
+            self._seq += 1
+            event = {"seq": self._seq, "ts": round(self.clock(), 6),
+                     "kind": kind}
+            event.update(attrs)
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+                self.provider.counter("journal_dropped_total").add()
+            self._ring.append(event)
+            spill = self._spill_file
+            if spill is None and self._spill_path is not None:
+                spill = self._spill_file = open(self._spill_path, "a")
+        self.provider.counter("journal_events_total", kind=kind).add()
+        if spill is not None:
+            # the file object's own lock serializes concurrent writers;
+            # flush per event — the spill exists for post-mortems, and a
+            # buffered tail lost to a SIGKILL defeats the point
+            try:
+                spill.write(json.dumps(event, default=str) + "\n")
+                spill.flush()
+            except ValueError:
+                pass  # closed mid-reconfigure: the ring still has it
+        return event
+
+    # ------------------------------------------------------------ reading
+    def tail(self, n: int | None = None) -> list[dict]:
+        """The most recent ``n`` events (all retained when ``n=None``),
+        oldest first."""
+        with self._lock:
+            events = list(self._ring)
+        return events if n is None else events[-n:]
+
+    def summary(self) -> dict:
+        """Point-in-time view for /statusz."""
+        with self._lock:
+            n = len(self._ring)
+            last = self._ring[-1] if self._ring else None
+        return {"events": n, "seq": self._seq, "dropped": self.dropped,
+                "incidents": self.incidents, "spill": self._spill_path,
+                "last": last}
+
+    # ---------------------------------------------------------- incidents
+    def incident(self, trigger: str, reason: str = "",
+                 force: bool = False, extra: dict | None = None
+                 ) -> str | None:
+        """Write one incident snapshot; returns its path (None when no
+        incident directory is configured or the rate limit suppressed
+        it). Never raises: an incident writer that can crash its caller
+        turns one failure into two."""
+        now = self.clock()
+        with self._lock:
+            limited = (not force
+                       and self._last_incident_t is not None
+                       and now - self._last_incident_t
+                       < self.min_interval_s)
+            if not limited:
+                self._last_incident_t = now
+        self.record(EVENT_INCIDENT, trigger=trigger, reason=reason,
+                    rate_limited=limited)
+        if limited or self._incident_dir is None:
+            return None
+        self.incidents += 1
+        self.provider.counter("journal_incidents_total",
+                              trigger=trigger).add()
+        snapshot = {
+            "schema": "fts-incident-v1",
+            "trigger": trigger,
+            "reason": reason,
+            "ts": now,
+            "journal_tail": self.tail(_SNAPSHOT_TAIL),
+            "threads": _dump_all_thread_stacks(),
+        }
+        try:
+            from .tracing import TRACER
+
+            snapshot["active_spans"] = [
+                {"name": sp.name, "span_id": sp.span_id,
+                 "trace_id": sp.trace_id, "parent_id": sp.parent_id,
+                 "age_s": round(time.perf_counter() - sp.start, 6),
+                 "attributes": dict(sp.attributes)}
+                for sp in TRACER.active_snapshot()]
+        except Exception as exc:  # pragma: no cover - defensive
+            snapshot["active_spans"] = [{"error": repr(exc)}]
+        status: dict = {}
+        for name, fn in self._status_sources.items():
+            try:
+                status[name] = fn()
+            except Exception as exc:
+                status[name] = {"error": repr(exc)}
+        snapshot["status"] = status
+        if extra:
+            snapshot["extra"] = extra
+        path = os.path.join(
+            self._incident_dir,
+            f"incident_{trigger}_{int(now)}_{self.incidents}.json")
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        return path
+
+    def reset(self) -> None:
+        """Drop ring + counters (test-fixture hook, like GLOBAL.reset).
+        Spill/incident configuration is kept."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self.dropped = 0
+            self.incidents = 0
+            self._last_incident_t = None
+
+
+def configure_from_env(journal: "Journal | None" = None) -> str | None:
+    """Opt-in wiring used by bench.py and the multichip dryrun: with
+    ``FTS_JOURNAL_DIR`` (or ``BENCH_JOURNAL_DIR``) set, spill the global
+    journal there and enable incident snapshots. Returns the directory
+    (or None)."""
+    directory = (os.environ.get("FTS_JOURNAL_DIR")
+                 or os.environ.get("BENCH_JOURNAL_DIR"))
+    if not directory:
+        return None
+    (journal or JOURNAL).configure(directory)
+    return directory
+
+
+#: Process-global flight recorder (GLOBAL / TRACER sibling).
+JOURNAL = Journal()

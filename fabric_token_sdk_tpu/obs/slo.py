@@ -30,6 +30,7 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
+from .journal import EVENT_SLO_BURN, JOURNAL
 from .metrics import GLOBAL, MetricsProvider
 
 #: Bound on retained (timestamp, ok, latency) events. At the ROADMAP
@@ -160,11 +161,22 @@ class SloMonitor:
             self.trips += 1
             self.provider.counter("slo_fast_burn_trips_total").add()
             self.provider.gauge("slo_fast_burn_active").set(1)
+            JOURNAL.record(EVENT_SLO_BURN, phase="trip",
+                           burn=[round(st["burn"], 3) for st in stats],
+                           availability=[round(st["availability"], 6)
+                                         for st in stats])
+            JOURNAL.incident(
+                "slo_fast_burn",
+                reason="burn rate >= {:.1f} on all windows: {}".format(
+                    self.policy.fast_burn,
+                    [round(st["burn"], 2) for st in stats]))
             if self.on_fast_burn is not None:
                 self.on_fast_burn()
         elif self.fast_burn_active and recovered:
             self.fast_burn_active = False
             self.provider.gauge("slo_fast_burn_active").set(0)
+            JOURNAL.record(EVENT_SLO_BURN, phase="recover",
+                           burn=[round(st["burn"], 3) for st in stats])
             if self.on_recover is not None:
                 self.on_recover()
         else:

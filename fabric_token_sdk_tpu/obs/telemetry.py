@@ -47,7 +47,8 @@ _TELEMETRY_FAMILIES = {
         "Telemetry endpoint render latency.",
 }
 
-_ENDPOINTS = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez")
+_ENDPOINTS = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez",
+              "/fleetz")
 
 
 @dataclass(frozen=True)
@@ -101,6 +102,7 @@ class TelemetryServer:
         self._health: dict[str, object] = {}
         self._ready: dict[str, object] = {}
         self._status: dict[str, object] = {}
+        self._federator = None
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._started_at: float | None = None
@@ -116,6 +118,14 @@ class TelemetryServer:
 
     def add_status_source(self, name: str, fn) -> None:
         self._status[name] = fn
+
+    def attach_federator(self, aggregator) -> None:
+        """Serve federated fleet metrics: /metrics becomes the
+        aggregator's merged exposition (parent registry + every spool
+        node, ``node``-labelled) and /fleetz serves its JSON summary.
+        ``aggregator`` duck-types obs.aggregate.FleetAggregator
+        (``collect() -> str``, ``summary() -> dict``)."""
+        self._federator = aggregator
 
     # -------------------------------------------------------- lifecycle
     def start(self) -> str:
@@ -190,8 +200,11 @@ class TelemetryServer:
             self.provider.counter("telemetry_scrapes_total",
                                   endpoint=path).add()
         if path == "/metrics":
+            text = (self._federator.collect()
+                    if self._federator is not None
+                    else self.provider.prometheus_text())
             return (200, "text/plain; version=0.0.4; charset=utf-8",
-                    self.provider.prometheus_text().encode())
+                    text.encode())
         if path == "/healthz":
             return self._check_body(self._health)
         if path == "/readyz":
@@ -207,6 +220,13 @@ class TelemetryServer:
                     status[name] = {"error": repr(exc)}
             return (200, "application/json",
                     json.dumps(status, default=str).encode())
+        if path == "/fleetz":
+            if self._federator is None:
+                doc: dict = {"enabled": False}
+            else:
+                doc = {"enabled": True, **self._federator.summary()}
+            return (200, "application/json",
+                    json.dumps(doc, default=str).encode())
         if path == "/tracez":
             doc = spans_to_chrome_trace(self.tracer.root_snapshot())
             return 200, "application/json", json.dumps(doc).encode()
@@ -250,12 +270,18 @@ def serve_telemetry(service, config: TelemetryConfig | None = None,
     if hasattr(service, "status"):
         server.add_status_source("serve", service.status)
 
+    from .journal import JOURNAL
     from .pipeline import RECORDS
     from .profiling import PROFILER
     server.add_status_source("pipeline", RECORDS.summary)
     server.add_status_source("profile", PROFILER.summary)
+    server.add_status_source("journal", JOURNAL.summary)
     slo = getattr(service, "slo", None)
     if slo is not None:
         server.add_status_source("slo", slo.summary)
+    # incident snapshots embed the same operational views /statusz serves
+    for name, fn in server._status.items():
+        if name != "journal":
+            JOURNAL.add_status_source(name, fn)
     server.start()
     return server

@@ -93,6 +93,7 @@ class Tracer:
         self.annotate_device = annotate_device
         self.finished: list[Span] = []
         self.roots: list[Span] = []
+        self._active: dict[int, Span] = {}
         self._keep = keep_spans
         self._lock = threading.Lock()
 
@@ -108,6 +109,8 @@ class Tracer:
                   attributes=dict(attributes))
         if parent is not None:
             parent.children.append(sp)
+        with self._lock:
+            self._active[sp.span_id] = sp
         return sp
 
     def _finish(self, sp: Span, end: float | None = None) -> None:
@@ -117,6 +120,7 @@ class Tracer:
             sanitize_metric_name(f"span_{sp.name}_seconds")).observe(
             sp.duration)
         with self._lock:
+            self._active.pop(sp.span_id, None)
             self.finished.append(sp)
             if len(self.finished) > self._keep:
                 self.finished.pop(0)
@@ -197,6 +201,15 @@ class Tracer:
         with self._lock:
             return list(self.roots)
 
+    def active_snapshot(self) -> list[Span]:
+        """Every span that has STARTED but not finished, oldest first —
+        the incident-snapshot view of what the process was doing when it
+        stopped making progress (a wedged dispatch is an open
+        ``serve.dispatch`` span with a large age). The Span objects are
+        live; callers must only read them."""
+        with self._lock:
+            return sorted(self._active.values(), key=lambda s: s.start)
+
     def last_root(self, name: str | None = None) -> Span | None:
         """Most recent completed root span (optionally by name)."""
         with self._lock:
@@ -209,6 +222,7 @@ class Tracer:
         with self._lock:
             self.finished.clear()
             self.roots.clear()
+            self._active.clear()
 
 
 #: Process-global default tracer: the one the verification pipeline

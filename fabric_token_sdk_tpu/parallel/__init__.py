@@ -7,4 +7,5 @@ axis is model-parallel ('tp') with an all-gather + point-fold combine over
 ICI (XLA collectives, not NCCL/MPI — SURVEY.md §2.5 "TPU-native equivalent").
 """
 
-from .mesh import make_mesh, shard_batch, sharded_msm_is_identity  # noqa: F401
+from .mesh import (make_mesh, set_heartbeat, shard_batch,  # noqa: F401
+                   sharded_msm_is_identity)

@@ -23,10 +23,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import ec
 
+#: Optional progress-stamp hook (any object with ``beat(phase, detail)``,
+#: normally an obs.heartbeat.Heartbeat). The multichip dryrun worker sets
+#: it so the stall detector can attribute a hang to "mesh build" vs
+#: "sharded compile" vs "sharded run"; duck-typed so this module never
+#: has to import obs/.
+_HEARTBEAT = None
+
+
+def set_heartbeat(hb) -> None:
+    """Install (or clear, with None) the mesh-phase heartbeat hook."""
+    global _HEARTBEAT
+    _HEARTBEAT = hb
+
+
+def _beat(phase: str, detail: str = "") -> None:
+    if _HEARTBEAT is not None:
+        _HEARTBEAT.beat(phase, detail)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """Version-skew shim over shard_map.
+
+    Newer jax exposes ``jax.shard_map`` whose replication checker is the
+    ``check_vma`` kwarg; older releases only have
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep``. Both
+    checks are disabled for the same reason: the msm fori_loop carries an
+    unvarying identity-point constant that the varying-manual-axes
+    checker would demand a pcast for inside the generic kernel."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False)
+
 
 def make_mesh(n_devices: int | None = None, dp: int | None = None,
               tp: int = 1, devices=None) -> Mesh:
     """Build a (dp, tp) mesh over the available devices."""
+    _beat("mesh_build", f"n={n_devices} tp={tp}")
     if devices is None:
         devices = jax.devices()
     if n_devices is None:
@@ -60,18 +101,18 @@ def sharded_msm_is_identity(mesh: Mesh, points: jnp.ndarray,
     Returns a jitted callable's result: (B,) bool, replicated.
     """
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             _partial_then_fold,
             mesh=mesh,
             in_specs=(P("dp", "tp", None, None), P("dp", "tp", None)),
             out_specs=P("dp"),
-            # the msm fori_loop carries an unvarying identity-point constant;
-            # varying-manual-axes checking would demand a pcast inside the
-            # generic kernel, so it is disabled for this wrapper.
-            check_vma=False,
         )
     )
-    return fn(points, scalars)
+    _beat("sharded_msm", f"B={points.shape[0]} T={points.shape[1]}")
+    out = fn(points, scalars)
+    out.block_until_ready()
+    _beat("sharded_msm_done")
+    return out
 
 
 def shard_batch(mesh: Mesh, arr: jnp.ndarray, axis: int = 0) -> jnp.ndarray:

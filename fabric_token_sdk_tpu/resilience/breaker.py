@@ -23,6 +23,7 @@ import time
 from collections import deque
 
 from ..obs import GLOBAL as _METRICS
+from ..obs.journal import EVENT_BREAKER_TRANSITION, JOURNAL
 
 STATE_CLOSED = "closed"
 STATE_OPEN = "open"
@@ -79,12 +80,15 @@ class CircuitBreaker:
     def _transition(self, state: str) -> None:
         if state == self.state:
             return
-        self.state = state
+        prev, self.state = self.state, state
         _METRICS.counter(
             "resil_breaker_transitions_total",
             help="Circuit-breaker state transitions, by target state",
             breaker=self.name, to=state).add()
         self._publish()
+        JOURNAL.record(EVENT_BREAKER_TRANSITION, breaker=self.name,
+                       src=prev, dst=state, forced=self._forced_open,
+                       failure_rate=round(self.failure_rate, 4))
 
     @property
     def failure_rate(self) -> float:
@@ -150,6 +154,10 @@ class CircuitBreaker:
         self._forced_open = True
         self._opened_at = self.clock()
         self._transition(STATE_OPEN)
+        JOURNAL.incident(
+            "breaker_force_open",
+            reason=f"breaker {self.name!r} latched open "
+                   f"(failure_rate={self.failure_rate:.3f})")
 
     def force_close(self) -> None:
         self._forced_open = False

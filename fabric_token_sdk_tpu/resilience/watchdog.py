@@ -22,6 +22,7 @@ import asyncio
 from concurrent.futures import ThreadPoolExecutor
 
 from ..obs import GLOBAL as _METRICS
+from ..obs.journal import EVENT_WATCHDOG_ABANDON, JOURNAL
 from .retry import TransientError
 
 
@@ -69,6 +70,14 @@ class DispatchWatchdog:
         _METRICS.counter(
             "resil_watchdog_trips_total",
             help="Hung device dispatches abandoned by the watchdog").add()
+        JOURNAL.record(EVENT_WATCHDOG_ABANDON, timeout_s=self.timeout_s,
+                       trips=self.trips)
+        # Snapshot BEFORE the executor swap: the wedged thread's stack
+        # (and its open serve.dispatch span) are the incident's payload.
+        JOURNAL.incident(
+            "watchdog_abandon",
+            reason=f"device dispatch exceeded {self.timeout_s}s "
+                   f"(trip #{self.trips})")
         # The hung thread is unkillable; orphan it and start fresh so the
         # next dispatch does not queue behind the wedge.
         self._executor.shutdown(wait=False)

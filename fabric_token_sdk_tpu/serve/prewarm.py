@@ -28,6 +28,8 @@ import time
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
+from ..obs.journal import (EVENT_COMPILE_END, EVENT_COMPILE_START,
+                           JOURNAL)
 from ..obs.profiling import PROFILER
 from .config import ServeConfig
 
@@ -66,9 +68,14 @@ class PrewarmManager:
             for bucket in self.config.buckets:
                 if bucket in self.ready:
                     continue
+                JOURNAL.record(EVENT_COMPILE_START, what="serve_prewarm",
+                               bucket=bucket)
                 per_shape = self.zk.prewarm_shapes(
                     (bucket,), include_block=self.config.prewarm_block)
                 elapsed = per_shape[bucket]
+                JOURNAL.record(EVENT_COMPILE_END, what="serve_prewarm",
+                               bucket=bucket,
+                               elapsed_s=round(elapsed, 3))
                 self.compile_s[bucket] = elapsed
                 self.ready.add(bucket)
                 _METRICS.histogram(

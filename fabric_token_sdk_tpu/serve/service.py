@@ -54,6 +54,10 @@ import numpy as np
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
+from ..obs.journal import (EVENT_BATCH_FORMED, EVENT_DISPATCH_END,
+                           EVENT_DISPATCH_START, EVENT_FALLBACK,
+                           EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED,
+                           JOURNAL)
 from ..obs.profiling import PROFILER
 from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
     ResilienceConfig
@@ -248,10 +252,15 @@ class VerificationService:
         shed = self.admission.admit(req, self.scheduler.lane_depth(lane))
         if shed is not None:
             result = VerifyResult(status=shed)
+            JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
+                           req_id=req.req_id, status=shed)
             if self.slo is not None:
                 self.slo.record(False)
             self._finish_request_span(req, result)
             return result
+        JOURNAL.record(EVENT_REQUEST_ADMITTED, req_kind=kind, lane=lane,
+                       req_id=req.req_id,
+                       depth=self.scheduler.lane_depth(lane))
         if req.span is not None:
             req.span.add_event(
                 "admitted", depth=self.scheduler.lane_depth(lane))
@@ -327,15 +336,26 @@ class VerificationService:
                 bspan.add_link(req.span, role="member")
                 req.span.add_link(bspan, role="batch")
         self._batch_span = bspan
+        JOURNAL.record(EVENT_BATCH_FORMED, group=group, rows=len(batch),
+                       bucket=bucket, span_id=bspan.span_id)
+        JOURNAL.record(EVENT_DISPATCH_START, group=group,
+                       rows=len(batch), bucket=bucket,
+                       span_id=bspan.span_id)
+        outcome = "error"
         try:
             verdicts, served_by = await self._dispatch_resilient(batch,
                                                                  bspan)
             bspan.set_attribute("served_by", served_by)
+            outcome = served_by
             return verdicts, served_by
         except Exception as exc:
             bspan.set_attribute("error", f"{type(exc).__name__}: {exc}")
+            outcome = f"error: {type(exc).__name__}"
             raise
         finally:
+            JOURNAL.record(EVENT_DISPATCH_END, group=group,
+                           rows=len(batch), span_id=bspan.span_id,
+                           outcome=outcome)
             self._batch_span = None
             _TRACER.end_span(bspan)
             PROFILER.record_memory_watermark()
@@ -373,6 +393,10 @@ class VerificationService:
             return verdicts, SERVED_BY_DEVICE
         if self._fallback is not None:
             group = batch[0].group
+            JOURNAL.record(
+                EVENT_FALLBACK, group=group, rows=len(batch),
+                why=(f"{type(last_exc).__name__}" if last_exc is not None
+                     else f"breaker {self._breaker.state}"))
             with _TRACER.span("resil.fallback", parent=bspan, group=group,
                               rows=len(batch)):
                 verdicts = await asyncio.get_running_loop().run_in_executor(

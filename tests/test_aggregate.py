@@ -1,0 +1,183 @@
+"""Fleet federation (obs/aggregate.py): exposition parsing, merge
+semantics (HELP/TYPE conflicts, node-label collisions), the spool
+publisher/aggregator pair, and the federated /metrics + /fleetz HTTP
+surface. The merged document must satisfy the same Prometheus grammar
+walker the single-process plane is held to (test_telemetry.py), with the
+stable family inventory unchanged — only a new ``node`` dimension.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from fabric_token_sdk_tpu.obs.aggregate import (FleetAggregator,
+                                                SpoolPublisher,
+                                                merge_expositions,
+                                                parse_exposition)
+from fabric_token_sdk_tpu.obs.metrics import MetricsProvider
+
+from test_telemetry import validate_prometheus
+
+
+def _node_provider(name: str, reqs: int) -> MetricsProvider:
+    p = MetricsProvider()
+    p.describe("serve_requests_total", "Requests admitted per lane.")
+    p.describe("serve_queue_depth", "Live queue depth.")
+    p.describe("serve_dispatch_seconds", "Dispatch wall time.")
+    p.counter("serve_requests_total", lane=name).add(reqs)
+    p.gauge("serve_queue_depth").set(float(reqs % 5))
+    p.histogram("serve_dispatch_seconds").observe(0.01 * (reqs + 1))
+    return p
+
+
+# ----------------------------------------------------------------- parse
+
+
+def test_parse_roundtrips_counter_gauge_histogram():
+    text = _node_provider("a", 3).prometheus_text()
+    fams = parse_exposition(text)
+    assert fams["serve_requests_total"]["type"] == "counter"
+    assert fams["serve_requests_total"]["help"].startswith("Requests")
+    (sample_name, labels, value), = fams["serve_requests_total"]["samples"]
+    assert sample_name == "serve_requests_total"
+    assert ("lane", "a") in labels and value == "3.0"
+    # histogram series attach to the base family
+    hist = fams["serve_dispatch_seconds"]["samples"]
+    names = {s[0] for s in hist}
+    assert {"serve_dispatch_seconds_bucket", "serve_dispatch_seconds_sum",
+            "serve_dispatch_seconds_count"} <= names
+    assert any(("le", "+Inf") in s[1] for s in hist)
+
+
+def test_parse_keeps_values_verbatim_and_unescapes_labels():
+    fams = parse_exposition(
+        '# TYPE x gauge\nx{k="a\\"b\\\\c\\nd"} NaN\nx 1e-09\n')
+    samples = fams["x"]["samples"]
+    assert samples[0][1] == [("k", 'a"b\\c\nd')]
+    assert samples[0][2] == "NaN" and samples[1][2] == "1e-09"
+
+
+def test_parse_rejects_malformed_sample_line():
+    with pytest.raises(ValueError):
+        parse_exposition("not a metric line at all {\n")
+
+
+# ----------------------------------------------------------------- merge
+
+
+def test_merge_injects_node_label_and_keeps_family_names():
+    docs = {n: _node_provider(n, i + 1).prometheus_text()
+            for i, n in enumerate(("n0", "n1", "n2"))}
+    text, merge = merge_expositions(docs)
+    validate_prometheus(text)
+    for n in docs:
+        assert f'node="{n}"' in text
+    # family names are untouched — no fleet_ prefixing of child families
+    assert 'serve_requests_total{lane="n1",node="n1"} 2.0' in text
+    assert merge.conflicts == {}
+    assert merge.samples == sum(
+        len(f["samples"]) for d in docs.values()
+        for f in parse_exposition(d).values())
+
+
+def test_merge_help_conflict_first_wins_and_is_counted():
+    docs = {
+        "a": '# HELP f one\n# TYPE f counter\nf 1.0\n',
+        "b": '# HELP f two\n# TYPE f gauge\nf 2.0\n',
+    }
+    text, merge = merge_expositions(docs)
+    validate_prometheus(text)
+    assert "# HELP f one" in text and "two" not in text
+    assert "# TYPE f counter" in text
+    assert merge.conflicts == {"help": 1, "type": 1}
+
+
+def test_merge_renames_colliding_node_label():
+    docs = {"parent": '# TYPE f counter\nf{node="inner"} 1.0\n'}
+    text, merge = merge_expositions(docs)
+    validate_prometheus(text)
+    assert 'node_orig="inner"' in text
+    assert 'node="parent"' in text
+    assert merge.conflicts == {"label": 1}
+
+
+def test_merge_self_text_carries_no_node_label():
+    text, _ = merge_expositions(
+        {"n0": '# TYPE f counter\nf 1.0\n'},
+        self_text='# TYPE own gauge\nown 7.0\n')
+    assert "own 7.0" in text            # bare: the parent is not a node
+    assert 'f{node="n0"} 1.0' in text
+
+
+def test_merge_unparseable_doc_counted_not_fatal():
+    text, merge = merge_expositions(
+        {"good": '# TYPE f counter\nf 1.0\n', "bad": "}{ torn write\n"})
+    assert 'f{node="good"} 1.0' in text
+    assert merge.conflicts == {"parse": 1}
+
+
+# --------------------------------------------------- spool + aggregator
+
+
+def test_three_node_spool_federation(tmp_path):
+    spool = tmp_path / "spool"
+    for i, n in enumerate(("issuer", "alice", "bob")):
+        SpoolPublisher(spool, n, provider=_node_provider(n, i + 1)).publish()
+
+    parent = MetricsProvider()
+    agg = FleetAggregator(spool, provider=parent)
+    text = agg.collect()
+    types = validate_prometheus(text)   # {family: type}, raises on error
+
+    for n in ("issuer", "alice", "bob"):
+        assert f'node="{n}"' in text
+    # the federation observes itself, inside the same document
+    assert "fleet_nodes 3.0" in text
+    assert types["fleet_nodes"] == "gauge"
+    assert types["serve_requests_total"] == "counter"
+    assert 'fleet_node_age_seconds{node="alice"}' in text
+
+    doc = agg.summary()
+    assert set(doc["nodes"]) == {"issuer", "alice", "bob"}
+    assert doc["last_collect"]["samples"] > 0
+    assert doc["last_collect"]["conflicts"] == {}
+
+
+def test_federated_metrics_and_fleetz_over_http(tmp_path):
+    from fabric_token_sdk_tpu.obs import TelemetryConfig, TelemetryServer
+
+    spool = tmp_path / "spool"
+    for n in ("n0", "n1", "n2"):
+        SpoolPublisher(spool, n, provider=_node_provider(n, 2)).publish()
+    parent = MetricsProvider()
+    server = TelemetryServer(TelemetryConfig(port=0), provider=parent)
+    server.attach_federator(FleetAggregator(spool, provider=parent))
+    url = server.start()
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=10.0) as r:
+            text = r.read().decode()
+        with urllib.request.urlopen(url + "/fleetz", timeout=10.0) as r:
+            fleetz = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    validate_prometheus(text)
+    assert 'node="n2"' in text
+    # the scrape itself is accounted, un-labelled (parent's own registry)
+    assert "telemetry_scrapes_total" in text
+    assert fleetz["enabled"] is True
+    assert set(fleetz["nodes"]) == {"n0", "n1", "n2"}
+
+
+def test_fleetz_disabled_without_federator():
+    from fabric_token_sdk_tpu.obs import TelemetryConfig, TelemetryServer
+
+    server = TelemetryServer(TelemetryConfig(port=0),
+                             provider=MetricsProvider())
+    url = server.start()
+    try:
+        with urllib.request.urlopen(url + "/fleetz", timeout=10.0) as r:
+            doc = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    assert doc == {"enabled": False}

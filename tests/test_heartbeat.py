@@ -1,0 +1,126 @@
+"""Heartbeat writer + stall detector (obs/heartbeat.py), driven entirely
+by fake clocks and temp files: stamp format, torn-line tolerant reads,
+per-phase deadlines, edge-triggered latching, and the incident wiring.
+"""
+
+import json
+import os
+
+from fabric_token_sdk_tpu.obs import GLOBAL
+from fabric_token_sdk_tpu.obs.heartbeat import (FileHeartbeatReader,
+                                                Heartbeat, StallDetector,
+                                                incident_on_stall, read_last)
+from fabric_token_sdk_tpu.obs.journal import Journal
+
+# ---------------------------------------------------------------- writer
+
+
+def test_beat_appends_flushed_stamps(tmp_path):
+    j = Journal()
+    path = tmp_path / "hb.jsonl"
+    hb = Heartbeat(path, journal=j, clock=lambda: 100.5)
+    hb.beat("jax_init", "8 devices")
+    hb.beat("verify")
+    # flushed per line: readable without close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    stamp = json.loads(lines[0])
+    assert stamp == {"t": 100.5, "phase": "jax_init",
+                     "detail": "8 devices", "pid": os.getpid()}
+    assert hb.last()["phase"] == "verify"
+    # every beat is mirrored into the flight recorder
+    assert [e["phase"] for e in j.tail()] == ["jax_init", "verify"]
+    hb.close()
+
+
+def test_pathless_heartbeat_stays_in_memory():
+    hb = Heartbeat(journal=None)
+    hb.beat("x")
+    assert hb.last()["phase"] == "x"
+
+
+def test_read_last_tolerates_torn_final_line(tmp_path):
+    path = tmp_path / "hb.jsonl"
+    hb = Heartbeat(path, journal=None, clock=lambda: 7.0)
+    hb.beat("setup")
+    hb.beat("verify")
+    hb.close()
+    # the writer died mid-write: a torn, unparseable final line
+    with open(path, "a") as f:
+        f.write('{"t": 9.0, "phase": "tam')
+    stamp = read_last(path)
+    assert stamp["phase"] == "verify" and stamp["t"] == 7.0
+    assert read_last(tmp_path / "missing.jsonl") is None
+    reader = FileHeartbeatReader(path)
+    assert reader()["phase"] == "verify"
+
+
+# -------------------------------------------------------- stall detection
+
+
+def _detector(reader, clock, **kw):
+    kw.setdefault("provider", GLOBAL)
+    kw.setdefault("grace_s", 5.0)
+    kw.setdefault("default_deadline_s", 10.0)
+    return StallDetector(reader, clock=clock, **kw)
+
+
+def test_stall_fires_once_per_stamp_then_relatches_on_progress():
+    now = [0.0]
+    hb = Heartbeat(journal=None, clock=lambda: now[0])
+    det = _detector(hb.last, lambda: now[0],
+                    deadlines={"verify": 2.0})
+    hb.beat("verify")
+    now[0] = 1.0
+    assert det.check() is None           # under the phase deadline
+    now[0] = 3.5
+    phase, age = det.check()             # over it: fires
+    assert phase == "verify" and age == 3.5
+    assert det.check() is None           # latched: no re-fire
+    assert det.stalls == 1
+    hb.beat("verify")                    # progress clears the latch
+    now[0] = 7.0
+    phase, age = det.check()
+    assert phase == "verify" and det.stalls == 2
+
+
+def test_default_deadline_applies_to_unlisted_phase():
+    now = [0.0]
+    hb = Heartbeat(journal=None, clock=lambda: now[0])
+    det = _detector(hb.last, lambda: now[0], deadlines={"verify": 2.0},
+                    default_deadline_s=50.0)
+    hb.beat("compile")
+    now[0] = 20.0
+    assert det.check() is None           # 20s < default 50s
+    now[0] = 60.0
+    assert det.check() == ("compile", 60.0)
+
+
+def test_no_heartbeat_trips_after_grace():
+    now = [0.0]
+    det = _detector(lambda: None, lambda: now[0], grace_s=5.0)
+    assert det.check() is None           # within grace: not started yet
+    now[0] = 6.0
+    phase, age = det.check()
+    assert phase == StallDetector.NO_HEARTBEAT and age == 6.0
+    assert det.check() is None           # latched
+
+
+def test_on_stall_callback_and_incident_wiring(tmp_path):
+    now = [0.0]
+    hb = Heartbeat(journal=None, clock=lambda: now[0])
+    j = Journal(min_interval_s=0.0)
+    j.configure(tmp_path)
+    fired = []
+    det = _detector(hb.last, lambda: now[0], default_deadline_s=1.0,
+                    on_stall=lambda phase, age: (
+                        fired.append(phase),
+                        incident_on_stall(j)(phase, age)))
+    hb.beat("sharded_msm")
+    now[0] = 2.0
+    assert det.check() is not None
+    assert fired == ["sharded_msm"]
+    snaps = list(tmp_path.glob("incident_heartbeat_stall_*.json"))
+    assert len(snaps) == 1
+    doc = json.loads(snaps[0].read_text())
+    assert "sharded_msm" in doc["reason"]

@@ -1,0 +1,255 @@
+"""Flight recorder (obs/journal.py): ring semantics, spill, concurrency,
+and incident snapshots — including the end-to-end "stalled dispatch"
+scenario the subsystem exists for: a seeded FaultInjector stall wedges a
+serve dispatch, the watchdog abandons it, and the incident snapshot must
+contain the journal tail, every thread's stack, and the still-open
+``serve.dispatch`` span.
+
+Everything here runs against fake backends (no device, no crypto): tier-1.
+"""
+
+import asyncio
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL, TRACER
+from fabric_token_sdk_tpu.obs.journal import (EVENT_DISPATCH_START,
+                                              EVENT_INCIDENT, EVENT_KINDS,
+                                              JOURNAL, Journal,
+                                              configure_from_env)
+
+# ------------------------------------------------------------ ring + spill
+
+
+def test_ring_wraps_and_counts_drops():
+    j = Journal(capacity=4, provider=GLOBAL)
+    for i in range(10):
+        j.record("heartbeat", i=i)
+    events = j.tail()
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]  # oldest first
+    assert j.dropped == 6
+    assert events[-1]["seq"] == 10
+    assert j.summary()["dropped"] == 6
+
+
+def test_tail_n_returns_newest_oldest_first():
+    j = Journal(capacity=16)
+    for i in range(8):
+        j.record("heartbeat", i=i)
+    assert [e["i"] for e in j.tail(3)] == [5, 6, 7]
+
+
+def test_event_kind_inventory_is_unique():
+    assert len(set(EVENT_KINDS)) == len(EVENT_KINDS)
+
+
+def test_spill_writes_parseable_jsonl(tmp_path):
+    j = Journal(capacity=8)
+    j.configure(tmp_path)
+    for i in range(5):
+        j.record("dispatch_start", group="range", i=i)
+    lines = (tmp_path / "journal.jsonl").read_text().splitlines()
+    assert len(lines) == 5
+    docs = [json.loads(line) for line in lines]
+    assert [d["i"] for d in docs] == list(range(5))
+    assert all(d["kind"] == "dispatch_start" for d in docs)
+    # spill is flushed per event: the file is already complete on disk
+    assert docs[-1]["seq"] == 5
+
+
+def test_reconfigure_switches_spill_directory(tmp_path):
+    j = Journal()
+    j.configure(tmp_path / "a")
+    j.record("heartbeat")
+    j.configure(tmp_path / "b")
+    j.record("heartbeat")
+    assert len((tmp_path / "a" / "journal.jsonl").read_text()
+               .splitlines()) == 1
+    assert len((tmp_path / "b" / "journal.jsonl").read_text()
+               .splitlines()) == 1
+
+
+def test_concurrent_record_loses_nothing(tmp_path):
+    j = Journal(capacity=10_000)
+    j.configure(tmp_path)
+    n_threads, per = 8, 200
+
+    def work(tid):
+        for i in range(per):
+            j.record("heartbeat", tid=tid, i=i)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = j.tail()
+    assert len(events) == n_threads * per
+    assert j.dropped == 0
+    # seq is a gapless total order under contention
+    assert sorted(e["seq"] for e in events) == \
+        list(range(1, n_threads * per + 1))
+    spilled = (tmp_path / "journal.jsonl").read_text().splitlines()
+    assert len(spilled) == n_threads * per
+
+
+# -------------------------------------------------------------- incidents
+
+
+def test_incident_without_directory_degrades_to_ring_event():
+    j = Journal()
+    assert j.incident("smoke", reason="no home") is None
+    last = j.tail(1)[0]
+    assert last["kind"] == EVENT_INCIDENT
+    assert last["trigger"] == "smoke"
+
+
+def test_incident_snapshot_contents_and_rate_limit(tmp_path):
+    fake = [1000.0]
+    j = Journal(provider=GLOBAL, clock=lambda: fake[0],
+                min_interval_s=30.0)
+    j.configure(tmp_path)
+    j.add_status_source("good", lambda: {"depth": 3})
+    j.add_status_source("broken", lambda: 1 / 0)
+    j.record("batch_formed", group="range", rows=7)
+
+    path = j.incident("breaker_force_open", reason="latched",
+                      extra={"note": "x"})
+    assert path is not None and os.path.exists(path)
+    doc = json.loads(open(path).read())
+    assert doc["schema"] == "fts-incident-v1"
+    assert doc["trigger"] == "breaker_force_open"
+    assert doc["reason"] == "latched"
+    assert any(e["kind"] == "batch_formed" for e in doc["journal_tail"])
+    # faulthandler's all-thread dump is embedded
+    assert "thread" in doc["threads"].lower()
+    assert doc["status"]["good"] == {"depth": 3}
+    assert "error" in doc["status"]["broken"]
+    assert doc["extra"] == {"note": "x"}
+
+    # rate limit: a second trigger inside min_interval_s is suppressed
+    fake[0] += 5.0
+    assert j.incident("breaker_force_open") is None
+    assert j.tail(1)[0]["rate_limited"] is True
+    # ... unless forced, or the interval has elapsed
+    assert j.incident("slo_fast_burn", force=True) is not None
+    fake[0] += 60.0
+    assert j.incident("slo_fast_burn") is not None
+
+
+def test_incident_includes_open_spans(tmp_path):
+    TRACER.clear()
+    j = Journal(min_interval_s=0.0)
+    j.configure(tmp_path)
+    with TRACER.span("serve.dispatch", group="range", rows=4):
+        path = j.incident("watchdog_abandon")
+    doc = json.loads(open(path).read())
+    names = [s["name"] for s in doc["active_spans"]]
+    assert "serve.dispatch" in names
+    sp = doc["active_spans"][names.index("serve.dispatch")]
+    assert sp["attributes"]["rows"] == 4
+    # after the with-block the span is closed: no longer "active"
+    path2 = j.incident("watchdog_abandon")
+    doc2 = json.loads(open(path2).read())
+    assert "serve.dispatch" not in [s["name"] for s in doc2["active_spans"]]
+
+
+def test_configure_from_env(tmp_path, monkeypatch):
+    j = Journal()
+    monkeypatch.delenv("FTS_JOURNAL_DIR", raising=False)
+    monkeypatch.delenv("BENCH_JOURNAL_DIR", raising=False)
+    assert configure_from_env(j) is None
+    monkeypatch.setenv("BENCH_JOURNAL_DIR", str(tmp_path / "flight"))
+    assert configure_from_env(j) == str(tmp_path / "flight")
+    j.record("heartbeat")
+    assert (tmp_path / "flight" / "journal.jsonl").exists()
+
+
+# ------------------------------------------------- e2e: stalled dispatch
+
+
+class _StallOnceRange:
+    """First verify wedges on an event (the injected stall); later calls
+    answer instantly — the watchdog's retry lands here."""
+
+    def __init__(self, hang):
+        self.hang = hang
+        self.calls = 0
+
+    def verify(self, proofs, commitments):
+        self.calls += 1
+        if self.calls == 1:
+            self.hang.wait(10.0)
+        return np.ones(len(proofs), dtype=bool)
+
+
+class _ZK:
+    def __init__(self, rng):
+        self._range = rng
+
+
+@pytest.fixture
+def global_journal(tmp_path):
+    """Point the process-global JOURNAL (hardwired into watchdog/breaker)
+    at a temp dir for one test, then restore its unconfigured state."""
+    JOURNAL.reset()
+    JOURNAL.configure(tmp_path)
+    old_interval, JOURNAL.min_interval_s = JOURNAL.min_interval_s, 0.0
+    yield tmp_path
+    JOURNAL.reset()
+    with JOURNAL._lock:
+        if JOURNAL._spill_file is not None:
+            JOURNAL._spill_file.close()
+            JOURNAL._spill_file = None
+        JOURNAL._spill_path = None
+        JOURNAL._incident_dir = None
+    JOURNAL.min_interval_s = old_interval
+
+
+def test_watchdog_abandon_snapshot_contains_stalled_dispatch(global_journal):
+    """A stalled dispatch (FaultInjector-style wedge) must produce an
+    incident snapshot whose payload shows WHERE it stalled: the open
+    serve.dispatch span and the wedged thread's stack."""
+    from fabric_token_sdk_tpu.resilience import ResilienceConfig
+    from fabric_token_sdk_tpu.serve import ServeConfig, VerificationService
+
+    TRACER.clear()
+    hang = threading.Event()
+    rng = _StallOnceRange(hang)
+    svc = VerificationService(
+        _ZK(rng), config=ServeConfig(buckets=(4,), max_wait_s=0.005),
+        resilience=ResilienceConfig(
+            retry_attempts=3, retry_base_s=0.0, retry_cap_s=0.0,
+            breaker_min_volume=10_000, watchdog_timeout_s=0.15))
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await asyncio.wait_for(
+            svc.submit_range(True, object(), deadline_s=30.0), timeout=10.0)
+        await svc.stop()
+        return res
+
+    try:
+        res = asyncio.run(run())
+    finally:
+        hang.set()
+    assert res.ok and rng.calls >= 2  # abandoned once, then served
+
+    snaps = sorted(global_journal.glob("incident_watchdog_abandon_*.json"))
+    assert snaps, "watchdog abandon wrote no incident snapshot"
+    doc = json.loads(snaps[0].read_text())
+    # the stalled dispatch span was still open at snapshot time
+    names = [s["name"] for s in doc["active_spans"]]
+    assert "serve.dispatch" in names
+    # the journal tail shows the dispatch that never ended
+    kinds = [e["kind"] for e in doc["journal_tail"]]
+    assert EVENT_DISPATCH_START in kinds
+    assert kinds.index(EVENT_DISPATCH_START) < kinds.index(EVENT_INCIDENT)
+    # the wedged worker thread's stack is in the all-thread dump
+    assert "verify" in doc["threads"]

@@ -1,0 +1,120 @@
+"""Tier-1 guard for the multichip dryrun path (parallel/dryrun.py).
+
+The contract under test: a dryrun can END WELL or END DIAGNOSED — never
+vanish. The monitor streams the worker's output to a log, watches its
+per-phase heartbeat with a StallDetector, and rewrites the report JSON
+on every poll tick, so ``rc=124 with an empty report`` is impossible by
+construction. Scripted children prove the three terminal shapes cheaply
+(success / crash / stall); the real-worker test then runs the actual
+sharded-MSM light leg on 8 simulated host CPU devices
+(``--xla_force_host_platform_device_count``) under the same watch.
+"""
+
+import json
+import sys
+import textwrap
+
+from fabric_token_sdk_tpu.parallel.dryrun import monitor
+
+# scripted child: beats phases over the monitor's heartbeat protocol
+# (raw JSON lines — no repo imports, so these tests stay fast)
+_CHILD_PRELUDE = textwrap.dedent("""\
+    import json, os, sys, time
+    def beat(phase, detail=""):
+        with open(os.environ["FTS_HEARTBEAT_FILE"], "a") as f:
+            f.write(json.dumps({"t": time.time(), "phase": phase,
+                                "detail": detail,
+                                "pid": os.getpid()}) + "\\n")
+            f.flush()
+    """)
+
+
+def _scripted(body: str) -> list[str]:
+    return [sys.executable, "-u", "-c", _CHILD_PRELUDE + textwrap.dedent(body)]
+
+
+def _monitor(tmp_path, body, **kw):
+    kw.setdefault("grace_s", 10.0)
+    kw.setdefault("poll_s", 0.1)
+    kw.setdefault("default_deadline_s", 30.0)
+    return monitor(8, report_path=str(tmp_path / "report.json"),
+                   child_argv=_scripted(body), **kw)
+
+
+def test_monitor_success_reports_final_phase(tmp_path):
+    report = _monitor(tmp_path, """
+        beat("jax_init"); print("starting", flush=True)
+        beat("verify"); beat("done", "all verdicts True")
+        print("finished", flush=True)
+        """)
+    assert report["ok"] and report["rc"] == 0 and not report["stalled"]
+    assert report["schema"] == "fts-multichip-v2"
+    assert report["phase"] == "done"
+    assert report["diagnosis"] == "completed"
+    assert "finished" in report["tail"]
+    # the on-disk artifact matches what the caller got
+    disk = json.loads((tmp_path / "report.json").read_text())
+    assert disk["phase"] == "done" and disk["ok"] is True
+
+
+def test_monitor_crash_is_phase_attributed_with_tail(tmp_path):
+    report = _monitor(tmp_path, """
+        beat("pp_setup")
+        print("about to fail: boom detail", flush=True)
+        sys.exit(3)
+        """)
+    assert not report["ok"] and report["rc"] == 3
+    assert report["phase"] == "pp_setup"
+    assert "rc=3" in report["diagnosis"]
+    assert "pp_setup" in report["diagnosis"]
+    assert "boom detail" in report["tail"]
+
+
+def test_monitor_stall_is_detected_attributed_and_killed(tmp_path):
+    report = _monitor(tmp_path, """
+        beat("verify")
+        print("entering the wedge", flush=True)
+        time.sleep(120)
+        """, deadlines={"verify": 1.0})
+    assert report["stalled"] is True and not report["ok"]
+    assert report["phase"] == "verify"
+    assert "stalled in phase 'verify'" in report["diagnosis"]
+    assert report["last_heartbeat_age_s"] >= 1.0
+    assert "entering the wedge" in report["tail"]
+    # the worker was actually killed, not left behind
+    assert report["rc"] is not None and report["rc"] != 0
+    disk = json.loads((tmp_path / "report.json").read_text())
+    assert disk["stalled"] is True and disk["phase"] == "verify"
+
+
+def test_monitor_child_that_never_beats_trips_no_heartbeat(tmp_path):
+    report = _monitor(tmp_path, """
+        print("no beats ever", flush=True)
+        time.sleep(120)
+        """, grace_s=1.0)
+    assert report["stalled"] is True
+    assert report["phase"] == "(no heartbeat)"
+    assert "no beats ever" in report["tail"]
+
+
+def test_real_light_dryrun_on_8_simulated_devices(tmp_path):
+    """The actual worker: mesh build + sharded-MSM identity check on 8
+    simulated host devices, under the stall detector. It must either
+    complete or be killed WITH a phase-attributed diagnosis — a bare
+    timeout (empty phase, empty tail) fails this test in every branch."""
+    report = monitor(
+        8, light=True, report_path=str(tmp_path / "light.json"),
+        deadlines={"jax_init": 240.0, "sharded_msm": 600.0},
+        default_deadline_s=300.0, grace_s=90.0, poll_s=0.5,
+        total_timeout_s=600.0)
+    # attribution invariants hold on EVERY outcome
+    assert report["schema"] == "fts-multichip-v2"
+    assert report["phase"] not in ("", "spawn"), report
+    assert report["diagnosis"], report
+    assert report["tail"], "worker produced no output at all"
+    if not report["ok"]:
+        raise AssertionError(
+            f"light dryrun failed (but was attributed): "
+            f"{report['diagnosis']}\n--- tail ---\n{report['tail']}")
+    assert report["phase"] == "done"
+    assert "light run complete" in report["tail"]

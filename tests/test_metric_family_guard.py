@@ -92,6 +92,19 @@ STABLE_FAMILIES = (
     "profile_compile_seconds",
     "profile_device_bytes_in_use",
     "profile_device_peak_bytes",
+    # obs/ flight recorder
+    "journal_dropped_total",
+    "journal_events_total",
+    "journal_incidents_total",
+    # obs/ heartbeat + stall detection
+    "hb_beats_total",
+    "hb_last_age_seconds",
+    "hb_stalls_total",
+    # obs/ fleet federation
+    "fleet_merge_conflicts_total",
+    "fleet_node_age_seconds",
+    "fleet_nodes",
+    "fleet_samples",
 )
 
 #: Families whose names are built dynamically: family -> the source
@@ -132,7 +145,8 @@ def test_no_duplicate_family_entries():
 @pytest.mark.parametrize("prefix", ["ttx_", "tcc_", "zk_", "sigma_",
                                     "pipeline_", "selector_", "serve_",
                                     "txgen_", "resil_", "telemetry_",
-                                    "slo_", "profile_"])
+                                    "slo_", "profile_", "journal_",
+                                    "hb_", "fleet_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

@@ -73,6 +73,46 @@ def test_platform_boots_from_tokengen_artifacts(tmp_path):
         p.stop()
 
 
+def test_fleet_federation_across_node_processes(tmp_path):
+    """Fleet observability over real OS processes: every node process
+    publishes its own metrics registry into the platform spool
+    (obs/aggregate.py) and stamps lifecycle heartbeats; the parent's
+    federated exposition is grammar-valid, carries one ``node`` label per
+    process, and keeps the stable family names untouched."""
+    from test_telemetry import validate_prometheus
+
+    from fabric_token_sdk_tpu.obs.heartbeat import read_last
+
+    spool = tmp_path / "spool"
+    names = ("issuer", "auditor", "alice", "bob")
+    p = Platform(specs=[
+        NodeSpec("issuer", role="issuer"),
+        NodeSpec("auditor", role="auditor"),
+        NodeSpec("alice"),
+        NodeSpec("bob"),
+    ], fleet_spool_dir=str(spool))
+    p.start()
+    try:
+        tx = p.issue(via="alice", issuer="issuer", to="alice",
+                     token_type="USD", amount=5)
+        assert p.wait_tx("alice", tx) == "Confirmed"
+        assert p.balance("alice", "USD") == 5
+    finally:
+        p.stop()   # each node's publisher does a final flush on stop
+
+    text = p.fleet_aggregator().collect()
+    types = validate_prometheus(text)
+    for n in names:
+        assert f'node="{n}"' in text, f"no federated samples from {n}"
+    # node registries merged under their own (stable) family names —
+    # federation adds a dimension, it never renames a family
+    assert "ttx_executions_total" in types
+    assert "fleet_nodes" in types and "fleet_node_age_seconds" in types
+    # lifecycle heartbeats rode along in the same spool
+    stamp = read_last(spool / "alice.hb.jsonl")
+    assert stamp is not None and stamp["phase"] == "stopped"
+
+
 def test_multiprocess_double_spend_rejected(platform):
     p = platform
     tx1 = p.issue(via="alice", issuer="issuer", to="alice",

@@ -212,3 +212,49 @@ def test_live_telemetry_slo_profile_families_export():
     assert "# TYPE slo_availability_ratio gauge" in text
     assert re.search(
         r'telemetry_scrapes_total\{endpoint="/metrics"\} 2\.0', text)
+
+
+# flight recorder / heartbeat / fleet federation families (PR:
+# observability) — stable interface; behaviour is covered crypto-free in
+# tests/test_journal.py, test_heartbeat.py and test_aggregate.py
+EXPECTED_FLIGHT_FAMILIES = (
+    "journal_events_total",
+    "journal_dropped_total",
+    "journal_incidents_total",
+    "hb_beats_total",
+    "hb_last_age_seconds",
+    "hb_stalls_total",
+    "fleet_nodes",
+    "fleet_samples",
+    "fleet_merge_conflicts_total",
+    "fleet_node_age_seconds",
+)
+
+
+def test_flight_recorder_and_fleet_families_export(tmp_path):
+    """One pass through journal + heartbeat + federation lights every
+    journal_*, hb_* and fleet_* family in a single exposition."""
+    from fabric_token_sdk_tpu.obs import (FleetAggregator, Heartbeat,
+                                          Journal, SpoolPublisher,
+                                          StallDetector)
+
+    GLOBAL.reset()
+    j = Journal(capacity=2, provider=GLOBAL, min_interval_s=0.0)
+    j.configure(tmp_path / "flight")
+    for i in range(4):                   # wraps the 2-deep ring: drops
+        j.record("heartbeat", i=i)
+    j.incident("smoke")
+    hb = Heartbeat(provider=GLOBAL, journal=j, clock=lambda: 50.0)
+    hb.beat("phase_a")
+    det = StallDetector(hb.last, default_deadline_s=1.0, grace_s=0.0,
+                        provider=GLOBAL, clock=lambda: 100.0)
+    assert det.check() == ("phase_a", 50.0)
+    spool = tmp_path / "spool"
+    SpoolPublisher(spool, "n0", provider=GLOBAL).publish()
+    # a node-label collision forces fleet_merge_conflicts_total to light
+    (spool / "n1.prom").write_text(
+        '# TYPE f counter\nf{node="inner"} 1.0\n')
+    text = FleetAggregator(spool, provider=GLOBAL).collect()
+    for fam in EXPECTED_FLIGHT_FAMILIES:
+        assert fam in text, f"flight family silent: {fam}"
+    assert 'node="n0"' in text
