@@ -593,6 +593,280 @@ def _bench_chaos():
     assert parity_bad == 0, "chaos bench: verdict parity broken under faults"
 
 
+def _crash_worker_factory():
+    """Picklable sidecar factory for BENCH_MODE=crash: the spawn context
+    re-imports this module in the child and calls this to build the
+    device verifier there. Caches are configured from the inherited env
+    — which a cold restart has already cleared."""
+    _configure_jax_cache()
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.crypto import setup
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    return ZKVerifier(pp, device=True)
+
+
+def _bench_crash():
+    """BENCH_MODE=crash: the serve bench under a seeded kill schedule.
+
+    The device backend runs as a supervised sidecar process
+    (serve/worker.py) with the request WAL armed. While an open-loop
+    arrival stream submits range requests, a seeded KillSchedule
+    SIGKILLs and SIGSTOPs the worker mid-load; the supervisor detects
+    the exit / heartbeat stall and restarts it while traffic rides the
+    host fallback (degraded, never down). Reports availability, p99
+    under kills, RTO per recovery, and the WAL accounting — then runs a
+    replay drill: admit a burst, abort the service mid-flight
+    (simulated crash), and let a successor service over the same WAL
+    directory replay every incomplete request to a bit-identical
+    verdict with exactly-once terminal accounting. Same seeds → same
+    kill schedule → reproducible run."""
+    import asyncio
+    import copy
+    import shutil
+
+    from fabric_token_sdk_tpu.harness.txgen import open_loop_arrivals
+    from fabric_token_sdk_tpu.obs import GLOBAL as METRICS
+    from fabric_token_sdk_tpu.obs import SloMonitor
+    from fabric_token_sdk_tpu.resilience import (ChildSpec, KillSchedule,
+                                                 ResilienceConfig, Supervisor,
+                                                 SupervisorPolicy)
+    from fabric_token_sdk_tpu.serve import (SERVED_BY_HOST,
+                                            STATUS_DEADLINE_MISS, STATUS_OK,
+                                            ServeConfig, VerificationService,
+                                            WorkerClient, WriteAheadLog)
+
+    pp, proofs, coms = _load()
+    rate = float(os.environ.get("BENCH_CRASH_RATE", "200"))
+    duration = float(os.environ.get("BENCH_CRASH_SECONDS", "30"))
+    seed = int(os.environ.get("BENCH_CRASH_SEED", "7"))
+    kills = int(os.environ.get("BENCH_CRASH_KILLS", "2"))
+    stops = int(os.environ.get("BENCH_CRASH_STOPS", "1"))
+    stall_s = float(os.environ.get("BENCH_CRASH_STALL_DEADLINE", "2.0"))
+    replay_n = int(os.environ.get("BENCH_CRASH_REPLAY", "96"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", "16,128,256,512,1024").split(","))
+    cfg = ServeConfig(
+        buckets=buckets,
+        max_wait_s=float(os.environ.get("BENCH_SERVE_WAIT", "0.025")),
+        default_deadline_s=float(os.environ.get("BENCH_SERVE_DEADLINE",
+                                                "15.0")))
+    resil = ResilienceConfig(retry_attempts=4, retry_base_s=0.002,
+                             retry_cap_s=0.05, seed=seed,
+                             breaker_reset_s=1.0,
+                             watchdog_timeout_s=120.0)
+
+    wal_root = BENCH_DIR / "crash_wal"
+    shutil.rmtree(wal_root, ignore_errors=True)
+    hb_path = str(BENCH_DIR / "crash_worker.hb.jsonl")
+
+    _configure_bench_journal()
+    worker = WorkerClient(
+        _crash_worker_factory, pp=pp, heartbeat_path=hb_path,
+        prewarm_buckets=buckets,
+        call_timeout_s=float(os.environ.get("BENCH_CRASH_CALL_TIMEOUT",
+                                            "60")),
+        name="verify-worker")
+
+    def _respawn(ctx=None):
+        # clear the dead pid's stamps first: the stall watch would
+        # otherwise trip on the stale "ready" beat while the fresh
+        # worker is still importing (grace_s only covers an EMPTY file)
+        try:
+            os.remove(hb_path)
+        except OSError:
+            pass
+        return worker.spawn(ctx)
+
+    proc = _respawn()
+    supervisor = Supervisor(
+        policy=SupervisorPolicy(seed=seed, backoff_base_s=0.05,
+                                backoff_cap_s=0.5,
+                                cold_after=kills + stops + 2,
+                                give_up_after=2 * (kills + stops) + 4),
+        poll_s=0.1)
+    supervisor.add_child(
+        ChildSpec("verify-worker", start=_respawn,
+                  heartbeat_file=hb_path,
+                  # boot/prewarm legitimately take a while (bounded by
+                  # the compile/table caches); only a frozen READY
+                  # worker is a stall
+                  deadlines={"boot": 600.0, "prewarm": 3600.0,
+                             "ready": stall_s},
+                  default_deadline_s=600.0, grace_s=120.0),
+        handle=proc)
+    supervisor.start()
+
+    wal = WriteAheadLog(str(wal_root / "serve"))
+    svc = VerificationService(worker, config=cfg, resilience=resil,
+                              slo=SloMonitor(), wal=wal)
+    telemetry = _start_bench_telemetry(svc)
+    if telemetry is not None:
+        telemetry.add_status_source("supervisor", supervisor.status)
+    n = len(proofs)
+    forged = copy.deepcopy(proofs[0])
+    forged.data.tau = (forged.data.tau + 1) % (1 << 250)
+    FORGE_EVERY = 97
+    schedule = KillSchedule(seed=seed, duration_s=duration, kills=kills,
+                            stops=stops)
+
+    async def run():
+        print(f"crash bench: worker prewarming {len(cfg.buckets)} buckets",
+              file=sys.stderr)
+        prewarm_s = await svc.start()
+        arrivals = open_loop_arrivals(rate, duration, seed=11)
+        print(f"crash bench: open loop, {len(arrivals)} arrivals over "
+              f"{duration:.0f}s; kill schedule "
+              f"{[(round(t, 1), s) for t, s in schedule.events]}",
+              file=sys.stderr)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        schedule.start(lambda: worker.pid)
+
+        async def one(i, offset):
+            delay = t0 + offset - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if i % FORGE_EVERY == 0:
+                return await svc.submit_range(forged, coms[0])
+            return await svc.submit_range(proofs[i % n], coms[i % n])
+
+        results = await asyncio.gather(
+            *[one(i, off) for i, off in enumerate(arrivals)])
+        elapsed = loop.time() - t0
+        schedule.cancel()
+        await svc.stop(timeout_s=120.0)
+        return prewarm_s, results, elapsed
+
+    prewarm_s, results, elapsed = asyncio.run(run())
+    total = len(results)
+    served = [r for r in results if r.status in (STATUS_OK,
+                                                STATUS_DEADLINE_MISS)
+              and r.accepted is not None]
+    errors = sum(r.status in ("error", "shutdown") for r in results)
+    availability = (total - errors) / total if total else 0.0
+    fallback_frac = (sum(r.served_by == SERVED_BY_HOST for r in served)
+                     / len(served)) if served else 0.0
+    parity_bad = sum(
+        1 for i, r in enumerate(results)
+        if r.accepted is not None
+        and r.accepted != (i % FORGE_EVERY != 0))
+    lost = wal.open_count          # admits without a terminal resolve
+    ok = [r for r in results if r.status == STATUS_OK]
+    lat = sorted(r.total_s for r in ok) or [0.0]
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    rto = METRICS.histogram("crash_rto_seconds", child="verify-worker")
+    snap = METRICS.snapshot()
+
+    def fam(name):
+        return sum(v for (fam_name, _), v in snap.items()
+                   if fam_name == name)
+
+    # ---- replay drill: admit a burst, crash mid-flight, replay -------
+    # Requests queue behind a never-firing trigger (one oversized
+    # bucket, hour-scale waits) so abort() leaves them all admitted but
+    # unresolved; the successor service over the SAME WAL directory
+    # must replay each to the ground-truth verdict exactly once.
+    print("crash bench: replay drill", file=sys.stderr)
+    REPLAY_FORGE = 7
+    hold_cfg = ServeConfig(buckets=(max(buckets),), max_wait_s=3600.0,
+                           default_deadline_s=3600.0)
+    wal_a = WriteAheadLog(str(wal_root / "replay"))
+    svc_a = VerificationService(worker, config=hold_cfg, resilience=resil,
+                                wal=wal_a)
+
+    async def crash_half():
+        await svc_a.start(prewarm=False)
+        tasks = []
+        for i in range(replay_n):
+            if i % REPLAY_FORGE == 0:
+                tasks.append(asyncio.ensure_future(
+                    svc_a.submit_range(forged, coms[0])))
+            else:
+                tasks.append(asyncio.ensure_future(
+                    svc_a.submit_range(proofs[i % n], coms[i % n])))
+        await asyncio.sleep(0.25)   # let every admit reach the WAL
+        await svc_a.abort()         # simulated SIGKILL mid-flight
+        for t in tasks:
+            t.cancel()
+
+    asyncio.run(crash_half())
+    wal_a.close()
+
+    wal_b = WriteAheadLog(str(wal_root / "replay"))
+    svc_b = VerificationService(worker, config=cfg, resilience=resil,
+                                wal=wal_b)
+
+    async def recover_half():
+        await svc_b.start(prewarm=False)   # start() awaits the replay
+        await svc_b.stop(timeout_s=120.0)
+        return svc_b.replayed
+
+    replayed = asyncio.run(recover_half())
+    # wal ids are assigned in admit order, so id i+1 carries request i
+    replay_parity = sum(
+        1 for wal_id, res in replayed
+        if res.accepted != ((wal_id - 1) % REPLAY_FORGE != 0))
+    replay_no_verdict = sum(1 for _, res in replayed
+                            if res.accepted is None)
+    snap2 = METRICS.snapshot()
+    replay_dups = sum(
+        v for (name, labels), v in snap2.items()
+        if name == "wal_appends_total"
+        and dict(labels).get("record") == "resolve_duplicate")
+    if telemetry is not None:
+        telemetry.stop()
+    supervisor.stop()
+    worker.stop()
+    wal.close()
+    wal_b.close()
+
+    print(json.dumps({
+        "metric": f"crash_availability_{BIT_LENGTH}bit",
+        "value": round(availability, 6),
+        "unit": (f"non-error terminal fraction ({total - errors}/{total}; "
+                 f"seed={seed}; injected "
+                 f"{int(fam('crash_injected_signals_total'))} signals "
+                 f"({kills} SIGKILL + {stops} SIGSTOP scheduled), "
+                 f"{int(fam('crash_failures_total'))} failures detected, "
+                 f"{int(fam('crash_restarts_total'))} restarts; "
+                 f"fallback served {fallback_frac:.3f} of verdicts; "
+                 f"{lost} requests lost)"),
+    }))
+    print(json.dumps({
+        "metric": f"crash_p99_seconds_{BIT_LENGTH}bit",
+        "value": round(p99, 4),
+        "unit": (f"s (p50 {p50 * 1e3:.1f}ms; prewarm {prewarm_s:.1f}s; "
+                 f"{len(ok) / elapsed:.0f} req/s served under kills)"),
+    }))
+    print(json.dumps({
+        "metric": f"crash_rto_seconds_{BIT_LENGTH}bit",
+        "value": round(rto.percentile(100.0), 4),
+        "unit": (f"s worst recovery (mean {rto.mean:.3f}s over {rto.n} "
+                 "recoveries: failure detection -> restarted worker's "
+                 "first fresh heartbeat)"),
+    }))
+    print(json.dumps({
+        "metric": f"crash_replayed_requests_{BIT_LENGTH}bit",
+        "value": len(replayed),
+        "unit": (f"requests replayed from the WAL after a mid-flight "
+                 f"abort ({replay_parity} verdict mismatches, "
+                 f"{replay_no_verdict} without verdicts, "
+                 f"{int(replay_dups)} duplicate resolves, "
+                 f"{wal_b.open_count} left unresolved)"),
+    }))
+    assert parity_bad == 0, "crash bench: verdict parity broken under kills"
+    assert lost == 0, f"crash bench: {lost} admitted requests lost"
+    assert replayed, "crash bench: replay drill recovered nothing"
+    assert replay_parity == 0, "crash bench: replayed verdicts diverged"
+    assert replay_no_verdict == 0, \
+        "crash bench: replayed requests missing verdicts"
+    assert replay_dups == 0, "crash bench: terminal accounting not exactly-once"
+    assert wal_b.open_count == 0, \
+        "crash bench: replayed requests left unresolved in the WAL"
+
+
 def _bench_htlc():
     """BENCH_MODE=htlc — BASELINE config 4: an HTLC claim batch. Each
     swap claim pairs the host-side interop checks (script validation +
@@ -721,6 +995,10 @@ def main():
 
     if mode == "chaos":
         _bench_chaos()
+        return
+
+    if mode == "crash":
+        _bench_crash()
         return
 
     if mode == "htlc":

@@ -255,7 +255,7 @@ class NodeSpec:
 
 
 def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
-               fleet_spool_dir=None):
+               fleet_spool_dir=None, state_dir=None):
     """Entry point of one node process."""
     import os
 
@@ -289,17 +289,41 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
         from ..services.identity.x509 import keypair_from_pem
 
         keys = keypair_from_pem(Path(spec.key_pem).read_bytes())
+    elif state_dir:
+        # durable identity: persist the signing key on first boot so a
+        # supervised RESTART of this node is the same logical party —
+        # its on-ledger tokens stay recognizable and balances
+        # reconstruct from block replay (the reference Restart(...)
+        # contract)
+        from pathlib import Path
+
+        from ..services.identity.x509 import (keypair_from_pem,
+                                              keypair_to_pem)
+
+        os.makedirs(state_dir, exist_ok=True)
+        key_path = Path(state_dir) / f"{spec.name}.sk.pem"
+        if key_path.exists():
+            keys = keypair_from_pem(key_path.read_bytes())
+        else:
+            keys = new_signing_identity()
+            priv_pem, _pub_pem = keypair_to_pem(keys)
+            key_path.write_bytes(priv_pem)
     else:
         keys = new_signing_identity()
 
     # GENERATE phase: report identity material
     control["out"].put(("identity", spec.name, bytes(keys.identity)))
 
-    # wait for SETUP: pp bytes + go signal
+    # wait for SETUP: pp bytes + go signal. A restarted node can find
+    # stale commands queued ahead of its release (sent while the old
+    # process was dead) — skip them; their callers already timed out.
     if hb is not None:
         hb.beat("setup_wait")
-    cmd, pp_raw, extra = control["in"].get()
-    assert cmd == "start"
+    while True:
+        msg = control["in"].get()
+        if msg[0] == "start":
+            _cmd, pp_raw, extra = msg
+            break
 
     bundle = default_registry(device=False).new_bundle(pp_raw)
     mgr = LedgerManager(address=tuple(ledger_address)
@@ -397,13 +421,21 @@ class Platform:
     def __init__(self, specs: list[NodeSpec], precision: int = 64,
                  driver: str = "fabtoken", bit_length: int = 16,
                  pp_raw: bytes | None = None,
-                 fleet_spool_dir: str | None = None):
+                 fleet_spool_dir: str | None = None,
+                 state_dir: str | None = None,
+                 supervise: bool = False, supervisor_policy=None):
         self.specs = specs
         self.precision = precision
         self.driver = driver
         self.bit_length = bit_length
         self._pp_override = pp_raw   # tokengen-artifacts pp, if any
         self.fleet_spool_dir = fleet_spool_dir
+        #: durable per-node state (signing keys) — required for a
+        #: restarted node to come back as the same logical party
+        self.state_dir = state_dir
+        self.supervise = supervise
+        self.supervisor_policy = supervisor_policy
+        self.supervisor = None
         self._ctx = mp.get_context("spawn")
         self._mgr = self._ctx.Manager()
         self._procs: dict[str, mp.Process] = {}
@@ -413,6 +445,8 @@ class Platform:
         self._ledger_mgr = None
         self._authkey = uuid.uuid4().hex.encode()
         self._address = ("127.0.0.1", 0)
+        self._pp_raw: bytes | None = None
+        self._extra: dict | None = None
 
     # ------------------------------------------------------------------ boot
     def start(self) -> None:
@@ -446,7 +480,7 @@ class Platform:
                 target=_node_main,
                 args=(s.__dict__, list(self._address), self._authkey,
                       inboxes, self._controls[s.name], replies,
-                      self.fleet_spool_dir),
+                      self.fleet_spool_dir, self.state_dir),
                 daemon=True)
             self._procs[s.name].start()
 
@@ -472,15 +506,86 @@ class Platform:
         self._ledger_mgr = mgr
         mgr.ledger().boot(pp_raw, self.driver)
 
-        # 3. RUN: release the nodes
+        # 3. RUN: release the nodes. pp bytes + extras are kept so a
+        # supervised restart can re-run the handshake for one node.
         auditor = next((s.name for s in self.specs if s.role == "auditor"),
                        None)
+        self._pp_raw = pp_raw
+        self._extra = {"precision": self.precision
+                       if self.driver == "fabtoken" else self.bit_length,
+                       "auditor": auditor}
         for s in self.specs:
             self._controls[s.name]["in"].put(
-                ("start", pp_raw,
-                 {"precision": self.precision
-                  if self.driver == "fabtoken" else self.bit_length,
-                  "auditor": auditor}))
+                ("start", pp_raw, self._extra))
+        if self.supervise:
+            self._start_supervisor()
+
+    def _start_supervisor(self) -> None:
+        """Put every node process under the resilience supervisor: exit
+        detection + respawn-with-handshake. Node heartbeats stamp phase
+        *transitions* only (not a steady cadence), so the stall watch
+        is disarmed via an unreachable deadline — exit detection and
+        the fresh-beat RTO measurement are what supervision buys here.
+        """
+        import os
+
+        from ..resilience.supervisor import ChildSpec, Supervisor
+
+        self.supervisor = Supervisor(policy=self.supervisor_policy,
+                                     poll_s=0.1)
+        for s in self.specs:
+            hb_file = (os.path.join(self.fleet_spool_dir,
+                                    f"{s.name}.hb.jsonl")
+                       if self.fleet_spool_dir else None)
+            self.supervisor.add_child(
+                ChildSpec(
+                    name=s.name,
+                    start=(lambda ctx, _name=s.name:
+                           self._respawn_node(_name, cold=ctx.cold)),
+                    heartbeat_file=hb_file,
+                    default_deadline_s=1e9, grace_s=1e9),
+                handle=self._procs[s.name])
+        self.supervisor.start()
+
+    # ------------------------------------------------------------- restart
+    def _respawn_node(self, name: str, cold: bool = False):
+        """Boot a replacement process for ``name`` and re-run its
+        GENERATE -> RUN handshake.
+
+        The replacement reloads its persisted signing key (``state_dir``)
+        so it is the same logical party, re-announces its identity (the
+        event is ignored by any in-flight ``call`` loop), and is released
+        immediately with the original pp bytes; its DeliveryService then
+        replays the ledger from block 0, reconstructing token state —
+        the reference ``Restart(...)`` semantics. The node's manager
+        queues survive the process, so session-plane calls queued while
+        it was down are served by the replacement."""
+        del cold   # nodes hold no process-local warm caches today
+        if self._pp_raw is None:
+            raise RuntimeError("Platform not started")
+        spec = next(s for s in self.specs if s.name == name)
+        proc = self._ctx.Process(
+            target=_node_main,
+            args=(spec.__dict__, list(self._address), self._authkey,
+                  self._inboxes, self._controls[name], self._replies,
+                  self.fleet_spool_dir, self.state_dir),
+            daemon=True)
+        proc.start()
+        self._procs[name] = proc
+        self._controls[name]["in"].put(("start", self._pp_raw,
+                                        self._extra))
+        return proc
+
+    def restart_node(self, name: str, timeout_s: float = 5.0):
+        """Hard-kill one node process and boot its replacement (direct,
+        unsupervised restart — the supervised path goes through
+        :class:`~fabric_token_sdk_tpu.resilience.supervisor.Supervisor`
+        detecting the death instead)."""
+        proc = self._procs[name]
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=timeout_s)
+        return self._respawn_node(name)
 
     @classmethod
     def from_artifacts(cls, artifacts_dir) -> "Platform":
@@ -584,17 +689,59 @@ class Platform:
         return self.call(node, "wait_tx", tx_id, timeout)
 
     # ------------------------------------------------------------------ stop
-    def stop(self) -> None:
+    def stop(self, timeout_s: float = 5.0,
+             raise_on_error: bool = True) -> dict:
+        """Shut the topology down and surface how each child died.
+
+        Joins every node with a shared bounded deadline, escalates
+        terminate -> kill for stragglers, and returns ``{name:
+        exitcode}``. A node that exited nonzero on its own (crashed
+        rather than acked the stop) is logged — and raised, under
+        ``raise_on_error`` — instead of being silently reaped;
+        escalated stragglers are logged but never raised (the negative
+        exit code is this method's own doing)."""
+        import logging
+
+        log = logging.getLogger("fabric_token_sdk_tpu.harness")
+        if self.supervisor is not None:
+            # first: a supervisor that outlives the stop commands would
+            # dutifully "recover" every cleanly-exiting node
+            self.supervisor.stop()
+            self.supervisor = None
         for s in self.specs:
             try:
                 self._controls[s.name]["in"].put(("stop",))
             except Exception:
                 pass
-        deadline = time.time() + 5
-        for p in self._procs.values():
+        deadline = time.time() + timeout_s
+        exit_codes: dict[str, int | None] = {}
+        escalated: dict[str, str] = {}
+        for name, p in self._procs.items():
             p.join(timeout=max(0.1, deadline - time.time()))
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=2.0)
+                escalated[name] = "terminate"
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=2.0)
+                    escalated[name] = "kill"
+            exit_codes[name] = p.exitcode
         if self._ledger_proc is not None:
             self._ledger_proc.terminate()
+            self._ledger_proc.join(timeout=2.0)
         self._mgr.shutdown()
+        for name, how in escalated.items():
+            log.warning("node [%s] missed the %.1fs stop deadline; "
+                        "escalated to %s (exitcode %s)",
+                        name, timeout_s, how, exit_codes[name])
+        unexpected = {n: c for n, c in exit_codes.items()
+                      if c not in (0, None) and n not in escalated}
+        if unexpected:
+            detail = ", ".join(f"{n}={c}"
+                               for n, c in sorted(unexpected.items()))
+            log.error("node processes exited nonzero: %s", detail)
+            if raise_on_error:
+                raise RuntimeError(
+                    f"node processes exited nonzero: {detail}")
+        return exit_codes

@@ -56,13 +56,23 @@ EVENT_FALLBACK = "fallback"
 EVENT_HEARTBEAT = "heartbeat"
 EVENT_WATCHDOG_ABANDON = "watchdog_abandon"
 EVENT_INCIDENT = "incident"
+#: A request resolved with terminal ``shutdown`` status during drain —
+#: journaled so a post-mortem can account for every admitted request.
+EVENT_REQUEST_SHUTDOWN = "request_shutdown"
+#: WAL lifecycle: recovery scan finished / one entry replayed.
+EVENT_WAL_RECOVERED = "wal_recovered"
+EVENT_WAL_REPLAY = "wal_replay"
+#: Supervisor lifecycle: child failure detected / child (re)started.
+EVENT_CHILD_FAILURE = "child_failure"
+EVENT_CHILD_RESTART = "child_restart"
 
 EVENT_KINDS = (
     EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED, EVENT_BATCH_FORMED,
     EVENT_DISPATCH_START, EVENT_DISPATCH_END, EVENT_COMPILE_START,
     EVENT_COMPILE_END, EVENT_BREAKER_TRANSITION, EVENT_SLO_BURN,
     EVENT_FALLBACK, EVENT_HEARTBEAT, EVENT_WATCHDOG_ABANDON,
-    EVENT_INCIDENT,
+    EVENT_INCIDENT, EVENT_REQUEST_SHUTDOWN, EVENT_WAL_RECOVERED,
+    EVENT_WAL_REPLAY, EVENT_CHILD_FAILURE, EVENT_CHILD_RESTART,
 )
 
 _JOURNAL_FAMILIES = {

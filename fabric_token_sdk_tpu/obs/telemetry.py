@@ -59,6 +59,15 @@ class TelemetryConfig:
     port: int = 0
 
 
+class _TelemetryHTTPServer(ThreadingHTTPServer):
+    # SO_REUSEADDR, explicitly: a supervisor-restarted process must
+    # rebind its fixed scrape port immediately, not EADDRINUSE through
+    # the predecessor's TIME_WAIT window. (http.server defaults this to
+    # 1 today, but the crash-recovery layer depends on it — pin it.)
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "fts-telemetry/1"
     protocol_version = "HTTP/1.1"
@@ -133,9 +142,8 @@ class TelemetryServer:
         (resolves the ephemeral port)."""
         if self._httpd is not None:
             return self.url
-        httpd = ThreadingHTTPServer(
+        httpd = _TelemetryHTTPServer(
             (self.config.host, self.config.port), _Handler)
-        httpd.daemon_threads = True
         httpd.telemetry = self
         self._httpd = httpd
         self._started_at = time.time()

@@ -18,7 +18,12 @@ first-class, *testable* behaviour instead of an unhandled exception:
     device path is exhausted or the breaker is open;
   - :class:`DispatchWatchdog` (watchdog.py): bounds the blocking device
     dispatch so a hung call is abandoned (fresh executor thread) and
-    retried/fallen back instead of freezing the dispatcher.
+    retried/fallen back instead of freezing the dispatcher;
+  - :class:`Supervisor` (supervisor.py): restart-with-backoff over
+    child *processes* (exit + heartbeat-stall detection, escalation
+    ladder restart -> cold restart -> give-up + incident snapshot),
+    reporting under the stable ``crash_*`` family — the layer that
+    survives what the in-process layers cannot (SIGKILL).
 
 Everything reports under the stable ``resil_*`` metric family
 (``resil_retries_total``, ``resil_breaker_state``,
@@ -39,6 +44,9 @@ from .faults import (ACTIONS, FaultInjector, FaultyZK,
                      InjectedPermanentError, InjectedTransientError)
 from .retry import (TRANSIENT_TYPES, RetryExhausted, RetryPolicy,
                     TransientError)
+from .supervisor import (RUNG_COLD_RESTART, RUNG_GIVE_UP, RUNG_RESTART,
+                         ChildSpec, KillSchedule, RestartContext,
+                         Supervisor, SupervisorPolicy)
 from .watchdog import DispatchWatchdog, WatchdogTimeout
 
 
@@ -90,8 +98,16 @@ class ResilienceConfig:
 
 __all__ = [
     "ACTIONS",
+    "ChildSpec",
     "CircuitBreaker",
     "DispatchWatchdog",
+    "KillSchedule",
+    "RestartContext",
+    "RUNG_COLD_RESTART",
+    "RUNG_GIVE_UP",
+    "RUNG_RESTART",
+    "Supervisor",
+    "SupervisorPolicy",
     "FaultInjector",
     "FaultyZK",
     "HostFallbackVerifier",

@@ -1,0 +1,530 @@
+"""Process supervisor: restart-with-backoff over child processes.
+
+Generalizes the multichip dryrun's monitor loop (parallel/dryrun.py) —
+"watch one worker's heartbeat file, kill it when it wedges" — into a
+reusable supervision tree node: N children (NWO node processes, the
+sidecar verification worker), each with an exit watch and an optional
+heartbeat-stall watch, restarted through an escalation ladder:
+
+  1. **restart** — respawn with seeded decorrelated-jitter backoff
+     (:meth:`RetryPolicy.delays`, so a crash-looping child backs off
+     deterministically per seed instead of hot-spinning);
+  2. **cold restart** — after ``cold_after`` failures without a stable
+     interval, the restart context carries ``cold=True`` so the spawn
+     callable can clear warm state (persistent compile / table caches)
+     in case the warm state itself is what keeps killing the child;
+  3. **give up** — after ``give_up_after`` failures, stop restarting,
+     write an incident snapshot (obs/journal.py) and notify
+     ``on_give_up``: a supervisor that flaps forever is an outage
+     generator, not a remedy.
+
+``stable_reset_s`` of uninterrupted uptime clears the ladder, so one
+bad hour a week does not creep a child toward give-up.
+
+Failure detection is edge-driven per :meth:`poll` pass (a daemon thread
+calls it; tests drive it with a fake clock and fake handles):
+
+  - *exit*: the handle reports not-alive — the exit code lands in the
+    journal and on ``crash_failures_total{cause="exit"}``;
+  - *stall*: the child's heartbeat file (obs/heartbeat.py, written by
+    the child, read here via :class:`FileHeartbeatReader`) is older
+    than its phase deadline — the wedged process is poked with SIGUSR1
+    (a cooperative child dumps stacks), then terminate, then kill,
+    exactly the dryrun ladder.
+
+RTO accounting: detection instant -> the restarted child's first fresh
+heartbeat (stamped by the NEW pid), or the respawn instant for children
+without heartbeat files — exported as ``crash_rto_seconds{child}``.
+
+Stable families: ``crash_failures_total{child,cause}``,
+``crash_restarts_total{child,rung}``,
+``crash_escalations_total{child,rung}``, ``crash_rto_seconds{child}``,
+``crash_child_up{child}``, ``crash_injected_signals_total{signal}``
+(the bench kill schedule reports through the same family block).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..obs import GLOBAL as _METRICS
+from ..obs.heartbeat import FileHeartbeatReader, StallDetector, read_last
+from ..obs.journal import (EVENT_CHILD_FAILURE, EVENT_CHILD_RESTART,
+                           JOURNAL)
+from .retry import RetryPolicy
+
+_CRASH_FAMILIES = {
+    "crash_failures_total":
+        "Supervised child failures detected, by child and cause "
+        "(exit / stall / spawn_error).",
+    "crash_restarts_total":
+        "Supervised child restarts performed, by child and ladder rung "
+        "(restart / cold_restart).",
+    "crash_escalations_total":
+        "Escalation-ladder advances, by child and rung reached "
+        "(cold_restart / give_up).",
+    "crash_rto_seconds":
+        "Recovery time objective per restart: failure detection until "
+        "the restarted child's first fresh heartbeat (or respawn "
+        "completion without one), by child.",
+    "crash_child_up":
+        "1 while the supervised child process is believed alive, else "
+        "0, by child.",
+    "crash_injected_signals_total":
+        "Kill-schedule signals injected by the crash bench, by signal.",
+}
+
+#: Escalation-ladder rungs.
+RUNG_RESTART = "restart"
+RUNG_COLD_RESTART = "cold_restart"
+RUNG_GIVE_UP = "give_up"
+
+#: Env knobs a cold restart should clear before spawning, so the child
+#: rebuilds its warm state from scratch (the caches themselves may be
+#: what keeps killing it).
+COLD_CACHE_ENV = ("FTS_TABLE_CACHE_DIR", "BENCH_COMPILE_CACHE_DIR",
+                  "JAX_CACHE_DIR")
+
+_STATE_RUNNING = "running"
+_STATE_BACKOFF = "backoff"
+_STATE_FAILED = "failed"
+_STATE_STOPPED = "stopped"
+
+
+@dataclass(frozen=True)
+class SupervisorPolicy:
+    """Backoff + escalation policy shared by every child.
+
+    ``cold_after``: failures (without a stable interval) after which
+    restarts become cold; ``give_up_after``: failures after which the
+    supervisor stops restarting. ``seed`` keys the per-child backoff
+    RNG — two supervisors with the same policy replay the same
+    schedules (the chaos/crash-bench determinism contract).
+    """
+
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    seed: int = 0
+    cold_after: int = 3
+    give_up_after: int = 6
+    stable_reset_s: float = 30.0
+
+
+@dataclass(frozen=True)
+class RestartContext:
+    """What a ``ChildSpec.start`` callable learns about the restart."""
+
+    child: str
+    failures: int = 0
+    rung: str = RUNG_RESTART
+    cold: bool = False
+
+
+@dataclass
+class ChildSpec:
+    """One supervised child.
+
+    ``start(ctx: RestartContext) -> handle`` spawns (or respawns) the
+    child and returns a process handle — ``multiprocessing.Process``
+    or ``subprocess.Popen``, duck-typed (alive / exitcode / terminate /
+    kill / pid). ``heartbeat_file`` additionally arms a stall watch
+    with per-phase ``deadlines`` (obs.heartbeat semantics).
+    """
+
+    name: str
+    start: object
+    heartbeat_file: str | None = None
+    deadlines: dict = field(default_factory=dict)
+    default_deadline_s: float = 120.0
+    grace_s: float = 60.0
+    on_give_up: object = None
+
+
+# ----------------------------------------------------------- handle ops
+def _alive(handle) -> bool:
+    if handle is None:
+        return False
+    if hasattr(handle, "is_alive"):
+        return bool(handle.is_alive())
+    return handle.poll() is None  # subprocess.Popen
+
+
+def _exitcode(handle):
+    if handle is None:
+        return None
+    if hasattr(handle, "exitcode"):
+        return handle.exitcode
+    return handle.returncode
+
+
+def _join(handle, timeout_s: float) -> None:
+    try:
+        if hasattr(handle, "join"):
+            handle.join(timeout=timeout_s)
+        else:
+            handle.wait(timeout=timeout_s)
+    except Exception:  # noqa: BLE001 — a join that raises is a dead child
+        pass
+
+
+class _Child:
+    """Mutable supervision state for one ChildSpec."""
+
+    def __init__(self, spec: ChildSpec, delays):
+        self.spec = spec
+        self.handle = None
+        self.state = _STATE_STOPPED
+        self.failures = 0
+        self.restarts = 0
+        self.rung = RUNG_RESTART
+        self.delays = delays          # seeded backoff generator
+        self.restart_at: float | None = None
+        self.started_t: float | None = None
+        self.detect_t: float | None = None   # failure detection instant
+        self.last_exitcode = None
+        self.last_cause = ""
+        self.detector: StallDetector | None = None
+
+
+class Supervisor:
+    """Restart-with-escalation over a set of child processes.
+
+    Lifecycle::
+
+        sup = Supervisor([ChildSpec("worker", start=spawn_fn, ...)])
+        sup.start()              # spawns unspawned children + monitor
+        ...
+        sup.stop()               # stops monitoring (children keep running
+                                 # unless terminate_children=True)
+
+    Already-running children register with :meth:`add_child`
+    (``handle=...``) — the Platform wires its node processes in this
+    way. :meth:`poll` is one synchronous detection/restart pass, the
+    fake-clock test surface.
+    """
+
+    def __init__(self, specs=(), policy: SupervisorPolicy | None = None,
+                 provider=None, journal=None, clock=time.time,
+                 poll_s: float = 0.2):
+        self.policy = policy or SupervisorPolicy()
+        self.provider = provider or _METRICS
+        self.journal = journal if journal is not None else JOURNAL
+        self.clock = clock
+        self.poll_s = poll_s
+        for fam, help_text in _CRASH_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+        self._children: dict[str, _Child] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started = False
+        for spec in specs:
+            self.add_child(spec)
+
+    # ------------------------------------------------------------ wiring
+    def add_child(self, spec: ChildSpec, handle=None) -> None:
+        """Register a child; ``handle`` adopts an already-running
+        process (it is watched and restarted like any other)."""
+        with self._lock:
+            index = len(self._children)
+            # decorrelated-jitter schedule, deterministic per (policy
+            # seed, registration order)
+            policy = RetryPolicy(
+                max_attempts=2, base_s=self.policy.backoff_base_s,
+                cap_s=self.policy.backoff_cap_s,
+                seed=self.policy.seed * 1000003 + index,
+                op=f"supervise_{spec.name}")
+            child = _Child(spec, policy.delays())
+            self._children[spec.name] = child
+            if handle is not None:
+                self._adopt(child, handle, self.clock())
+            elif self._started:
+                self._spawn(child, self.clock())
+
+    def _new_detector(self, spec: ChildSpec) -> StallDetector | None:
+        if not spec.heartbeat_file:
+            return None
+        return StallDetector(
+            FileHeartbeatReader(spec.heartbeat_file),
+            deadlines=dict(spec.deadlines),
+            default_deadline_s=spec.default_deadline_s,
+            grace_s=spec.grace_s, provider=self.provider,
+            clock=self.clock)
+
+    def _adopt(self, child: _Child, handle, now: float) -> None:
+        child.handle = handle
+        child.state = _STATE_RUNNING
+        child.started_t = now
+        child.detector = self._new_detector(child.spec)
+        self.provider.gauge("crash_child_up",
+                            child=child.spec.name).set(1)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "Supervisor":
+        """Spawn every unspawned child, then monitor on a daemon
+        thread."""
+        now = self.clock()
+        with self._lock:
+            self._started = True
+            for child in self._children.values():
+                if child.state == _STATE_STOPPED and child.handle is None:
+                    self._spawn(child, now)
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fts-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — monitor must survive
+                pass
+
+    def stop(self, terminate_children: bool = False,
+             timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        if terminate_children:
+            with self._lock:
+                children = list(self._children.values())
+            for child in children:
+                if _alive(child.handle):
+                    self._kill_handle(child.handle, grace_s=timeout_s)
+                child.state = _STATE_STOPPED
+                self.provider.gauge("crash_child_up",
+                                    child=child.spec.name).set(0)
+
+    # ----------------------------------------------------------- polling
+    def poll(self, now: float | None = None) -> None:
+        """One detection/restart pass over every child."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            children = list(self._children.values())
+        for child in children:
+            if child.state == _STATE_RUNNING:
+                self._poll_running(child, now)
+            elif child.state == _STATE_BACKOFF \
+                    and child.restart_at is not None \
+                    and now >= child.restart_at:
+                self._spawn(child, now)
+
+    def _poll_running(self, child: _Child, now: float) -> None:
+        if not _alive(child.handle):
+            self._on_failure(child, now, cause="exit",
+                             exitcode=_exitcode(child.handle))
+            return
+        if child.detector is not None:
+            fired = child.detector.check()
+            if fired is not None:
+                phase, age = fired
+                # the wedged process still holds the port/queues: take
+                # it down (SIGUSR1 poke -> terminate -> kill, the
+                # dryrun ladder) before scheduling the restart
+                self._kill_handle(child.handle, grace_s=2.0, poke=True)
+                self._on_failure(child, now, cause="stall",
+                                 detail=f"phase {phase!r} "
+                                        f"heartbeat {age:.1f}s old")
+                return
+        if child.detect_t is not None and self._came_back(child):
+            rto = max(0.0, now - child.detect_t)
+            self.provider.histogram(
+                "crash_rto_seconds",
+                child=child.spec.name).observe(round(rto, 6))
+            child.detect_t = None
+        if child.failures and child.started_t is not None \
+                and child.detect_t is None \
+                and now - child.started_t >= self.policy.stable_reset_s:
+            child.failures = 0        # stable uptime clears the ladder
+            child.rung = RUNG_RESTART
+
+    def _came_back(self, child: _Child) -> bool:
+        """Recovery point for RTO: a fresh heartbeat from the NEW pid,
+        or mere liveness for children without heartbeat files."""
+        if not child.spec.heartbeat_file:
+            return True
+        stamp = read_last(child.spec.heartbeat_file)
+        if stamp is None:
+            return False
+        pid = getattr(child.handle, "pid", None)
+        return pid is not None and stamp.get("pid") == pid
+
+    # ---------------------------------------------------------- failures
+    def _on_failure(self, child: _Child, now: float, cause: str,
+                    exitcode=None, detail: str = "") -> None:
+        name = child.spec.name
+        child.failures += 1
+        child.last_exitcode = exitcode
+        child.last_cause = cause
+        if child.detect_t is None:
+            child.detect_t = now      # RTO clock starts at detection
+        self.provider.counter("crash_failures_total", child=name,
+                              cause=cause).add()
+        self.provider.gauge("crash_child_up", child=name).set(0)
+        self.journal.record(EVENT_CHILD_FAILURE, child=name, cause=cause,
+                            exitcode=exitcode, failures=child.failures,
+                            detail=detail)
+        prev_rung = child.rung
+        if child.failures > self.policy.give_up_after:
+            child.rung = RUNG_GIVE_UP
+        elif child.failures > self.policy.cold_after:
+            child.rung = RUNG_COLD_RESTART
+        if child.rung != prev_rung:
+            self.provider.counter("crash_escalations_total", child=name,
+                                  rung=child.rung).add()
+        if child.rung == RUNG_GIVE_UP:
+            child.state = _STATE_FAILED
+            child.restart_at = None
+            self.journal.incident(
+                "supervisor_give_up",
+                reason=f"child {name!r} failed {child.failures}x "
+                       f"(last cause: {cause})",
+                extra={"child": name, "exitcode": exitcode,
+                       "failures": child.failures})
+            if child.spec.on_give_up is not None:
+                try:
+                    child.spec.on_give_up(name, child.failures)
+                except Exception:  # noqa: BLE001 — callback isolation
+                    pass
+            return
+        child.state = _STATE_BACKOFF
+        child.restart_at = now + next(child.delays)
+
+    def _spawn(self, child: _Child, now: float) -> None:
+        name = child.spec.name
+        cold = child.rung == RUNG_COLD_RESTART
+        ctx = RestartContext(child=name, failures=child.failures,
+                             rung=child.rung, cold=cold)
+        saved = {}
+        if cold:
+            for key in COLD_CACHE_ENV:
+                if key in os.environ:
+                    saved[key] = os.environ.pop(key)
+        try:
+            handle = child.spec.start(ctx)
+        except Exception as exc:  # noqa: BLE001 — a spawn that raises
+            # is just the next failure on the ladder
+            self._on_failure(child, now, cause="spawn_error",
+                             detail=f"{type(exc).__name__}: {exc}")
+            return
+        finally:
+            os.environ.update(saved)
+        self._adopt(child, handle, now)
+        if child.failures:
+            child.restarts += 1
+            self.provider.counter("crash_restarts_total", child=name,
+                                  rung=ctx.rung).add()
+        self.journal.record(EVENT_CHILD_RESTART, child=name,
+                            rung=ctx.rung, cold=cold,
+                            failures=child.failures,
+                            pid=getattr(handle, "pid", None))
+
+    @staticmethod
+    def _kill_handle(handle, grace_s: float = 2.0,
+                     poke: bool = False) -> None:
+        pid = getattr(handle, "pid", None)
+        if poke and pid is not None and hasattr(signal, "SIGUSR1"):
+            try:  # cooperative children dump stacks on SIGUSR1
+                os.kill(pid, signal.SIGUSR1)
+            except (OSError, ProcessLookupError):
+                pass
+        try:
+            handle.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        _join(handle, grace_s)
+        if _alive(handle) and hasattr(handle, "kill"):
+            try:
+                handle.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            _join(handle, grace_s)
+
+    # ------------------------------------------------------------ status
+    def status(self) -> dict:
+        """JSON-serializable snapshot for /statusz and incidents."""
+        with self._lock:
+            return {name: {
+                "state": child.state,
+                "alive": _alive(child.handle),
+                "pid": getattr(child.handle, "pid", None),
+                "failures": child.failures,
+                "restarts": child.restarts,
+                "rung": child.rung,
+                "last_cause": child.last_cause,
+                "last_exitcode": child.last_exitcode,
+            } for name, child in self._children.items()}
+
+
+class KillSchedule:
+    """Seeded schedule of SIGKILL/SIGSTOP injections against one pid —
+    the fault source for ``BENCH_MODE=crash``.
+
+    Offsets are drawn from ``random.Random(seed)`` over the middle of
+    the load window (``[start_frac, end_frac] * duration_s``) so the
+    schedule is replayable run-over-run. SIGSTOP is the stealth
+    failure: the process stays "alive" but its heartbeat freezes, so
+    recovery must come from the supervisor's stall watch (which
+    SIGKILLs the stopped process — SIGTERM would stay queued and
+    undelivered).
+    """
+
+    def __init__(self, seed: int, duration_s: float, kills: int = 2,
+                 stops: int = 1, start_frac: float = 0.15,
+                 end_frac: float = 0.85):
+        rng = random.Random(seed)
+        lo, hi = start_frac * duration_s, end_frac * duration_s
+        self.events = sorted(
+            [(rng.uniform(lo, hi), "SIGKILL") for _ in range(kills)]
+            + [(rng.uniform(lo, hi), "SIGSTOP") for _ in range(stops)])
+        self.delivered: list[tuple[float, str, int | None]] = []
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self, get_pid, provider=None,
+              clock=time.monotonic) -> "KillSchedule":
+        """Fire the schedule on a daemon thread; ``get_pid() -> int |
+        None`` is read at each firing so restarts are targeted too."""
+        provider = provider or _METRICS
+        t0 = clock()
+
+        def _run():
+            for offset, signame in self.events:
+                delay = offset - (clock() - t0)
+                if delay > 0 and self._stop.wait(delay):
+                    return
+                pid = get_pid()
+                if pid is None:
+                    self.delivered.append((offset, signame, None))
+                    continue
+                try:
+                    os.kill(pid, getattr(signal, signame))
+                except (OSError, ProcessLookupError):
+                    pid = None
+                self.delivered.append((offset, signame, pid))
+                provider.counter("crash_injected_signals_total",
+                                 signal=signame).add()
+                JOURNAL.record(EVENT_CHILD_FAILURE, child="kill_schedule",
+                               cause="injected", detail=signame, pid=pid)
+
+        self._thread = threading.Thread(
+            target=_run, name="fts-kill-schedule", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def cancel(self) -> None:
+        self._stop.set()
+        self.join(timeout_s=1.0)

@@ -19,6 +19,8 @@ from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
 from .scheduler import GROUPS, BucketScheduler
 from .service import VerificationService
+from .wal import WalConfig, WalEntry, WriteAheadLog
+from .worker import StubZK, WorkerClient, WorkerUnavailable, worker_main
 
 __all__ = [
     "AdmissionController",
@@ -41,7 +43,14 @@ __all__ = [
     "STATUS_SHED_DEADLINE",
     "STATUS_SHED_QUEUE_FULL",
     "STATUS_SHUTDOWN",
+    "StubZK",
     "VerificationService",
     "VerifyRequest",
     "VerifyResult",
+    "WalConfig",
+    "WalEntry",
+    "WorkerClient",
+    "WorkerUnavailable",
+    "WriteAheadLog",
+    "worker_main",
 ]

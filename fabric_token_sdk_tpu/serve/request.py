@@ -81,6 +81,8 @@ class VerifyRequest:
     future: object = None     # asyncio.Future set by the service
     req_id: int = field(default_factory=lambda: next(_req_ids))
     span: object = None       # obs Span opened at admission (sampled)
+    wal_id: int | None = None  # durable WAL id (when the service logs)
+    terminal: bool = False    # set by _resolve: exactly-once completion
 
     @property
     def group(self) -> str:

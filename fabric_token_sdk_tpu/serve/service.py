@@ -57,6 +57,7 @@ from ..obs import TRACER as _TRACER
 from ..obs.journal import (EVENT_BATCH_FORMED, EVENT_DISPATCH_END,
                            EVENT_DISPATCH_START, EVENT_FALLBACK,
                            EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED,
+                           EVENT_REQUEST_SHUTDOWN, EVENT_WAL_REPLAY,
                            JOURNAL)
 from ..obs.profiling import PROFILER
 from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
@@ -109,8 +110,11 @@ class VerificationService:
 
     def __init__(self, zk, config: ServeConfig | None = None,
                  resilience: ResilienceConfig | None = None,
-                 fallback=None, slo=None):
+                 fallback=None, slo=None, wal=None):
         self.zk = zk
+        self.wal = wal
+        #: (wal_id, VerifyResult) pairs replayed at the last ``start()``.
+        self.replayed: list[tuple[int, VerifyResult]] = []
         self.config = config or ServeConfig()
         self.resilience = resilience
         self.slo = slo
@@ -168,7 +172,61 @@ class VerificationService:
                 self._watchdog.executor, self.prewarm.run)
         self._running = True
         self._task = asyncio.create_task(self._dispatch_loop())
+        if self.wal is not None:
+            await self._replay_wal()
         return self.prewarm_s or 0.0
+
+    async def _replay_wal(self) -> None:
+        """Crash recovery: push every admitted-but-unresolved WAL entry
+        back through the normal dispatch path (same scheduler, same
+        device call — bit-identical verdicts) and wait for their
+        terminal verdicts. Replays bypass admission: each entry was
+        already admitted once, and shedding it now would turn a durable
+        promise into a loss. Results land in :attr:`replayed` and each
+        resolution is logged to the WAL exactly once under the
+        original id."""
+        entries = self.wal.recover()
+        self.replayed = []
+        if not entries:
+            return
+        loop = asyncio.get_running_loop()
+        now = time.perf_counter()
+        reqs = []
+        for e in entries:
+            # deadline is re-based on the replay instant: the original
+            # wall deadline is long past, and expiring a recovered
+            # request unexamined would defeat the replay
+            deadline_s = max(e.deadline_s, self.config.default_deadline_s)
+            req = VerifyRequest(kind=e.kind, payload=e.payload,
+                                lane=e.lane, deadline=now + deadline_s,
+                                enqueue_t=now, future=loop.create_future(),
+                                wal_id=e.wal_id)
+            JOURNAL.record(EVENT_WAL_REPLAY, req_kind=e.kind, lane=e.lane,
+                           wal_id=e.wal_id)
+            _METRICS.counter("wal_replayed_total").add()
+            self.scheduler.push(req)
+            reqs.append(req)
+        self._wake.set()
+        results = await asyncio.gather(*(r.future for r in reqs))
+        self.replayed = [(r.wal_id, res) for r, res in zip(reqs, results)]
+
+    async def abort(self) -> None:
+        """Simulate a crash: cancel the dispatch loop WITHOUT resolving
+        queued or in-flight requests. Their futures never resolve (as
+        in a real SIGKILL — callers must not await them past this) and
+        the WAL keeps their admit records unresolved, so a successor
+        service constructed over the same WAL directory replays them.
+        Test/drill hook for the crash-recovery contract."""
+        if not self._running:
+            return
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
 
     async def stop(self, drain: bool = True,
                    timeout_s: float | None = None) -> None:
@@ -261,6 +319,12 @@ class VerificationService:
         JOURNAL.record(EVENT_REQUEST_ADMITTED, req_kind=kind, lane=lane,
                        req_id=req.req_id,
                        depth=self.scheduler.lane_depth(lane))
+        if self.wal is not None:
+            # durability point: once this line is flushed the request
+            # survives a SIGKILL — a successor service replays it
+            req.wal_id = self.wal.append_admit(
+                kind=kind, lane=lane, deadline_s=deadline_s,
+                payload=payload)
         if req.span is not None:
             req.span.add_event(
                 "admitted", depth=self.scheduler.lane_depth(lane))
@@ -497,11 +561,25 @@ class VerificationService:
         _TRACER.end_span(sp)
 
     def _resolve(self, req: VerifyRequest, result: VerifyResult) -> None:
+        # exactly-once: the drain-timeout path and a late demux can both
+        # reach a request; only the first resolution counts anywhere
+        # (metrics, SLO, WAL, future)
+        if req.terminal:
+            return
+        req.terminal = True
         _METRICS.counter("serve_results_total",
                          status=result.status).add()
+        if result.status == STATUS_SHUTDOWN:
+            JOURNAL.record(EVENT_REQUEST_SHUTDOWN, req_kind=req.kind,
+                           lane=req.lane, req_id=req.req_id,
+                           error=result.error)
         if self.slo is not None:
             ok = result.status == STATUS_OK
             self.slo.record(ok, result.total_s if ok else None)
+        if self.wal is not None and req.wal_id is not None:
+            self.wal.append_resolve(req.wal_id, status=result.status,
+                                    accepted=result.accepted,
+                                    served_by=result.served_by)
         self._finish_request_span(req, result)
         if req.future is not None and not req.future.done():
             req.future.set_result(result)

@@ -1,0 +1,344 @@
+"""Sidecar verification worker: the device backend in a child process.
+
+Running the ZK backend inside the dispatcher's own process means a
+device wedge or an OOM kills the whole serving plane. This module moves
+the blocking verify calls into a supervised child process:
+
+  - :func:`worker_main` is the child entry point: build the verifier
+    from a picklable ``factory``, prewarm, then serve ``range`` /
+    ``block`` calls over a ``multiprocessing.Pipe``. A daemon thread
+    stamps the current phase (``boot -> prewarm -> ready``) into a
+    heartbeat file at a fixed cadence, so a SIGSTOP'd or wedged worker
+    is visible to the supervisor as a stall (the beats stop) while a
+    SIGKILL'd one is visible as an exit.
+  - :class:`WorkerClient` is the parent-side facade with the exact
+    duck-type ``VerificationService`` dispatches on (``_range.verify``,
+    ``verify_block``, ``pp``): transport failures — dead process,
+    closed pipe, reply timeout — raise :class:`WorkerUnavailable`,
+    which derives from :class:`TransientError`, so the existing
+    resilience chain (retry -> breaker -> ``HostFallbackVerifier``)
+    degrades service to the host path while the supervisor respawns
+    and re-prewarms the worker. Availability degrades; it never zeroes.
+
+``WorkerClient.spawn`` doubles as a :class:`ChildSpec.start` callable:
+the supervisor hands it a :class:`RestartContext` and a cold restart
+spawns the child with the warm-cache env cleared.
+
+:class:`StubZK` is the crypto-free backend used by the worker/
+supervisor tests and smoke drills: the "proof" object is its own
+verdict, so parity across restarts is trivially checkable without jax.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..obs.heartbeat import Heartbeat, read_last
+from ..resilience.retry import TransientError
+
+#: Worker heartbeat phases, in boot order.
+PHASE_BOOT = "boot"
+PHASE_PREWARM = "prewarm"
+PHASE_READY = "ready"
+
+#: Remote exception type names re-raised as transient on the parent
+#: side (the same classification RetryPolicy applies locally).
+_REMOTE_TRANSIENT_NAMES = frozenset(
+    {"XlaRuntimeError", "TransientError", "InjectedTransientError",
+     "ConnectionError", "TimeoutError"})
+
+
+class WorkerUnavailable(TransientError):
+    """The worker process is dead, unreachable, or silent past the call
+    timeout. Transient by construction: the supervisor is (re)starting
+    it, and until then the host fallback serves."""
+
+
+# --------------------------------------------------------------- child
+def worker_main(conn, factory, heartbeat_path=None, prewarm_buckets=(),
+                include_block: bool = False,
+                beat_interval_s: float = 0.25) -> None:
+    """Child entry point (spawn context: ``factory`` must pickle).
+
+    The child inherits the parent's env (JAX platform, cache dirs) at
+    spawn; a cold restart's cleared cache env is inherited the same
+    way."""
+    hb = Heartbeat(heartbeat_path)
+    phase = {"now": PHASE_BOOT}
+    stop_beats = threading.Event()
+
+    def _beater():
+        # a separate thread so the beat cadence reflects scheduler
+        # liveness: SIGSTOP freezes it (stall), a wedged verify call
+        # does not (the GIL is released inside device calls)
+        while not stop_beats.wait(beat_interval_s):
+            hb.beat(phase["now"])
+
+    hb.beat(PHASE_BOOT)
+    threading.Thread(target=_beater, name="fts-worker-beat",
+                     daemon=True).start()
+    zk = factory()
+    if prewarm_buckets and hasattr(zk, "prewarm_shapes"):
+        phase["now"] = PHASE_PREWARM
+        hb.beat(PHASE_PREWARM)
+        try:
+            zk.prewarm_shapes(tuple(prewarm_buckets),
+                              include_block=include_block)
+        except TypeError:
+            zk.prewarm_shapes(tuple(prewarm_buckets))
+    phase["now"] = PHASE_READY
+    hb.beat(PHASE_READY)
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        op = msg[0]
+        try:
+            if op == "stop":
+                conn.send(("ok", None))
+                break
+            if op == "ping":
+                conn.send(("ok", os.getpid()))
+            elif op == "range":
+                _, proofs, coms = msg
+                verdicts = np.asarray(zk._range.verify(proofs, coms),
+                                      dtype=bool)
+                conn.send(("ok", verdicts))
+            elif op == "block":
+                _, transfers, issues = msg
+                t_ok, i_ok = zk.verify_block(transfers, issues)
+                conn.send(("ok", (np.asarray(t_ok, dtype=bool),
+                                  np.asarray(i_ok, dtype=bool))))
+            else:
+                conn.send(("err", "ValueError", f"unknown op {op!r}"))
+        except Exception as exc:  # noqa: BLE001 — ship it to the parent
+            try:
+                conn.send(("err", type(exc).__name__, str(exc)))
+            except (OSError, ValueError):
+                break
+    stop_beats.set()
+    hb.close()
+
+
+# -------------------------------------------------------------- parent
+class _WorkerRange:
+    """The ``zk._range`` facet of the worker facade."""
+
+    def __init__(self, client: "WorkerClient"):
+        self._client = client
+
+    def verify(self, proofs, coms):
+        return self._client._call("range", list(proofs), list(coms))
+
+
+class WorkerClient:
+    """Parent-side verifier facade over a supervised worker process.
+
+    ``factory`` builds the real verifier inside the child (it must be
+    picklable — a module-level function or ``functools.partial`` over
+    one). ``pp`` is held parent-side so ``VerificationService`` can
+    auto-build its ``HostFallbackVerifier`` for degraded mode.
+    """
+
+    def __init__(self, factory, pp=None, heartbeat_path=None,
+                 prewarm_buckets=(), include_block: bool = False,
+                 call_timeout_s: float | None = None,
+                 name: str = "verify-worker", mp_context: str = "spawn"):
+        self.factory = factory
+        self.pp = pp
+        self.name = name
+        self.heartbeat_path = heartbeat_path
+        self.prewarm_buckets = tuple(prewarm_buckets)
+        self.include_block = include_block
+        self.call_timeout_s = call_timeout_s
+        self._ctx = mp.get_context(mp_context)
+        self._range = _WorkerRange(self)
+        self._state_lock = threading.Lock()   # conn/proc swap
+        self._io_lock = threading.Lock()      # send/recv pairing
+        self._conn = None
+        self._proc = None
+
+    # --------------------------------------------------------- lifecycle
+    def spawn(self, ctx=None):
+        """Spawn a fresh worker (ChildSpec.start-compatible: ``ctx`` is
+        an optional RestartContext; cold-cache env is the supervisor's
+        job). Returns the process handle; the previous pipe, if any, is
+        closed so a blocked call fails over immediately."""
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=worker_main,
+            args=(child_conn, self.factory, self.heartbeat_path,
+                  self.prewarm_buckets, self.include_block),
+            name=self.name, daemon=True)
+        proc.start()
+        child_conn.close()
+        with self._state_lock:
+            old_conn, self._conn = self._conn, parent_conn
+            self._proc = proc
+        if old_conn is not None:
+            try:
+                old_conn.close()
+            except OSError:
+                pass
+        return proc
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        with self._state_lock:
+            conn, proc = self._conn, self._proc
+            self._conn = None
+            self._proc = None
+        if conn is not None:
+            try:
+                conn.send(("stop",))
+                conn.poll(timeout_s)
+            except (OSError, ValueError, BrokenPipeError):
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=timeout_s)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=timeout_s)
+
+    # ------------------------------------------------------------- state
+    @property
+    def pid(self) -> int | None:
+        proc = self._proc
+        return proc.pid if proc is not None and proc.is_alive() else None
+
+    def alive(self) -> bool:
+        proc = self._proc
+        return proc is not None and proc.is_alive()
+
+    def phase(self) -> str | None:
+        """Last heartbeat phase of the CURRENT worker pid (None before
+        its first beat)."""
+        if self.heartbeat_path is None:
+            return PHASE_READY if self.alive() else None
+        stamp = read_last(self.heartbeat_path)
+        if stamp is None or stamp.get("pid") != self.pid:
+            return None
+        return stamp.get("phase")
+
+    def wait_ready(self, timeout_s: float = 60.0) -> int:
+        """Block until the worker answers a ping (it only enters the
+        serve loop after prewarm); returns the worker pid."""
+        deadline = time.monotonic() + timeout_s
+        with self._io_lock:
+            with self._state_lock:
+                conn, proc = self._conn, self._proc
+            if conn is None or proc is None:
+                raise WorkerUnavailable(f"{self.name}: not spawned")
+            try:
+                conn.send(("ping",))
+                while time.monotonic() < deadline:
+                    if conn.poll(0.2):
+                        tag, payload = conn.recv()
+                        if tag == "ok":
+                            return payload
+                        raise WorkerUnavailable(
+                            f"{self.name}: ping failed: {payload}")
+                    if not proc.is_alive():
+                        raise WorkerUnavailable(
+                            f"{self.name}: died during boot "
+                            f"(exitcode {proc.exitcode})")
+            except (EOFError, BrokenPipeError, OSError) as exc:
+                raise WorkerUnavailable(
+                    f"{self.name}: pipe failed during boot: "
+                    f"{exc}") from exc
+        raise WorkerUnavailable(
+            f"{self.name}: not ready within {timeout_s}s")
+
+    # -------------------------------------------------------------- calls
+    def _call(self, op: str, *args):
+        with self._state_lock:
+            conn, proc = self._conn, self._proc
+        if conn is None or proc is None or not proc.is_alive():
+            raise WorkerUnavailable(
+                f"{self.name}: worker process is not running")
+        with self._io_lock:
+            try:
+                conn.send((op, *args))
+                if self.call_timeout_s is not None:
+                    if not conn.poll(self.call_timeout_s):
+                        raise WorkerUnavailable(
+                            f"{self.name}: no reply to {op!r} within "
+                            f"{self.call_timeout_s}s")
+                reply = conn.recv()
+            except WorkerUnavailable:
+                raise
+            except (EOFError, BrokenPipeError, OSError,
+                    ValueError) as exc:
+                raise WorkerUnavailable(
+                    f"{self.name}: pipe failed during {op!r}: "
+                    f"{exc}") from exc
+        if reply[0] == "ok":
+            return reply[1]
+        _, type_name, message = reply
+        if type_name in _REMOTE_TRANSIENT_NAMES \
+                or type_name.endswith("TransientError"):
+            raise TransientError(f"worker {type_name}: {message}")
+        raise RuntimeError(f"worker {type_name}: {message}")
+
+    def verify_block(self, transfers, issues):
+        return self._call("block", list(transfers), list(issues))
+
+    def prewarm_shapes(self, buckets, include_block: bool = False):
+        """PrewarmManager compatibility: the worker prewarms at boot,
+        so a parent-side prewarm is one ready-wait, not a compile."""
+        self.wait_ready()
+        return {int(b): 0.0 for b in buckets}
+
+
+# ------------------------------------------------------- stub backend
+class _StubRange:
+    def verify(self, proofs, coms):
+        del coms
+        return [bool(p) for p in proofs]
+
+
+class StubZK:
+    """Deterministic, dependency-free verifier for worker/supervisor
+    tests and drills: each 'proof' is its own verdict (truthiness), so
+    bit-identical replay across process kills is directly assertable.
+    ``pp`` stays None so the service does not auto-build a fallback."""
+
+    pp = None
+
+    def __init__(self, boot_delay_s: float = 0.0):
+        if boot_delay_s:
+            time.sleep(boot_delay_s)
+        self._range = _StubRange()
+
+    def verify_block(self, transfers, issues):
+        return ([bool(t[0]) for t in transfers],
+                [bool(i[0]) for i in issues])
+
+    def prewarm_shapes(self, buckets, include_block: bool = False):
+        del include_block
+        return {int(b): 0.0 for b in buckets}
+
+
+def stub_zk_factory():
+    """Picklable worker factory for tests/drills."""
+    return StubZK()
+
+
+class StubHostFallback:
+    """Host-fallback twin of :class:`StubZK` (same verdict function),
+    for degraded-mode tests: verdicts stay bit-identical whether the
+    worker or the 'host' serves them."""
+
+    def verify_batch(self, batch) -> np.ndarray:
+        return np.asarray([bool(r.payload[0]) for r in batch],
+                          dtype=bool)

@@ -67,6 +67,22 @@ STABLE_FAMILIES = (
     "serve_results_total",
     "serve_shed_total",
     "serve_wait_seconds",
+    # serve/ write-ahead log
+    "wal_appends_total",
+    "wal_bytes_written_total",
+    "wal_compactions_total",
+    "wal_open_requests",
+    "wal_recovery_seconds",
+    "wal_replayed_total",
+    "wal_segments_total",
+    "wal_torn_records_total",
+    # resilience/ supervisor + bench kill schedule
+    "crash_child_up",
+    "crash_escalations_total",
+    "crash_failures_total",
+    "crash_injected_signals_total",
+    "crash_restarts_total",
+    "crash_rto_seconds",
     # resilience/
     "resil_breaker_state",
     "resil_breaker_transitions_total",
@@ -146,7 +162,7 @@ def test_no_duplicate_family_entries():
                                     "pipeline_", "selector_", "serve_",
                                     "txgen_", "resil_", "telemetry_",
                                     "slo_", "profile_", "journal_",
-                                    "hb_", "fleet_"])
+                                    "hb_", "fleet_", "wal_", "crash_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

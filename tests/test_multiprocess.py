@@ -113,6 +113,80 @@ def test_fleet_federation_across_node_processes(tmp_path):
     assert stamp is not None and stamp["phase"] == "stopped"
 
 
+@pytest.mark.slow
+@pytest.mark.crash
+def test_supervised_restart_mid_load_reconstructs_balances(tmp_path):
+    """Crash-recovery acceptance: SIGKILL one node mid-load under the
+    resilience supervisor. The replacement must come back as the same
+    logical party (persisted signing key under ``state_dir``), replay
+    the ledger from cursor 0, and reconstruct balances — while the rest
+    of the topology keeps transacting."""
+    import os
+    import signal
+    import time
+
+    spool = tmp_path / "spool"
+    state = tmp_path / "state"
+    p = Platform(specs=[
+        NodeSpec("issuer", role="issuer"),
+        NodeSpec("alice"),
+        NodeSpec("bob"),
+    ], fleet_spool_dir=str(spool), state_dir=str(state), supervise=True)
+    p.start()
+    try:
+        tx = p.issue(via="alice", issuer="issuer", to="alice",
+                     token_type="USD", amount=1000)
+        assert p.wait_tx("alice", tx) == "Confirmed"
+        tx2 = p.transfer(via="alice", token_type="USD", amount=300,
+                         to="bob")
+        assert p.wait_tx("alice", tx2) == "Confirmed"
+
+        pid = p._procs["bob"].pid
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            proc = p._procs["bob"]
+            if proc.pid != pid and proc.is_alive():
+                break
+            time.sleep(0.1)
+        assert p._procs["bob"].pid != pid, "supervisor never respawned bob"
+
+        # more load while the replacement replays the ledger
+        tx3 = p.transfer(via="alice", token_type="USD", amount=200,
+                         to="bob")
+        assert p.wait_tx("alice", tx3) == "Confirmed"
+
+        deadline = time.time() + 30
+        while time.time() < deadline and p.balance("bob", "USD") != 500:
+            time.sleep(0.1)
+        assert p.balance("bob", "USD") == 500   # both transfers survived
+        assert p.balance("alice", "USD") == 500
+
+        from fabric_token_sdk_tpu.obs import GLOBAL
+        failures = sum(
+            v for (name, labels), v in GLOBAL.snapshot().items()
+            if name == "crash_failures_total"
+            and dict(labels).get("child") == "bob")
+        assert failures >= 1
+    finally:
+        p.stop(raise_on_error=False)
+
+
+@pytest.mark.slow
+@pytest.mark.crash
+def test_stop_surfaces_nonzero_exit_codes():
+    """Platform.stop must report a node that crashed on its own instead
+    of silently reaping it (and must not blame its own terminate/kill
+    escalation on the node)."""
+    p = Platform(specs=[NodeSpec("issuer", role="issuer"),
+                        NodeSpec("alice")])
+    p.start()
+    p._procs["alice"].kill()
+    p._procs["alice"].join(timeout=10)
+    with pytest.raises(RuntimeError, match="alice"):
+        p.stop()
+
+
 def test_multiprocess_double_spend_rejected(platform):
     p = platform
     tx1 = p.issue(via="alice", issuer="issuer", to="alice",

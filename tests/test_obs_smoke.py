@@ -258,3 +258,135 @@ def test_flight_recorder_and_fleet_families_export(tmp_path):
     for fam in EXPECTED_FLIGHT_FAMILIES:
         assert fam in text, f"flight family silent: {fam}"
     assert 'node="n0"' in text
+
+
+# crash-recovery families (PR: crash recovery) — stable interface;
+# behaviour is covered crypto-free in tests/test_wal.py and
+# tests/test_supervisor.py
+EXPECTED_WAL_FAMILIES = (
+    "wal_appends_total",
+    "wal_bytes_written_total",
+    "wal_compactions_total",
+    "wal_open_requests",
+    "wal_recovery_seconds",
+    "wal_replayed_total",
+    "wal_segments_total",
+    "wal_torn_records_total",
+)
+EXPECTED_CRASH_FAMILIES = (
+    "crash_child_up",
+    "crash_escalations_total",
+    "crash_failures_total",
+    "crash_injected_signals_total",
+    "crash_restarts_total",
+    "crash_rto_seconds",
+)
+
+
+@pytest.mark.crash
+def test_wal_and_crash_families_export(tmp_path):
+    """One WAL crash/replay cycle (with a torn tail), one fake-clock
+    supervision ladder and one kill-schedule injection light every
+    wal_* and crash_* family in a single exposition."""
+    import asyncio
+    import subprocess
+    import sys
+
+    from fabric_token_sdk_tpu.resilience import (ChildSpec, KillSchedule,
+                                                 Supervisor,
+                                                 SupervisorPolicy)
+    from fabric_token_sdk_tpu.serve import (ServeConfig,
+                                            VerificationService,
+                                            WriteAheadLog)
+
+    GLOBAL.reset()
+
+    class _TruthyRange:
+        def verify(self, proofs, coms):
+            del coms
+            return [bool(p) for p in proofs]
+
+    class _TruthyZK:
+        _range = _TruthyRange()
+
+    # -- wal_*: admit under load, crash, tear the tail, recover + replay
+    wal = WriteAheadLog(tmp_path / "wal")
+    svc = VerificationService(
+        _TruthyZK(), config=ServeConfig(buckets=(64,), max_wait_s=3600.0,
+                                        default_deadline_s=3600.0),
+        wal=wal)
+
+    async def crash():
+        await svc.start(prewarm=False)
+        tasks = [asyncio.ensure_future(svc.submit_range(True, "c"))
+                 for _ in range(3)]
+        await asyncio.sleep(0.05)
+        await svc.abort()
+        for t in tasks:
+            t.cancel()
+
+    asyncio.run(crash())
+    wal.close()
+    [seg] = list((tmp_path / "wal").glob("wal-*.jsonl"))
+    with open(seg, "ab") as f:
+        f.write(b'{"t":"resolve","id":1')       # torn final record
+
+    succ = VerificationService(
+        _TruthyZK(), config=ServeConfig(buckets=(4,), max_wait_s=0.001),
+        wal=WriteAheadLog(tmp_path / "wal"))
+
+    async def recover():
+        await succ.start(prewarm=False)          # recovery + replay
+        await succ.stop(timeout_s=10.0)
+
+    asyncio.run(recover())
+
+    # -- crash_* (ladder): one exit -> cold restart -> liveness RTO
+    class _Handle:
+        def __init__(self):
+            self.pid = 4_194_313                 # past pid_max: unpokable
+            self.exitcode = None
+
+        def is_alive(self):
+            return self.exitcode is None
+
+        def terminate(self):
+            self.exitcode = -15
+
+        def kill(self):
+            self.exitcode = -9
+
+        def join(self, timeout=None):
+            pass
+
+    clk = {"t": 0.0}
+    sup = Supervisor(policy=SupervisorPolicy(backoff_base_s=0.01,
+                                             backoff_cap_s=0.02,
+                                             cold_after=0),
+                     clock=lambda: clk["t"])
+    h0 = _Handle()
+    sup.add_child(ChildSpec("w", start=lambda ctx: _Handle()), handle=h0)
+    h0.exitcode = 1
+    sup.poll(1.0)                                # failure + escalation
+    sup.poll(2.0)                                # cold restart
+    sup.poll(3.0)                                # recovery: RTO observed
+
+    # -- crash_injected_signals_total: one scheduled SIGKILL delivered
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        ks = KillSchedule(seed=2, duration_s=0.2, kills=1, stops=0)
+        ks.start(lambda: proc.pid)
+        ks.join(timeout_s=10.0)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_WAL_FAMILIES:
+        assert fam in text, f"wal family silent: {fam}"
+    for fam in EXPECTED_CRASH_FAMILIES:
+        assert fam in text, f"crash family silent: {fam}"
+    assert "# TYPE wal_open_requests gauge" in text
+    assert "# TYPE crash_rto_seconds histogram" in text

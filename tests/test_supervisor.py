@@ -1,0 +1,456 @@
+"""Process supervisor (resilience/supervisor.py): escalation ladder,
+RTO accounting, stall detection, seeded backoff/kill schedules — all
+pure-logic with fake handles + a fake clock (tier-1), plus real
+process-kill drills against the sidecar worker (slow)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL
+from fabric_token_sdk_tpu.obs.journal import Journal
+from fabric_token_sdk_tpu.resilience import (RUNG_COLD_RESTART,
+                                             RUNG_GIVE_UP, RUNG_RESTART,
+                                             ChildSpec, KillSchedule,
+                                             Supervisor, SupervisorPolicy)
+from fabric_token_sdk_tpu.resilience.supervisor import COLD_CACHE_ENV
+
+pytestmark = pytest.mark.crash
+
+#: Above the kernel's default pid_max (4194304 would be the first
+#: impossible pid; anything >= it can never name a live process), so the
+#: supervisor's SIGUSR1 poke on a stalled fake handle hits nothing.
+_FAKE_PID = 4_194_313
+
+
+class _FakeHandle:
+    """multiprocessing.Process duck-type driven by the test."""
+
+    def __init__(self, pid=_FAKE_PID):
+        self.pid = pid
+        self.exitcode = None
+        self.terminated = 0
+        self.killed = 0
+
+    def is_alive(self):
+        return self.exitcode is None
+
+    def die(self, code=-9):
+        self.exitcode = code
+
+    def terminate(self):
+        self.terminated += 1
+        self.die(-15)
+
+    def kill(self):
+        self.killed += 1
+        self.die(-9)
+
+    def join(self, timeout=None):
+        pass
+
+
+def _fake_supervisor(policy, **kw):
+    """Supervisor on a settable clock; poll() is driven manually (the
+    monitor thread never starts)."""
+    clk = {"t": 0.0}
+    sup = Supervisor(policy=policy, clock=lambda: clk["t"],
+                     journal=Journal(min_interval_s=0.0), **kw)
+    return sup, clk
+
+
+def _tick(sup, clk, t):
+    clk["t"] = t
+    sup.poll()
+
+
+def _stamp(path, t, phase, pid):
+    with open(path, "a") as f:
+        f.write(json.dumps({"t": t, "phase": phase, "pid": pid}) + "\n")
+
+
+# -------------------------------------------------------------- ladder
+def test_escalation_ladder_restart_cold_giveup(monkeypatch):
+    GLOBAL.reset()
+    monkeypatch.setenv(COLD_CACHE_ENV[0], "/tmp/warm-cache")
+    policy = SupervisorPolicy(seed=3, backoff_base_s=0.01,
+                              backoff_cap_s=0.02, cold_after=1,
+                              give_up_after=2, stable_reset_s=1e9)
+    sup, clk = _fake_supervisor(policy)
+    spawned, handles, gave_up = [], [], []
+
+    def start(ctx):
+        # capture what a spawn callable observes: the RestartContext and
+        # whether the warm-cache env was cleared for this spawn
+        spawned.append((ctx, os.environ.get(COLD_CACHE_ENV[0])))
+        h = _FakeHandle()
+        handles.append(h)
+        return h
+
+    h0 = _FakeHandle()
+    handles.append(h0)
+    sup.add_child(ChildSpec(
+        "w", start=start,
+        on_give_up=lambda name, n: gave_up.append((name, n))), handle=h0)
+
+    # failure 1 -> warm restart, env untouched
+    handles[-1].die(code=1)
+    _tick(sup, clk, 100.0)
+    assert sup.status()["w"]["state"] == "backoff"
+    _tick(sup, clk, 110.0)
+    ctx, env = spawned[-1]
+    assert (ctx.rung, ctx.cold, env) == (RUNG_RESTART, False,
+                                         "/tmp/warm-cache")
+
+    # failure 2 (> cold_after=1) -> cold restart with caches cleared
+    # during the spawn and restored right after
+    handles[-1].die(code=1)
+    _tick(sup, clk, 120.0)
+    _tick(sup, clk, 130.0)
+    ctx, env = spawned[-1]
+    assert (ctx.rung, ctx.cold, env) == (RUNG_COLD_RESTART, True, None)
+    assert os.environ[COLD_CACHE_ENV[0]] == "/tmp/warm-cache"
+
+    # failure 3 (> give_up_after=2) -> give up: incident, callback, no
+    # further spawns ever
+    handles[-1].die(code=1)
+    _tick(sup, clk, 140.0)
+    st = sup.status()["w"]
+    assert (st["state"], st["rung"]) == ("failed", RUNG_GIVE_UP)
+    assert gave_up == [("w", 3)]
+    assert any("supervisor_give_up" in str(e) for e in sup.journal.tail())
+    n = len(spawned)
+    _tick(sup, clk, 10_000.0)
+    assert len(spawned) == n
+
+    snap = GLOBAL.snapshot()
+    key = ("crash_failures_total", (("cause", "exit"), ("child", "w")))
+    assert snap[key] == 3
+    assert snap[("crash_restarts_total",
+                 (("child", "w"), ("rung", RUNG_RESTART)))] == 1
+    assert snap[("crash_restarts_total",
+                 (("child", "w"), ("rung", RUNG_COLD_RESTART)))] == 1
+    assert snap[("crash_escalations_total",
+                 (("child", "w"), ("rung", RUNG_COLD_RESTART)))] == 1
+    assert snap[("crash_escalations_total",
+                 (("child", "w"), ("rung", RUNG_GIVE_UP)))] == 1
+    assert snap[("crash_child_up", (("child", "w"),))] == 0
+
+
+def test_stable_uptime_clears_ladder():
+    GLOBAL.reset()
+    policy = SupervisorPolicy(backoff_base_s=0.01, backoff_cap_s=0.02,
+                              cold_after=1, give_up_after=10,
+                              stable_reset_s=5.0)
+    sup, clk = _fake_supervisor(policy)
+    handles = []
+
+    def start(ctx):
+        handles.append(_FakeHandle())
+        return handles[-1]
+
+    h0 = _FakeHandle()
+    handles.append(h0)
+    sup.add_child(ChildSpec("w", start=start), handle=h0)
+
+    handles[-1].die(code=1)
+    _tick(sup, clk, 0.0)
+    _tick(sup, clk, 1.0)                       # respawned, failures=1
+    assert sup.status()["w"]["failures"] == 1
+    _tick(sup, clk, 7.0)                       # 6s stable >= 5s: cleared
+    assert sup.status()["w"]["failures"] == 0
+
+    # the next failure starts the ladder from scratch: warm, not cold
+    handles[-1].die(code=1)
+    _tick(sup, clk, 8.0)
+    _tick(sup, clk, 9.0)
+    st = sup.status()["w"]
+    assert (st["failures"], st["rung"]) == (1, RUNG_RESTART)
+
+
+# ----------------------------------------------------------------- RTO
+def test_rto_measured_without_heartbeat_file():
+    GLOBAL.reset()
+    policy = SupervisorPolicy(backoff_base_s=0.01, backoff_cap_s=0.02,
+                              stable_reset_s=1e9)
+    sup, clk = _fake_supervisor(policy)
+    h0 = _FakeHandle()
+    sup.add_child(ChildSpec("w", start=lambda ctx: _FakeHandle()),
+                  handle=h0)
+    h0.die(code=1)
+    _tick(sup, clk, 10.0)                      # detection instant
+    _tick(sup, clk, 12.0)                      # respawn
+    _tick(sup, clk, 12.5)                      # liveness == recovery
+    hist = GLOBAL.histogram("crash_rto_seconds", child="w")
+    assert hist.n == 1
+    assert abs(hist.total - 2.5) < 1e-6
+
+
+def test_rto_waits_for_fresh_heartbeat_from_new_pid(tmp_path):
+    GLOBAL.reset()
+    hb = str(tmp_path / "w.hb.jsonl")
+    policy = SupervisorPolicy(backoff_base_s=0.01, backoff_cap_s=0.02,
+                              stable_reset_s=1e9)
+    sup, clk = _fake_supervisor(policy)
+    h1 = _FakeHandle(pid=_FAKE_PID + 1)
+
+    _stamp(hb, 0.0, "ready", _FAKE_PID)
+    h0 = _FakeHandle(pid=_FAKE_PID)
+    sup.add_child(ChildSpec("w", start=lambda ctx: h1,
+                            heartbeat_file=hb, default_deadline_s=1e9,
+                            grace_s=1e9), handle=h0)
+    h0.die(code=1)
+    _tick(sup, clk, 5.0)                       # detection instant
+    _tick(sup, clk, 6.0)                       # respawn as pid+1
+    _tick(sup, clk, 7.0)
+    hist = GLOBAL.histogram("crash_rto_seconds", child="w")
+    # the dead pid's stale stamp must not count as recovery
+    assert hist.n == 0
+    _stamp(hb, 8.0, "ready", h1.pid)           # first beat of the NEW pid
+    _tick(sup, clk, 9.0)
+    assert hist.n == 1
+    assert abs(hist.total - 4.0) < 1e-6        # 9.0 - detection at 5.0
+
+
+# --------------------------------------------------------------- stall
+def test_stall_kills_and_restarts_the_wedged_child(tmp_path):
+    GLOBAL.reset()
+    hb = str(tmp_path / "w.hb.jsonl")
+    policy = SupervisorPolicy(backoff_base_s=0.01, backoff_cap_s=0.02,
+                              stable_reset_s=1e9)
+    sup, clk = _fake_supervisor(policy)
+    _stamp(hb, 100.0, "ready", _FAKE_PID)
+    h0 = _FakeHandle()
+    sup.add_child(ChildSpec("w", start=lambda ctx: _FakeHandle(),
+                            heartbeat_file=hb,
+                            deadlines={"ready": 2.0},
+                            default_deadline_s=1e9, grace_s=1e9),
+                  handle=h0)
+    clk["t"] = 100.5
+    sup.poll()                                 # fresh stamp: healthy
+    assert sup.status()["w"]["state"] == "running"
+
+    _tick(sup, clk, 110.0)                     # 10s-old "ready" beat
+    st = sup.status()["w"]
+    assert st["last_cause"] == "stall"
+    assert st["state"] == "backoff"
+    # the wedged-but-alive process was taken down before the restart
+    assert h0.terminated == 1 and not h0.is_alive()
+    key = ("crash_failures_total", (("cause", "stall"), ("child", "w")))
+    assert GLOBAL.snapshot()[key] == 1
+
+
+# ------------------------------------------------------------- seeding
+def test_backoff_schedule_is_deterministic_per_seed():
+    def restart_at(seed):
+        policy = SupervisorPolicy(seed=seed, backoff_base_s=0.05,
+                                  backoff_cap_s=2.0)
+        sup, clk = _fake_supervisor(policy)
+        h = _FakeHandle()
+        sup.add_child(ChildSpec("w", start=lambda ctx: _FakeHandle()),
+                      handle=h)
+        h.die(code=1)
+        _tick(sup, clk, 50.0)
+        return sup._children["w"].restart_at
+
+    assert restart_at(7) == restart_at(7)
+    assert restart_at(7) > 50.0
+
+
+def test_kill_schedule_is_seeded_and_bounded():
+    a = KillSchedule(seed=5, duration_s=100.0, kills=3, stops=2)
+    b = KillSchedule(seed=5, duration_s=100.0, kills=3, stops=2)
+    assert a.events == b.events                # replayable run-over-run
+    assert a.events == sorted(a.events)
+    assert len(a.events) == 5
+    names = [name for _, name in a.events]
+    assert names.count("SIGKILL") == 3 and names.count("SIGSTOP") == 2
+    for offset, _ in a.events:
+        assert 15.0 <= offset <= 85.0          # middle of the window
+    c = KillSchedule(seed=6, duration_s=100.0, kills=3, stops=2)
+    assert c.events != a.events
+
+
+def test_kill_schedule_delivers_and_counts():
+    GLOBAL.reset()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        ks = KillSchedule(seed=1, duration_s=0.4, kills=1, stops=0)
+        ks.start(lambda: proc.pid)
+        ks.join(timeout_s=10.0)
+        proc.wait(timeout=10)
+        assert proc.returncode == -signal.SIGKILL
+        assert [(s, p) for _, s, p in ks.delivered] \
+            == [("SIGKILL", proc.pid)]
+        key = ("crash_injected_signals_total", (("signal", "SIGKILL"),))
+        assert GLOBAL.snapshot()[key] == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+# ------------------------------------------- real process-kill drills
+def _worker_client(hb):
+    from fabric_token_sdk_tpu.serve.worker import stub_zk_factory
+
+    from fabric_token_sdk_tpu.serve import WorkerClient
+
+    return WorkerClient(stub_zk_factory, heartbeat_path=hb,
+                        call_timeout_s=60.0)
+
+
+@pytest.mark.slow
+def test_supervisor_restarts_sigkilled_worker(tmp_path):
+    GLOBAL.reset()
+    hb = str(tmp_path / "w.hb.jsonl")
+    worker = _worker_client(hb)
+
+    def respawn(ctx=None):
+        # a dead pid's stale stamp would trip the stall watch against
+        # the fresh child; with no file, grace_s covers the boot
+        try:
+            os.remove(hb)
+        except FileNotFoundError:
+            pass
+        return worker.spawn(ctx)
+
+    h = respawn()
+    worker.wait_ready(timeout_s=60.0)
+    sup = Supervisor(policy=SupervisorPolicy(backoff_base_s=0.05,
+                                             backoff_cap_s=0.2),
+                     poll_s=0.05)
+    sup.add_child(ChildSpec("w", start=respawn, heartbeat_file=hb,
+                            default_deadline_s=120.0, grace_s=120.0),
+                  handle=h)
+    sup.start()
+    try:
+        pid0 = worker.pid
+        assert worker._range.verify([1, 0, 1], list("abc")).tolist() \
+            == [True, False, True]
+        os.kill(pid0, signal.SIGKILL)
+        deadline = time.monotonic() + 60
+        verdicts = None
+        while time.monotonic() < deadline:
+            if worker.pid is not None and worker.pid != pid0:
+                try:
+                    verdicts = worker._range.verify([1, 0, 1],
+                                                    list("abc")).tolist()
+                    break
+                except Exception:  # noqa: BLE001 — still rebooting
+                    pass
+            time.sleep(0.05)
+        # the replacement serves bit-identical verdicts
+        assert verdicts == [True, False, True]
+        assert worker.pid != pid0
+        snap = GLOBAL.snapshot()
+        key = ("crash_failures_total",
+               (("cause", "exit"), ("child", "w")))
+        assert snap[key] >= 1
+    finally:
+        sup.stop(terminate_children=True)
+        worker.stop()
+
+
+@pytest.mark.slow
+def test_supervisor_recovers_sigstopped_worker(tmp_path):
+    """SIGSTOP is the stealth failure: the process stays alive but its
+    beats freeze. Recovery must come from the stall watch, which must
+    escalate to SIGKILL (a queued SIGTERM never reaches a stopped
+    process)."""
+    GLOBAL.reset()
+    hb = str(tmp_path / "w.hb.jsonl")
+    worker = _worker_client(hb)
+
+    def respawn(ctx=None):
+        try:
+            os.remove(hb)
+        except FileNotFoundError:
+            pass
+        return worker.spawn(ctx)
+
+    h = respawn()
+    worker.wait_ready(timeout_s=60.0)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and worker.phase() != "ready":
+        time.sleep(0.05)
+    assert worker.phase() == "ready"
+
+    sup = Supervisor(policy=SupervisorPolicy(backoff_base_s=0.05,
+                                             backoff_cap_s=0.2),
+                     poll_s=0.05)
+    sup.add_child(ChildSpec("w", start=respawn, heartbeat_file=hb,
+                            deadlines={"ready": 1.5},
+                            default_deadline_s=60.0, grace_s=120.0),
+                  handle=h)
+    sup.start()
+    try:
+        pid0 = worker.pid
+        os.kill(pid0, signal.SIGSTOP)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if worker.pid is not None and worker.pid != pid0 \
+                    and worker.phase() == "ready":
+                break
+            time.sleep(0.05)
+        assert worker.pid is not None and worker.pid != pid0
+        assert worker._range.verify([1, 0], list("ab")).tolist() \
+            == [True, False]
+        key = ("crash_failures_total",
+               (("cause", "stall"), ("child", "w")))
+        assert GLOBAL.snapshot()[key] >= 1
+    finally:
+        sup.stop(terminate_children=True)
+        worker.stop()
+
+
+@pytest.mark.slow
+def test_service_degrades_to_host_fallback_when_worker_dies():
+    """Degraded mode: with the worker dead and no supervisor running,
+    every verdict rides the host fallback (bit-identical) instead of
+    erroring — availability degrades, it never zeroes."""
+    import asyncio
+
+    from fabric_token_sdk_tpu.resilience import ResilienceConfig
+    from fabric_token_sdk_tpu.serve import (STATUS_OK, ServeConfig,
+                                            VerificationService)
+    from fabric_token_sdk_tpu.serve.worker import StubHostFallback
+
+    worker = _worker_client(None)
+    worker.spawn()
+    worker.wait_ready(timeout_s=60.0)
+    resil = ResilienceConfig(retry_attempts=2, retry_base_s=0.01,
+                             retry_cap_s=0.02, breaker_min_volume=2,
+                             breaker_reset_s=60.0,
+                             watchdog_timeout_s=None)
+    svc = VerificationService(
+        worker,
+        config=ServeConfig(buckets=(4,), max_wait_s=0.005,
+                           default_deadline_s=30.0),
+        resilience=resil, fallback=StubHostFallback())
+
+    async def run():
+        await svc.start(prewarm=False)
+        first = await svc.submit_range(1, "c")
+        assert first.accepted is True and first.served_by == "device"
+        worker._proc.kill()
+        worker._proc.join()
+        outs = await asyncio.gather(
+            *[svc.submit_range(i % 2, f"c{i}") for i in range(6)])
+        await svc.stop(timeout_s=10.0)
+        return outs
+
+    try:
+        outs = asyncio.run(run())
+        for i, res in enumerate(outs):
+            assert res.status == STATUS_OK
+            assert res.served_by == "host"
+            assert res.accepted is bool(i % 2)
+    finally:
+        worker.stop()

@@ -13,6 +13,8 @@ import json
 import re
 import urllib.error
 import urllib.request
+
+import pytest
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -323,3 +325,36 @@ def test_trace_every_zero_disables_request_spans():
     assert all(r.ok for r in results)
     assert not [r for r in TRACER.root_snapshot()
                 if r.name == "serve.request"]
+
+
+@pytest.mark.crash
+def test_server_socket_reuses_address():
+    """Restart-friendliness: a supervisor-respawned telemetry plane must
+    rebind its fixed scrape port immediately (SO_REUSEADDR), not
+    crash-loop on EADDRINUSE through the predecessor's TIME_WAIT."""
+    import socket
+
+    from fabric_token_sdk_tpu.obs.telemetry import _TelemetryHTTPServer
+
+    assert _TelemetryHTTPServer.allow_reuse_address is True
+
+    provider = MetricsProvider()
+    server = TelemetryServer(TelemetryConfig(port=0), provider=provider,
+                             tracer=Tracer(provider=provider))
+    url = server.start()
+    port = server.port
+    try:
+        assert server._httpd.socket.getsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR) != 0
+        # an accepted connection leaves sockets behind in TIME_WAIT
+        assert _get(url + "/metrics")[0] == 200
+    finally:
+        server.stop()
+
+    succ = TelemetryServer(TelemetryConfig(port=port), provider=provider,
+                           tracer=Tracer(provider=provider))
+    succ.start()                       # immediate same-port rebind
+    try:
+        assert _get(succ.url + "/metrics")[0] == 200
+    finally:
+        succ.stop()
