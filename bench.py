@@ -286,7 +286,7 @@ def _bench_adversarial():
             "vs_baseline": round(BATCH / elapsed / TARGET_BASELINE, 4)}))
 
 
-def _start_bench_telemetry(svc):
+def _start_bench_telemetry(svc, supervisor=None):
     """With BENCH_TELEMETRY_PORT=<port> set, put the live telemetry
     plane on the running bench service (scrape /metrics, /statusz,
     /tracez while the open loop is in flight). Returns the server or
@@ -297,7 +297,8 @@ def _start_bench_telemetry(svc):
     from fabric_token_sdk_tpu.obs import TelemetryConfig, serve_telemetry
 
     host = os.environ.get("BENCH_TELEMETRY_HOST", "0.0.0.0")
-    server = serve_telemetry(svc, TelemetryConfig(host=host, port=int(port)))
+    server = serve_telemetry(svc, TelemetryConfig(host=host, port=int(port)),
+                             supervisor=supervisor)
     print(f"bench: telemetry plane at {server.url} "
           "(/metrics /healthz /readyz /statusz /tracez)", file=sys.stderr)
     return server
@@ -609,18 +610,21 @@ def _crash_worker_factory():
 def _bench_crash():
     """BENCH_MODE=crash: the serve bench under a seeded kill schedule.
 
-    The device backend runs as a supervised sidecar process
-    (serve/worker.py) with the request WAL armed. While an open-loop
-    arrival stream submits range requests, a seeded KillSchedule
-    SIGKILLs and SIGSTOPs the worker mid-load; the supervisor detects
-    the exit / heartbeat stall and restarts it while traffic rides the
-    host fallback (degraded, never down). Reports availability, p99
-    under kills, RTO per recovery, and the WAL accounting — then runs a
-    replay drill: admit a burst, abort the service mid-flight
-    (simulated crash), and let a successor service over the same WAL
-    directory replay every incomplete request to a bit-identical
-    verdict with exactly-once terminal accounting. Same seeds → same
-    kill schedule → reproducible run."""
+    The device backend runs as a supervised sidecar process with the
+    request WAL armed — a multiprocessing pipe worker (serve/worker.py)
+    by default, or the TCP RPC sidecar (serve/sidecar.py) with a
+    reconnecting RpcClient under BENCH_CRASH_TRANSPORT=tcp. While an
+    open-loop arrival stream submits range requests, a seeded
+    KillSchedule SIGKILLs and SIGSTOPs the sidecar mid-load; the
+    supervisor detects the exit / heartbeat stall and restarts it while
+    traffic rides the host fallback (degraded, never down) and, on tcp,
+    the client redials through its decorrelated-jitter ladder. Reports
+    availability, p99 under kills, RTO per recovery, and the WAL
+    accounting — then runs a replay drill: admit a burst, abort the
+    service mid-flight (simulated crash), and let a successor service
+    over the same WAL directory replay every incomplete request to a
+    bit-identical verdict with exactly-once terminal accounting. Same
+    seeds → same kill schedule → reproducible run."""
     import asyncio
     import copy
     import shutil
@@ -659,14 +663,32 @@ def _bench_crash():
     wal_root = BENCH_DIR / "crash_wal"
     shutil.rmtree(wal_root, ignore_errors=True)
     hb_path = str(BENCH_DIR / "crash_worker.hb.jsonl")
+    transport = os.environ.get("BENCH_CRASH_TRANSPORT", "pipe")
+    call_timeout_s = float(os.environ.get("BENCH_CRASH_CALL_TIMEOUT", "60"))
 
     _configure_bench_journal()
-    worker = WorkerClient(
-        _crash_worker_factory, pp=pp, heartbeat_path=hb_path,
-        prewarm_buckets=buckets,
-        call_timeout_s=float(os.environ.get("BENCH_CRASH_CALL_TIMEOUT",
-                                            "60")),
-        name="verify-worker")
+    if transport == "tcp":
+        # real network boundary: the whole serving backend lives in the
+        # TCP sidecar process; the bench dials it with a reconnecting
+        # RpcClient that matches the zk duck-type, so everything below
+        # (service, WAL, fallback ladder) is transport-agnostic
+        from fabric_token_sdk_tpu.serve import RpcClient, RpcSidecar
+
+        sidecar = RpcSidecar(
+            _crash_worker_factory, heartbeat_path=hb_path,
+            buckets=buckets, prewarm=True, name="verify-worker")
+        worker = RpcClient(sidecar.address, pp=pp, tms_id="bench",
+                           call_timeout_s=call_timeout_s,
+                           name="verify-worker")
+    elif transport == "pipe":
+        sidecar = None
+        worker = WorkerClient(
+            _crash_worker_factory, pp=pp, heartbeat_path=hb_path,
+            prewarm_buckets=buckets,
+            call_timeout_s=call_timeout_s,
+            name="verify-worker")
+    else:
+        raise SystemExit(f"unknown BENCH_CRASH_TRANSPORT {transport!r}")
 
     def _respawn(ctx=None):
         # clear the dead pid's stamps first: the stall watch would
@@ -676,7 +698,12 @@ def _bench_crash():
             os.remove(hb_path)
         except OSError:
             pass
+        if sidecar is not None:
+            return sidecar.spawn(ctx)
         return worker.spawn(ctx)
+
+    def _get_pid():
+        return sidecar.pid if sidecar is not None else worker.pid
 
     proc = _respawn()
     supervisor = Supervisor(
@@ -700,9 +727,7 @@ def _bench_crash():
     wal = WriteAheadLog(str(wal_root / "serve"))
     svc = VerificationService(worker, config=cfg, resilience=resil,
                               slo=SloMonitor(), wal=wal)
-    telemetry = _start_bench_telemetry(svc)
-    if telemetry is not None:
-        telemetry.add_status_source("supervisor", supervisor.status)
+    telemetry = _start_bench_telemetry(svc, supervisor=supervisor)
     n = len(proofs)
     forged = copy.deepcopy(proofs[0])
     forged.data.tau = (forged.data.tau + 1) % (1 << 250)
@@ -721,7 +746,7 @@ def _bench_crash():
               file=sys.stderr)
         loop = asyncio.get_running_loop()
         t0 = loop.time()
-        schedule.start(lambda: worker.pid)
+        schedule.start(_get_pid)
 
         async def one(i, offset):
             delay = t0 + offset - loop.time()
@@ -819,6 +844,16 @@ def _bench_crash():
         telemetry.stop()
     supervisor.stop()
     worker.stop()
+    if sidecar is not None:
+        sidecar.stop()
+        # draining stops under load must never cut a frame in half;
+        # the client-side counter would have recorded it
+        tcp_frame_errors = sum(
+            v for (name, labels), v in METRICS.snapshot().items()
+            if name == "rpc_frame_errors_total"
+            and dict(labels).get("kind") == "midframe_close")
+        assert tcp_frame_errors == 0, \
+            "crash bench: connection closed mid-frame"
     wal.close()
     wal_b.close()
 
@@ -826,7 +861,7 @@ def _bench_crash():
         "metric": f"crash_availability_{BIT_LENGTH}bit",
         "value": round(availability, 6),
         "unit": (f"non-error terminal fraction ({total - errors}/{total}; "
-                 f"seed={seed}; injected "
+                 f"seed={seed}; transport={transport}; injected "
                  f"{int(fam('crash_injected_signals_total'))} signals "
                  f"({kills} SIGKILL + {stops} SIGSTOP scheduled), "
                  f"{int(fam('crash_failures_total'))} failures detected, "

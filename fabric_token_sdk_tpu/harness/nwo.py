@@ -254,6 +254,25 @@ class NodeSpec:
     #                              artifacts); empty -> fresh key at boot
 
 
+def _sidecar_zk_factory(pp_raw: bytes, driver: str):
+    """Picklable verification backend for the shared TCP sidecar.
+
+    zkatdlog gets the real host verifier over the platform's public
+    params; fabtoken (no zk proofs to verify) gets the crypto-free
+    ``StubZK``, which keeps the network plane — framing, credits,
+    deadlines, reconnects — fully exercisable under every driver.
+    """
+    if driver == "zkatdlog":
+        from ..core.zkatdlog.verifier import ZKVerifier
+        from ..crypto import setup
+
+        pp = setup.PublicParams.deserialize(pp_raw)
+        return ZKVerifier(pp, device=False)
+    from ..serve.worker import StubZK
+
+    return StubZK()
+
+
 def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
                fleet_spool_dir=None, state_dir=None):
     """Entry point of one node process."""
@@ -355,6 +374,18 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
                driver=bundle.services, owner_wallet=owner_wallet)
     delivery.start()
 
+    # shared verification sidecar: every node process dials the ONE
+    # TCP front door, multi-tenant by node name — the "millions of
+    # users" topology in miniature (N clients, one Validator SPI)
+    rpc_client = None
+    if extra.get("sidecar_addr"):
+        from ..serve.rpc_client import RpcClient
+
+        rpc_client = RpcClient(tuple(extra["sidecar_addr"]),
+                               tms_id=spec.name,
+                               name=f"rpc-{spec.name}")
+        rpc_client.wait_ready(timeout_s=120.0)
+
     stop_event = threading.Event()
     dispatcher = threading.Thread(
         target=_dispatch_loop, args=(node, inboxes[spec.name], stop_event),
@@ -370,6 +401,8 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
             if cmd == "stop":
                 stop_event.set()
                 delivery.stop()
+                if rpc_client is not None:
+                    rpc_client.close()
                 if hb is not None:
                     hb.beat("stopped")
                 if publisher is not None:
@@ -393,6 +426,18 @@ def _node_main(spec_dict, ledger_address, authkey, inboxes, control, replies,
                 token_type, = args
                 control["out"].put(("result", spec.name,
                                     node.balance(token_type)))
+            elif cmd == "verify_range":
+                # offload a range-proof batch through the SHARED TCP
+                # sidecar (transport failures surface as transient
+                # WorkerUnavailable and are reported, not crashes)
+                proofs, coms = args
+                if rpc_client is None:
+                    control["out"].put(("error", spec.name,
+                                        "no sidecar configured"))
+                else:
+                    verdicts = rpc_client.submit_range(proofs, coms)
+                    control["out"].put(("result", spec.name,
+                                        [bool(v) for v in verdicts]))
             elif cmd == "wait_tx":
                 tx_id, timeout = args
                 deadline = time.time() + timeout
@@ -423,9 +468,18 @@ class Platform:
                  pp_raw: bytes | None = None,
                  fleet_spool_dir: str | None = None,
                  state_dir: str | None = None,
-                 supervise: bool = False, supervisor_policy=None):
+                 supervise: bool = False, supervisor_policy=None,
+                 sidecar: str | None = None, sidecar_factory=None):
+        if sidecar not in (None, "tcp"):
+            raise ValueError(f"unknown sidecar transport {sidecar!r}")
         self.specs = specs
         self.precision = precision
+        #: "tcp" boots one shared verification sidecar (serve/sidecar.py)
+        #: that every node process dials; None keeps verification
+        #: in-process per node.
+        self.sidecar_mode = sidecar
+        self.sidecar_factory = sidecar_factory
+        self.sidecar = None
         self.driver = driver
         self.bit_length = bit_length
         self._pp_override = pp_raw   # tokengen-artifacts pp, if any
@@ -514,11 +568,54 @@ class Platform:
         self._extra = {"precision": self.precision
                        if self.driver == "fabtoken" else self.bit_length,
                        "auditor": auditor}
+        if self.sidecar_mode == "tcp":
+            self._extra["sidecar_addr"] = list(
+                self._start_sidecar(pp_raw).address)
         for s in self.specs:
             self._controls[s.name]["in"].put(
                 ("start", pp_raw, self._extra))
         if self.supervise:
             self._start_supervisor()
+
+    def _start_sidecar(self, pp_raw: bytes):
+        """Boot the ONE shared verification sidecar all nodes dial.
+
+        WAL and heartbeat land under ``state_dir``/``fleet_spool_dir``
+        when available, so a supervised respawn replays open requests
+        and the supervisor's stall watch sees sidecar phases.
+        """
+        import functools
+        import os
+
+        from ..serve.sidecar import RpcSidecar
+
+        factory = self.sidecar_factory or functools.partial(
+            _sidecar_zk_factory, pp_raw, self.driver)
+        base = self.state_dir or self.fleet_spool_dir
+        wal_dir = hb_path = None
+        if base:
+            os.makedirs(base, exist_ok=True)
+            wal_dir = os.path.join(base, "sidecar_wal")
+            hb_path = os.path.join(base, "rpc-sidecar.hb.jsonl")
+        self.sidecar = RpcSidecar(
+            factory, heartbeat_path=hb_path, wal_dir=wal_dir,
+            prewarm=False, name="rpc-sidecar")
+        self.sidecar.spawn()
+        return self.sidecar
+
+    def _respawn_sidecar(self, ctx=None):
+        """ChildSpec.start for the sidecar: clear the dead pid's stale
+        heartbeat stamps first, then spawn the replacement (which
+        recovers + replays the shared WAL before serving)."""
+        import os
+
+        hb = self.sidecar.heartbeat_path
+        if hb is not None:
+            try:
+                os.remove(hb)
+            except OSError:
+                pass
+        return self.sidecar.spawn(ctx)
 
     def _start_supervisor(self) -> None:
         """Put every node process under the resilience supervisor: exit
@@ -545,6 +642,15 @@ class Platform:
                     heartbeat_file=hb_file,
                     default_deadline_s=1e9, grace_s=1e9),
                 handle=self._procs[s.name])
+        if self.sidecar is not None:
+            # the sidecar DOES beat at a steady cadence, so its stall
+            # watch is armed for real (SIGSTOP -> stall -> restart)
+            self.supervisor.add_child(
+                ChildSpec(name="rpc-sidecar",
+                          start=self._respawn_sidecar,
+                          heartbeat_file=self.sidecar.heartbeat_path,
+                          default_deadline_s=15.0, grace_s=300.0),
+                handle=self.sidecar._proc)
         self.supervisor.start()
 
     # ------------------------------------------------------------- restart
@@ -663,6 +769,12 @@ class Platform:
     def balance(self, node: str, token_type: str) -> int:
         return self.call(node, "balance", token_type)
 
+    def verify_range(self, node: str, proofs, coms=None) -> list[bool]:
+        """Drive a range-proof batch from ``node`` through the shared
+        TCP sidecar (requires ``sidecar="tcp"``)."""
+        coms = list(coms) if coms is not None else [None] * len(proofs)
+        return self.call(node, "verify_range", list(proofs), coms)
+
     # ------------------------------------------------------------ fleet obs
     def fleet_aggregator(self, provider=None):
         """A FleetAggregator over the platform spool (requires
@@ -727,6 +839,10 @@ class Platform:
                     p.join(timeout=2.0)
                     escalated[name] = "kill"
             exit_codes[name] = p.exitcode
+        if self.sidecar is not None:
+            # after the nodes: their stop path closes RPC clients first
+            self.sidecar.stop(timeout_s=max(2.0, timeout_s))
+            self.sidecar = None
         if self._ledger_proc is not None:
             self._ledger_proc.terminate()
             self._ledger_proc.join(timeout=2.0)

@@ -71,6 +71,9 @@ class _TelemetryHTTPServer(ThreadingHTTPServer):
 class _Handler(BaseHTTPRequestHandler):
     server_version = "fts-telemetry/1"
     protocol_version = "HTTP/1.1"
+    # socket-level read deadline: a slow-loris scraper (or a wedged
+    # peer) cannot pin a handler thread forever
+    timeout = 30.0
 
     def log_message(self, format, *args):  # noqa: A002 - stdlib signature
         pass  # scrape traffic must not spam the node's stdout
@@ -247,7 +250,8 @@ class TelemetryServer:
 
 def serve_telemetry(service, config: TelemetryConfig | None = None,
                     provider: MetricsProvider | None = None,
-                    tracer: Tracer | None = None) -> TelemetryServer:
+                    tracer: Tracer | None = None, *,
+                    supervisor=None, rpc_server=None) -> TelemetryServer:
     """Wire a TelemetryServer to a serve ``VerificationService``
     (duck-typed) and start it.
 
@@ -255,6 +259,12 @@ def serve_telemetry(service, config: TelemetryConfig | None = None,
     the node is alive but actively degrading, which is what a load
     balancer should route around. readyz fails until the frontend is
     running and prewarm compiled every bucket.
+
+    ``supervisor`` (anything with a ``status()``) and the service's WAL
+    are surfaced as ``/statusz`` sources so supervised restarts and WAL
+    segment state are visible to the ops plane, not just to metrics;
+    ``rpc_server`` likewise exposes the network front door's
+    connection/credit accounting.
     """
     server = TelemetryServer(config=config, provider=provider,
                              tracer=tracer)
@@ -287,6 +297,13 @@ def serve_telemetry(service, config: TelemetryConfig | None = None,
     slo = getattr(service, "slo", None)
     if slo is not None:
         server.add_status_source("slo", slo.summary)
+    if supervisor is not None and hasattr(supervisor, "status"):
+        server.add_status_source("supervisor", supervisor.status)
+    wal = getattr(service, "wal", None)
+    if wal is not None and hasattr(wal, "summary"):
+        server.add_status_source("wal", wal.summary)
+    if rpc_server is not None and hasattr(rpc_server, "status"):
+        server.add_status_source("rpc", rpc_server.status)
     # incident snapshots embed the same operational views /statusz serves
     for name, fn in server._status.items():
         if name != "journal":

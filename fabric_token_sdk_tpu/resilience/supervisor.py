@@ -151,6 +151,7 @@ def _alive(handle) -> bool:
         return False
     if hasattr(handle, "is_alive"):
         return bool(handle.is_alive())
+    # io-deadline: Popen.poll() is non-blocking (returns immediately)
     return handle.poll() is None  # subprocess.Popen
 
 
@@ -284,6 +285,7 @@ class Supervisor:
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
             try:
+                # io-deadline: one non-blocking supervision scan
                 self.poll()
             except Exception:  # noqa: BLE001 — monitor must survive
                 pass

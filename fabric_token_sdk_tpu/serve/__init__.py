@@ -17,8 +17,11 @@ from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
                       STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
                       STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
+from .rpc import FrameError, RpcConfig, RpcServer
+from .rpc_client import RpcClient
 from .scheduler import GROUPS, BucketScheduler
 from .service import VerificationService
+from .sidecar import RpcSidecar, pick_free_port, sidecar_main
 from .wal import WalConfig, WalEntry, WriteAheadLog
 from .worker import StubZK, WorkerClient, WorkerUnavailable, worker_main
 
@@ -26,6 +29,7 @@ __all__ = [
     "AdmissionController",
     "ACTION_KINDS",
     "BucketScheduler",
+    "FrameError",
     "GROUPS",
     "KIND_ISSUE",
     "KIND_RANGE",
@@ -34,6 +38,10 @@ __all__ = [
     "LANE_INTERACTIVE",
     "LANES",
     "PrewarmManager",
+    "RpcClient",
+    "RpcConfig",
+    "RpcServer",
+    "RpcSidecar",
     "SERVED_BY_DEVICE",
     "SERVED_BY_HOST",
     "ServeConfig",
@@ -52,5 +60,7 @@ __all__ = [
     "WorkerClient",
     "WorkerUnavailable",
     "WriteAheadLog",
+    "pick_free_port",
+    "sidecar_main",
     "worker_main",
 ]

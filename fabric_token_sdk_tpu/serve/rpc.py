@@ -1,0 +1,608 @@
+"""Network front door: asyncio TCP RPC server on the Validator SPI.
+
+The reference SDK's whole point is a pluggable ``driver.Validator``
+behind a process boundary (SURVEY §3.2). PR 8's sidecar speaks a
+same-host ``multiprocessing`` pipe; this module adds the real network
+plane — stdlib-only (asyncio TCP, no grpcio — same policy as the
+stdlib-HTTP ``TelemetryServer``) so the failure modes of a network
+boundary (half-open connections, torn frames, slow peers, reconnect
+storms) are exercised and testable.
+
+Wire format — length-prefixed, CRC-checksummed frames (the WAL's
+checksum discipline applied to the socket):
+
+    header  = struct ">BBHII" (12 bytes)
+              magic 0xF7 | frame type | flags (0) | payload len | CRC32
+    payload = pickled dict, CRC32-checked before unpickling
+
+Pickle is acceptable here for the same reason it is on the worker
+pipe: the sidecar is a same-trust-domain process boundary, not an
+internet-facing endpoint (README "Network boundary").
+
+Protocol:
+
+  HELLO{tms_id,t}  -> WELCOME{t,t_srv,credits,max_frame}   handshake;
+      the client derives RTT and a clock-offset estimate so wire
+      deadlines are absolute *server-clock* times.
+  SUBMIT{req_id,kind,lane,deadline,payload}  -> RESULT{req_id,...}
+      streaming batch submits; rows fan into
+      ``VerificationService.submit_*`` and the per-row verdicts are
+      demultiplexed back into one RESULT frame.
+  CREDIT{grant}    credit-based flow control: each connection holds a
+      row budget; SUBMIT rows consume it, the server replenishes from
+      admission headroom (``queue_capacity`` minus the deepest lane),
+      so backpressure reaches the client instead of an unbounded
+      socket buffer.
+  PING{t} -> PONG{t,t_srv}   liveness + RTT/offset refresh.
+  GOAWAY{reason}   draining stop: no new submits accepted, in-flight
+      frames finish, the server never closes a connection mid-frame
+      (asserted by per-connection frame accounting).
+  ERROR{...}       protocol-level rejection.
+
+Every read is under an explicit deadline (``asyncio.wait_for``) — a
+hung read with no deadline is how rc=124-with-no-diagnosis comes back
+(enforced by ``scripts/check_socket_timeouts.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+
+from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
+from ..obs.journal import JOURNAL
+from .config import LANE_BULK, LANES
+from .request import STATUS_OK
+
+MAGIC = 0xF7
+_HEADER = struct.Struct(">BBHII")
+HEADER_SIZE = _HEADER.size
+
+# Frame types.
+HELLO = 1
+WELCOME = 2
+SUBMIT = 3
+RESULT = 4
+CREDIT = 5
+PING = 6
+PONG = 7
+GOAWAY = 8
+ERROR = 9
+
+FRAME_NAMES = {
+    HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", RESULT: "result",
+    CREDIT: "credit", PING: "ping", PONG: "pong", GOAWAY: "goaway",
+    ERROR: "error",
+}
+
+DEFAULT_MAX_FRAME = 32 * 1024 * 1024
+
+# RESULT statuses (transport-level; row-level statuses reuse serve's).
+RPC_OK = STATUS_OK
+RPC_EXPIRED = "expired"            # shed at decode: wire deadline passed
+RPC_GOAWAY = "goaway"              # server draining, submit rejected
+RPC_ERROR = "error"
+
+_RPC_FAMILIES = {
+    "rpc_connections_total":
+        "RPC connections accepted by the server, by tenant tms id.",
+    "rpc_connections_active":
+        "RPC connections currently open on the server.",
+    "rpc_frames_total":
+        "RPC frames moved, by role (server/client), direction "
+        "(sent/recv) and frame type.",
+    "rpc_frame_errors_total":
+        "RPC frame-level failures by kind: torn (EOF mid-frame), "
+        "checksum, oversize, bad_magic, slow_frame (mid-frame stall "
+        "past the frame deadline), decode, protocol, credit_violation, "
+        "midframe_close.",
+    "rpc_requests_total":
+        "SUBMIT frames accepted into the service, by tenant tms id, "
+        "kind and lane.",
+    "rpc_credits":
+        "Row credits currently granted to a tenant's connection "
+        "(server-side view of the client's spendable budget).",
+    "rpc_credit_waits_total":
+        "Client-side stalls waiting for row credits (backpressure "
+        "reached the client).",
+    "rpc_redials_total":
+        "Client reconnect attempts, by outcome (ok / error).",
+    "rpc_goaways_total":
+        "GOAWAY frames, by role (server sent / client received).",
+    "rpc_deadline_expired_total":
+        "SUBMIT frames shed at decode because the wire-propagated "
+        "deadline had already passed.",
+    "rpc_call_seconds":
+        "Client-observed RPC round-trip wall seconds, by kind.",
+    "rpc_hedges_total":
+        "Hedged duplicate SUBMITs sent for the interactive lane.",
+}
+
+
+class FrameError(Exception):
+    """A frame-level protocol failure; ``kind`` feeds the metric label."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+
+
+def _describe(provider) -> None:
+    for fam, help_text in _RPC_FAMILIES.items():
+        provider.describe(fam, help_text)
+
+
+# --------------------------------------------------------------- codec
+def encode_frame(ftype: int, body: dict,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
+    """Serialize one frame: 12-byte header + pickled, CRC'd payload."""
+    payload = pickle.dumps(body, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > max_frame_bytes:
+        raise FrameError("oversize",
+                         f"{len(payload)}B payload > {max_frame_bytes}B cap")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return _HEADER.pack(MAGIC, ftype, 0, len(payload), crc) + payload
+
+
+def decode_header(header: bytes,
+                  max_frame_bytes: int = DEFAULT_MAX_FRAME):
+    """Validate a 12-byte header -> (ftype, length, crc)."""
+    magic, ftype, _flags, length, crc = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameError("bad_magic", f"0x{magic:02x}")
+    if length > max_frame_bytes:
+        raise FrameError("oversize",
+                         f"{length}B header length > {max_frame_bytes}B cap")
+    return ftype, length, crc
+
+
+def decode_payload(payload: bytes, crc: int):
+    """CRC-check then unpickle a frame payload."""
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise FrameError("checksum",
+                         f"crc mismatch over {len(payload)}B payload")
+    try:
+        return pickle.loads(payload)
+    except Exception as exc:  # corrupt-but-crc-colliding, or bad pickle
+        raise FrameError("decode", repr(exc)) from exc
+
+
+async def read_frame(reader: asyncio.StreamReader, *,
+                     max_frame_bytes: int = DEFAULT_MAX_FRAME,
+                     header_timeout_s: float | None = None,
+                     body_timeout_s: float = 30.0):
+    """Read one frame; ``None`` on clean EOF at a frame boundary.
+
+    ``header_timeout_s`` bounds the idle wait for a new frame
+    (``asyncio.TimeoutError`` escapes so the caller can use it as a
+    checkpoint); ``body_timeout_s`` bounds the rest of the frame once
+    its first byte arrived — a slow-loris peer that trickles a frame
+    surfaces as ``FrameError("slow_frame")``, never a hang.
+    """
+    try:
+        header = await asyncio.wait_for(
+            reader.readexactly(HEADER_SIZE), header_timeout_s)
+    except asyncio.IncompleteReadError as exc:
+        if not exc.partial:
+            return None  # clean EOF between frames
+        raise FrameError("torn",
+                         f"EOF after {len(exc.partial)}B of header") from exc
+    ftype, length, crc = decode_header(header, max_frame_bytes)
+    try:
+        payload = await asyncio.wait_for(
+            reader.readexactly(length), body_timeout_s)
+    except asyncio.IncompleteReadError as exc:
+        raise FrameError(
+            "torn",
+            f"EOF after {len(exc.partial)}/{length}B of payload") from exc
+    except asyncio.TimeoutError as exc:
+        raise FrameError(
+            "slow_frame",
+            f"payload stalled past {body_timeout_s}s deadline") from exc
+    return ftype, decode_payload(payload, crc)
+
+
+# ----------------------------------------------------- sync codec (client)
+def send_frame_sock(sock, ftype: int, body: dict,
+                    max_frame_bytes: int = DEFAULT_MAX_FRAME) -> None:
+    """Blocking frame send; the socket's own timeout bounds it."""
+    sock.sendall(encode_frame(ftype, body, max_frame_bytes))
+
+
+def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
+    """Blocking exact read; ``deadline`` is an absolute monotonic cap.
+
+    Returns ``b""`` on clean EOF before the first byte. Raises
+    ``FrameError("torn")`` on EOF mid-buffer and
+    ``FrameError("slow_frame")`` when the deadline passes mid-buffer.
+    The socket must carry a finite ``settimeout`` so each recv ticks.
+    """
+    buf = bytearray()
+    while len(buf) < n:
+        if deadline is not None and time.monotonic() >= deadline:
+            raise FrameError("slow_frame",
+                             f"{len(buf)}/{n}B before deadline")
+        try:
+            chunk = sock.recv(n - len(buf))  # io-deadline: settimeout tick
+        except TimeoutError:
+            if not buf and deadline is None:
+                raise  # idle tick between frames: caller's checkpoint
+            continue
+        if not chunk:
+            if not buf:
+                return b""
+            raise FrameError("torn", f"EOF after {len(buf)}/{n}B")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame_sock(sock, *, max_frame_bytes: int = DEFAULT_MAX_FRAME,
+                    body_timeout_s: float = 30.0):
+    """Blocking frame read; ``None`` on clean EOF at a frame boundary.
+
+    Idle waits between frames raise ``TimeoutError`` (the socket's
+    ``settimeout`` tick) so the caller can poll a stop flag; once the
+    first byte lands, the whole frame must arrive within
+    ``body_timeout_s`` or the read fails as ``slow_frame``.
+    """
+    first = recv_exact_sock(sock, 1)
+    if not first:
+        return None
+    deadline = time.monotonic() + body_timeout_s
+    rest = recv_exact_sock(sock, HEADER_SIZE - 1, deadline=deadline)
+    if len(rest) != HEADER_SIZE - 1:
+        raise FrameError("torn", "EOF mid-header")
+    ftype, length, crc = decode_header(first + rest, max_frame_bytes)
+    payload = recv_exact_sock(sock, length, deadline=deadline)
+    if len(payload) != length:
+        raise FrameError("torn", "EOF mid-payload")
+    return ftype, decode_payload(payload, crc)
+
+
+# -------------------------------------------------------------- server
+@dataclass(frozen=True)
+class RpcConfig:
+    """Network-plane knobs; all waits are finite by construction."""
+
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 = ephemeral (tests)
+    max_frame_bytes: int = DEFAULT_MAX_FRAME
+    hello_timeout_s: float = 5.0       # handshake must complete in this
+    idle_tick_s: float = 0.5           # read-loop checkpoint cadence
+    frame_timeout_s: float = 10.0      # slow-loris: whole frame after byte 0
+    write_timeout_s: float = 30.0      # drain() cap per frame
+    conn_credits: int = 1024           # per-connection row-budget ceiling
+    drain_timeout_s: float = 30.0      # stop(): cap on finishing in-flight
+
+
+class _Conn:
+    """Per-connection state: credits, write lock, frame accounting."""
+
+    def __init__(self, server: "RpcServer", reader, writer, cid: int):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.cid = cid
+        self.tms_id = "unknown"
+        self.credits = 0               # server-side view of client budget
+        self.write_lock = asyncio.Lock()
+        self.frames_started = 0        # writes begun (header bytes queued)
+        self.frames_done = 0           # writes fully drained
+        self.inflight: set[asyncio.Task] = set()
+        self.goaway_sent = False
+        self.closing = False
+
+    async def send(self, ftype: int, body: dict) -> None:
+        cfg = self.server.config
+        buf = encode_frame(ftype, body, cfg.max_frame_bytes)
+        async with self.write_lock:
+            if self.closing:
+                raise ConnectionResetError("connection closing")
+            self.frames_started += 1
+            self.writer.write(buf)
+            await asyncio.wait_for(self.writer.drain(), cfg.write_timeout_s)
+            self.frames_done += 1
+        self.server._count_frame("sent", ftype)
+
+
+class RpcServer:
+    """Streaming TCP front door over a running ``VerificationService``.
+
+    Single event loop, shared with the service's dispatch loop. Start
+    the service first, then ``await server.start()``; ``stop()`` is a
+    draining stop: GOAWAY to every connection, in-flight frames finish,
+    no connection is closed mid-frame (``frames_clean`` asserts it).
+    """
+
+    def __init__(self, service, config: RpcConfig | None = None, *,
+                 provider=None, tracer=None):
+        self.service = service
+        self.config = config or RpcConfig()
+        self.provider = provider or _METRICS
+        self.tracer = tracer or _TRACER
+        _describe(self.provider)
+        self._server: asyncio.base_events.Server | None = None
+        self._conns: dict[int, _Conn] = {}
+        self._next_cid = 0
+        self._draining = False
+        self.midframe_closes = 0
+        self.address: tuple[str, int] | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle, self.config.host, self.config.port,
+            reuse_address=True)
+        sockname = self._server.sockets[0].getsockname()
+        self.address = (sockname[0], sockname[1])
+        JOURNAL.record("rpc_listen", addr=f"{sockname[0]}:{sockname[1]}")
+        return self.address
+
+    async def stop(self, drain: bool = True) -> None:
+        """Draining stop: GOAWAY, finish in-flight, close clean."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+        conns = list(self._conns.values())
+        for conn in conns:
+            if not conn.goaway_sent:
+                conn.goaway_sent = True
+                try:
+                    await conn.send(GOAWAY, {"reason": "draining"})
+                    self.provider.counter(
+                        "rpc_goaways_total", role="server").add()
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass
+        if drain:
+            pending = [t for c in conns for t in list(c.inflight)]
+            if pending:
+                await asyncio.wait(
+                    pending, timeout=self.config.drain_timeout_s)
+        for conn in conns:
+            await self._close_conn(conn)
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    @property
+    def frames_clean(self) -> bool:
+        """True iff no connection was ever closed mid-write."""
+        return self.midframe_closes == 0
+
+    def status(self) -> dict:
+        """``/statusz`` payload: connections, credits, accounting."""
+        return {
+            "address": list(self.address) if self.address else None,
+            "draining": self._draining,
+            "connections": {
+                str(c.cid): {
+                    "tms_id": c.tms_id,
+                    "credits": c.credits,
+                    "inflight": len(c.inflight),
+                    "frames_started": c.frames_started,
+                    "frames_done": c.frames_done,
+                }
+                for c in self._conns.values()
+            },
+            "midframe_closes": self.midframe_closes,
+        }
+
+    # ------------------------------------------------------------- metrics
+    def _count_frame(self, direction: str, ftype: int) -> None:
+        self.provider.counter(
+            "rpc_frames_total", role="server", dir=direction,
+            type=FRAME_NAMES.get(ftype, str(ftype))).add()
+
+    def _frame_error(self, kind: str) -> None:
+        self.provider.counter("rpc_frame_errors_total", kind=kind).add()
+
+    # ------------------------------------------------------------- credits
+    def _credit_target(self) -> int:
+        """Row budget a connection may hold: admission headroom, capped.
+
+        Headroom follows the deepest lane so credits shrink as queues
+        fill — the client stalls on credits instead of stuffing the
+        socket buffer with work the admission controller would shed.
+        """
+        svc = self.service
+        deepest = max(
+            (svc.scheduler.lane_depth(lane) for lane in LANES), default=0)
+        headroom = svc.config.queue_capacity - deepest
+        return max(0, min(self.config.conn_credits, headroom))
+
+    async def _replenish(self, conn: _Conn) -> None:
+        grant = self._credit_target() - conn.credits
+        if grant <= 0 or conn.closing or conn.goaway_sent:
+            return
+        conn.credits += grant
+        self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
+        try:
+            await conn.send(CREDIT, {"grant": grant})
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            conn.credits -= grant
+
+    # ---------------------------------------------------------- connection
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        cfg = self.config
+        cid = self._next_cid
+        self._next_cid += 1
+        conn = _Conn(self, reader, writer, cid)
+        try:
+            frame = await read_frame(
+                reader, max_frame_bytes=cfg.max_frame_bytes,
+                header_timeout_s=cfg.hello_timeout_s,
+                body_timeout_s=cfg.hello_timeout_s)
+        except (FrameError, asyncio.TimeoutError, ConnectionError,
+                OSError) as exc:
+            kind = exc.kind if isinstance(exc, FrameError) else "torn"
+            self._frame_error(kind)
+            await self._close_conn(conn)
+            return
+        if frame is None or frame[0] != HELLO:
+            self._frame_error("protocol")
+            await self._close_conn(conn)
+            return
+        hello = frame[1]
+        conn.tms_id = str(hello.get("tms_id", "default"))
+        conn.credits = self._credit_target()
+        self._conns[cid] = conn
+        self.provider.counter("rpc_connections_total",
+                              tms=conn.tms_id).add()
+        self.provider.gauge("rpc_connections_active").set(len(self._conns))
+        self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
+        self._count_frame("recv", HELLO)
+        try:
+            await conn.send(WELCOME, {
+                "t": hello.get("t", 0.0),
+                "t_srv": time.time(),
+                "credits": conn.credits,
+                "max_frame": cfg.max_frame_bytes,
+            })
+            if self._draining and not conn.goaway_sent:
+                conn.goaway_sent = True
+                await conn.send(GOAWAY, {"reason": "draining"})
+                self.provider.counter(
+                    "rpc_goaways_total", role="server").add()
+            await self._read_loop(conn)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
+        finally:
+            if conn.inflight:
+                await asyncio.wait(list(conn.inflight),
+                                   timeout=cfg.drain_timeout_s)
+            await self._close_conn(conn)
+            self._conns.pop(cid, None)
+            self.provider.gauge(
+                "rpc_connections_active").set(len(self._conns))
+
+    async def _read_loop(self, conn: _Conn) -> None:
+        cfg = self.config
+        while not conn.closing:
+            try:
+                frame = await read_frame(
+                    conn.reader, max_frame_bytes=cfg.max_frame_bytes,
+                    header_timeout_s=cfg.idle_tick_s,
+                    body_timeout_s=cfg.frame_timeout_s)
+            except asyncio.TimeoutError:
+                # idle checkpoint: leave once draining and quiesced
+                if self._draining and not conn.inflight:
+                    return
+                continue
+            except FrameError as exc:
+                # A poisoned stream: count it, drop THIS connection, and
+                # keep the accept loop alive — one bad peer never takes
+                # the server down.
+                self._frame_error(exc.kind)
+                JOURNAL.record("rpc_frame_error", kind=exc.kind,
+                               tms_id=conn.tms_id, detail=str(exc))
+                return
+            if frame is None:
+                return  # client closed cleanly
+            ftype, body = frame
+            self._count_frame("recv", ftype)
+            if ftype == PING:
+                await conn.send(PONG, {"t": body.get("t", 0.0),
+                                       "t_srv": time.time()})
+            elif ftype == GOAWAY:
+                conn.goaway_sent = True  # client-initiated drain
+            elif ftype == SUBMIT:
+                self._accept_submit(conn, body)
+            else:
+                self._frame_error("protocol")
+
+    def _accept_submit(self, conn: _Conn, body: dict) -> None:
+        rows = int(body.get("rows", 1))
+        if rows > conn.credits:
+            self._frame_error("credit_violation")
+        conn.credits = max(0, conn.credits - rows)
+        self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
+        task = asyncio.ensure_future(self._serve_submit(conn, body))
+        conn.inflight.add(task)
+        task.add_done_callback(conn.inflight.discard)
+
+    async def _serve_submit(self, conn: _Conn, body: dict) -> None:
+        req_id = body.get("req_id")
+        kind = body.get("kind", "range")
+        lane = body.get("lane", LANE_BULK)
+        tms_id = str(body.get("tms_id", conn.tms_id))
+        reply: dict = {"req_id": req_id, "status": RPC_OK}
+        deadline = body.get("deadline")
+        deadline_s = None
+        if deadline is not None:
+            deadline_s = float(deadline) - time.time()
+            if deadline_s <= 0:
+                self.provider.counter("rpc_deadline_expired_total").add()
+                reply["status"] = RPC_EXPIRED
+                reply["error"] = (
+                    f"deadline passed {-deadline_s * 1000:.1f}ms before "
+                    "decode")
+        if reply["status"] == RPC_OK and (self._draining or conn.goaway_sent):
+            reply["status"] = RPC_GOAWAY
+            reply["error"] = "server draining"
+        if reply["status"] == RPC_OK:
+            self.provider.counter("rpc_requests_total", tms=tms_id,
+                                  kind=kind, lane=lane).add()
+            try:
+                await self._verify_into(reply, kind, lane, deadline_s, body)
+            except Exception as exc:  # service-level failure -> typed error
+                reply["status"] = RPC_ERROR
+                reply["error"] = str(exc)
+                reply["error_type"] = type(exc).__name__
+        try:
+            await conn.send(RESULT, reply)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return  # peer gone; its redial will resubmit
+        await self._replenish(conn)
+
+    async def _verify_into(self, reply: dict, kind: str, lane: str,
+                           deadline_s: float | None, body: dict) -> None:
+        svc = self.service
+        with self.tracer.span("rpc.serve", kind=kind, lane=lane):
+            if kind == "range":
+                proofs, coms = body["payload"]
+                results = await asyncio.gather(*[
+                    svc.submit_range(p, c, deadline_s=deadline_s, lane=lane)
+                    for p, c in zip(proofs, coms)])
+                reply["statuses"] = [r.status for r in results]
+                reply["verdicts"] = [r.accepted for r in results]
+                reply["served_by"] = sorted(
+                    {r.served_by for r in results if r.served_by})
+            elif kind == "block":
+                transfers, issues = body["payload"]
+                t_res, i_res = await asyncio.gather(
+                    asyncio.gather(*[
+                        svc.submit_transfer(pr, ins, outs,
+                                            deadline_s=deadline_s, lane=lane)
+                        for pr, ins, outs in transfers]),
+                    asyncio.gather(*[
+                        svc.submit_issue(pr, outs, deadline_s=deadline_s,
+                                         lane=lane)
+                        for pr, outs in issues]))
+                reply["statuses"] = ([r.status for r in t_res],
+                                     [r.status for r in i_res])
+                reply["verdicts"] = ([r.accepted for r in t_res],
+                                     [r.accepted for r in i_res])
+                reply["served_by"] = sorted(
+                    {r.served_by for r in (*t_res, *i_res) if r.served_by})
+            else:
+                raise ValueError(f"unknown submit kind {kind!r}")
+
+    async def _close_conn(self, conn: _Conn) -> None:
+        if conn.closing:
+            return
+        conn.closing = True
+        if conn.frames_started != conn.frames_done:
+            # a write was abandoned between header and drain — the one
+            # invariant the draining stop exists to prevent
+            self.midframe_closes += 1
+            self._frame_error("midframe_close")
+        try:
+            conn.writer.close()
+            await asyncio.wait_for(conn.writer.wait_closed(), 5.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass

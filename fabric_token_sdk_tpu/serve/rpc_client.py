@@ -1,0 +1,498 @@
+"""Reconnecting TCP client for the RPC sidecar (``serve/rpc.py``).
+
+Matches the ``WorkerClient`` duck-type — ``_range.verify``,
+``verify_block``, ``prewarm_shapes``, ``wait_ready``, ``stop``, ``pp``
+— so a ``VerificationService`` (or the crash bench) can point at a
+network sidecar instead of a pipe worker without changing anything
+else. Every transport failure surfaces as
+``WorkerUnavailable(TransientError)``, so traffic degrades onto the
+existing retry→breaker→watchdog→``HostFallbackVerifier`` ladder while
+the ``Supervisor`` respawns the sidecar process.
+
+Mechanics:
+
+  - **Reconnect under crash**: dialing rides
+    ``resilience.RetryPolicy`` — decorrelated-jitter redial with a
+    bounded attempt ladder, counted by ``rpc_redials_total{outcome}``.
+    A dead or GOAWAY'd connection is replaced on the next call.
+  - **Pipelined, not single-flight**: a background reader thread
+    demultiplexes RESULT frames to per-request slots by ``req_id``, so
+    concurrent callers share one connection without serializing behind
+    one slow reply (the pipe ``WorkerClient`` is single-flight; see
+    its ``_call`` docstring).
+  - **Credit flow control**: SUBMITs spend row credits granted by the
+    server (WELCOME + CREDIT frames). When the sidecar's lanes fill,
+    credits dry up and callers stall here — counted by
+    ``rpc_credit_waits_total`` — instead of stuffing the socket.
+  - **Deadline propagation**: the HELLO/WELCOME (and PING/PONG)
+    exchange measures RTT and a clock offset; each SUBMIT carries an
+    absolute server-clock deadline of ``now + budget - RTT/2``, so the
+    server sheds already-expired work at decode.
+  - **Hedged sends** (optional): with ``hedge_after_s`` set,
+    interactive-lane calls that wait longer than the hedge threshold
+    send a duplicate SUBMIT under a fresh req_id; first reply wins
+    (verdicts are deterministic, so duplicates are parity-safe).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
+from ..resilience import RetryPolicy
+from .config import LANE_BULK, LANE_INTERACTIVE
+from .rpc import (CREDIT, DEFAULT_MAX_FRAME, FRAME_NAMES, GOAWAY, HELLO,
+                  PING, PONG, RESULT, RPC_OK, SUBMIT, WELCOME, FrameError,
+                  _describe, recv_frame_sock, send_frame_sock)
+from .worker import _REMOTE_TRANSIENT_NAMES, WorkerUnavailable
+
+
+class _Slot:
+    """One pending request: first RESULT (of possibly hedged pair) wins."""
+
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+
+    def resolve(self, body: dict) -> None:
+        if self.reply is None:
+            self.reply = body
+        self.event.set()
+
+
+class _RpcRange:
+    """``zk._range.verify`` facade over the wire."""
+
+    def __init__(self, client: "RpcClient"):
+        self._client = client
+
+    def verify(self, proofs, coms):
+        return self._client.submit_range(proofs, coms)
+
+
+class RpcClient:
+    """Reconnecting, pipelined client for one RPC sidecar address."""
+
+    def __init__(self, address, *, pp=None, tms_id: str = "default",
+                 call_timeout_s: float = 120.0,
+                 connect_timeout_s: float = 5.0,
+                 tick_s: float = 0.25,
+                 frame_timeout_s: float = 30.0,
+                 credit_wait_s: float = 30.0,
+                 hedge_after_s: float | None = None,
+                 redial_attempts: int = 4,
+                 redial_base_s: float = 0.05,
+                 redial_cap_s: float = 1.0,
+                 seed: int = 0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME,
+                 name: str = "rpc-client",
+                 provider=None, tracer=None):
+        self.address = (str(address[0]), int(address[1]))
+        self.pp = pp
+        self.tms_id = tms_id
+        self.call_timeout_s = call_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.tick_s = tick_s
+        self.frame_timeout_s = frame_timeout_s
+        self.credit_wait_s = credit_wait_s
+        self.hedge_after_s = hedge_after_s
+        self.max_frame_bytes = max_frame_bytes
+        self.name = name
+        self.provider = provider or _METRICS
+        self.tracer = tracer or _TRACER
+        _describe(self.provider)
+        self._redial = RetryPolicy(
+            max_attempts=redial_attempts, base_s=redial_base_s,
+            cap_s=redial_cap_s, seed=seed, op=f"rpc_dial_{name}")
+        self._range = _RpcRange(self)
+        self._dial_lock = threading.Lock()   # one redial ladder at a time
+        self._send_lock = threading.Lock()   # frame writes are atomic
+        self._cv = threading.Condition()     # credits + pending + liveness
+        self._pending: dict[int, _Slot] = {}
+        self._pong_waiters: list[threading.Event] = []
+        self._req_ids = itertools.count(1)
+        self._sock = None
+        self._reader: threading.Thread | None = None
+        self._gen = 0                        # invalidates stale readers
+        self._dead = True
+        self._goaway = False
+        self._closed = False
+        self._credits = 0
+        self.rtt_s = 0.0
+        self.clock_offset_s = 0.0            # server clock minus ours
+
+    # ----------------------------------------------------------- transport
+    def _dial(self) -> None:
+        """One connect + HELLO/WELCOME handshake (RTT + clock offset)."""
+        self._gen += 1
+        gen = self._gen
+        old = self._sock
+        self._sock = None
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        sock = socket.create_connection(
+            self.address, timeout=self.connect_timeout_s)
+        try:
+            sock.settimeout(self.tick_s)
+            t0 = time.time()
+            send_frame_sock(sock, HELLO,
+                            {"tms_id": self.tms_id, "t": t0, "v": 1},
+                            self.max_frame_bytes)
+            deadline = time.monotonic() + self.connect_timeout_s
+            while True:
+                if time.monotonic() >= deadline:
+                    raise FrameError("slow_frame", "WELCOME never arrived")
+                try:
+                    frame = recv_frame_sock(
+                        sock, max_frame_bytes=self.max_frame_bytes,
+                        body_timeout_s=self.connect_timeout_s)
+                except TimeoutError:
+                    continue
+                break
+            if frame is None or frame[0] != WELCOME:
+                raise FrameError("protocol", "expected WELCOME")
+        except BaseException:
+            sock.close()
+            raise
+        welcome = frame[1]
+        t1 = time.time()
+        self.rtt_s = max(0.0, t1 - t0)
+        self.clock_offset_s = welcome.get("t_srv", t1) - (
+            t0 + self.rtt_s / 2.0)
+        with self._cv:
+            self._sock = sock
+            self._dead = False
+            self._goaway = False
+            self._credits = int(welcome.get("credits", 0))
+            self._cv.notify_all()
+        self._count_frame("sent", HELLO)
+        self._count_frame("recv", WELCOME)
+        reader = threading.Thread(
+            target=self._read_loop, args=(sock, gen),
+            name=f"{self.name}-reader", daemon=True)
+        self._reader = reader
+        reader.start()
+
+    def _ensure_conn(self) -> None:
+        """Redial ladder (decorrelated jitter) until connected or out of
+        attempts; raises ``WorkerUnavailable`` so the resilience ladder
+        takes over."""
+        with self._dial_lock:
+            if self._closed:
+                raise WorkerUnavailable(f"{self.name} is closed")
+            if self._sock is not None and not self._dead \
+                    and not self._goaway:
+                return
+            last: Exception | None = None
+            delays = self._redial.delays()
+            for attempt in range(self._redial.max_attempts):
+                if attempt:
+                    self._redial.pause(next(delays))
+                try:
+                    self._dial()
+                    self.provider.counter(
+                        "rpc_redials_total", outcome="ok").add()
+                    return
+                except (OSError, ConnectionError, TimeoutError,
+                        FrameError) as exc:
+                    last = exc
+                    self.provider.counter(
+                        "rpc_redials_total", outcome="error").add()
+            raise WorkerUnavailable(
+                f"rpc dial {self.address[0]}:{self.address[1]} failed "
+                f"after {self._redial.max_attempts} attempts: {last!r}")
+
+    def _conn_lost(self, gen: int, why: str) -> None:
+        """Fail every pending call on this generation — callers raise
+        ``WorkerUnavailable`` and the parent ladder retries/falls back."""
+        with self._cv:
+            if gen != self._gen and not self._closed:
+                return  # a newer dial already superseded this conn
+            self._dead = True
+            pending, self._pending = self._pending, {}
+            self._cv.notify_all()
+        for slot in pending.values():
+            slot.resolve({"status": "transport", "error": why})
+
+    def _read_loop(self, sock, gen: int) -> None:
+        while not self._closed and gen == self._gen:
+            try:
+                frame = recv_frame_sock(
+                    sock, max_frame_bytes=self.max_frame_bytes,
+                    body_timeout_s=self.frame_timeout_s)
+            except TimeoutError:
+                continue  # idle tick: re-check stop/generation flags
+            except (FrameError, OSError, ConnectionError) as exc:
+                if isinstance(exc, FrameError):
+                    self.provider.counter(
+                        "rpc_frame_errors_total", kind=exc.kind).add()
+                self._conn_lost(gen, repr(exc))
+                return
+            if frame is None:
+                self._conn_lost(gen, "server closed connection")
+                return
+            ftype, body = frame
+            self._count_frame("recv", ftype)
+            if ftype == RESULT:
+                with self._cv:
+                    slot = self._pending.pop(body.get("req_id"), None)
+                if slot is not None:
+                    slot.resolve(body)
+            elif ftype == CREDIT:
+                with self._cv:
+                    self._credits += int(body.get("grant", 0))
+                    self._cv.notify_all()
+            elif ftype == GOAWAY:
+                self.provider.counter(
+                    "rpc_goaways_total", role="client").add()
+                with self._cv:
+                    self._goaway = True
+                    self._cv.notify_all()
+            elif ftype == PONG:
+                t0 = body.get("t")
+                if isinstance(t0, float):
+                    t1 = time.time()
+                    self.rtt_s = max(0.0, t1 - t0)
+                    self.clock_offset_s = body.get("t_srv", t1) - (
+                        t0 + self.rtt_s / 2.0)
+                with self._cv:
+                    waiters, self._pong_waiters = self._pong_waiters, []
+                for ev in waiters:
+                    ev.set()
+
+    def _count_frame(self, direction: str, ftype: int) -> None:
+        self.provider.counter(
+            "rpc_frames_total", role="client", dir=direction,
+            type=FRAME_NAMES.get(ftype, str(ftype))).add()
+
+    # ------------------------------------------------------------- credits
+    def _acquire_credits(self, rows: int, deadline_mono: float) -> None:
+        cap = time.monotonic() + self.credit_wait_s
+        credit_deadline = min(deadline_mono, cap)
+        with self._cv:
+            if self._credits >= rows:
+                self._credits -= rows
+                return
+            self.provider.counter("rpc_credit_waits_total").add()
+            while True:
+                remaining = credit_deadline - time.monotonic()
+                if remaining <= 0:
+                    raise WorkerUnavailable(
+                        f"rpc backpressure: {rows} credits not granted "
+                        f"within budget (held {self._credits})")
+                self._cv.wait(timeout=min(remaining, self.tick_s))
+                if self._dead or self._goaway or self._closed:
+                    raise WorkerUnavailable(
+                        "connection lost while awaiting credits")
+                if self._credits >= rows:
+                    self._credits -= rows
+                    return
+
+    def _try_acquire_credits(self, rows: int) -> bool:
+        with self._cv:
+            if self._credits >= rows:
+                self._credits -= rows
+                return True
+            return False
+
+    # ---------------------------------------------------------------- call
+    def _wire_deadline(self, budget_s: float) -> float:
+        """Absolute server-clock deadline: now + budget - RTT/2."""
+        return time.time() + budget_s - self.rtt_s / 2.0 \
+            + self.clock_offset_s
+
+    def _send_submit(self, body: dict) -> None:
+        with self._cv:
+            sock = self._sock
+            dead = self._dead
+        if sock is None or dead:
+            raise WorkerUnavailable("rpc connection lost before send")
+        try:
+            with self._send_lock:
+                send_frame_sock(sock, SUBMIT, body, self.max_frame_bytes)
+        except (OSError, ConnectionError, FrameError) as exc:
+            self._conn_lost(self._gen, repr(exc))
+            raise WorkerUnavailable(f"rpc send failed: {exc!r}") from exc
+        self._count_frame("sent", SUBMIT)
+
+    def _call(self, kind: str, payload, rows: int, *,
+              lane: str = LANE_BULK, deadline_s: float | None = None):
+        budget = deadline_s if deadline_s is not None else self.call_timeout_s
+        t_start = time.perf_counter()
+        with self.tracer.span("rpc.call", kind=kind, rows=rows, lane=lane):
+            try:
+                return self._call_once(kind, payload, rows, lane, budget)
+            finally:
+                self.provider.histogram(
+                    "rpc_call_seconds", kind=kind).observe(
+                        time.perf_counter() - t_start)
+
+    def _call_once(self, kind, payload, rows, lane, budget):
+        self._ensure_conn()
+        deadline_mono = time.monotonic() + budget
+        self._acquire_credits(rows, deadline_mono)
+        slot = _Slot()
+        req_id = next(self._req_ids)
+        body = {"req_id": req_id, "kind": kind, "lane": lane,
+                "tms_id": self.tms_id, "rows": rows,
+                "deadline": self._wire_deadline(budget),
+                "payload": payload}
+        hedge_id = None
+        with self._cv:
+            self._pending[req_id] = slot
+        try:
+            self._send_submit(body)
+            hedge = (self.hedge_after_s is not None
+                     and lane == LANE_INTERACTIVE)
+            if hedge:
+                first_wait = min(self.hedge_after_s,
+                                 deadline_mono - time.monotonic())
+                if not slot.event.wait(timeout=max(0.0, first_wait)) \
+                        and self._try_acquire_credits(rows):
+                    hedge_id = next(self._req_ids)
+                    with self._cv:
+                        self._pending[hedge_id] = slot
+                    self.provider.counter("rpc_hedges_total").add()
+                    self._send_submit(dict(body, req_id=hedge_id))
+            remaining = deadline_mono - time.monotonic()
+            if not slot.event.wait(timeout=max(0.0, remaining)):
+                raise WorkerUnavailable(
+                    f"rpc {kind} call timed out after {budget:.3f}s")
+        finally:
+            with self._cv:
+                self._pending.pop(req_id, None)
+                if hedge_id is not None:
+                    self._pending.pop(hedge_id, None)
+        return self._classify(kind, slot.reply)
+
+    def _classify(self, kind: str, reply: dict):
+        status = reply.get("status")
+        if status == RPC_OK:
+            return self._unpack(kind, reply)
+        error = reply.get("error", "")
+        if status == "error":
+            # same split the pipe WorkerClient applies to remote errors
+            type_name = reply.get("error_type", "")
+            if type_name in _REMOTE_TRANSIENT_NAMES \
+                    or type_name.endswith("TransientError"):
+                raise WorkerUnavailable(
+                    f"sidecar error ({type_name}): {error}")
+            raise RuntimeError(f"sidecar {type_name}: {error}")
+        # expired / goaway / transport — all transient by construction
+        raise WorkerUnavailable(f"rpc {kind} {status}: {error}")
+
+    def _unpack(self, kind: str, reply: dict):
+        if kind == "range":
+            verdicts = reply["verdicts"]
+            if any(v is None for v in verdicts):
+                raise WorkerUnavailable(
+                    "sidecar shed rows: "
+                    f"{sorted(set(reply['statuses']))}")
+            return np.asarray(verdicts, dtype=bool)
+        t_v, i_v = reply["verdicts"]
+        if any(v is None for v in t_v) or any(v is None for v in i_v):
+            t_st, i_st = reply["statuses"]
+            raise WorkerUnavailable(
+                f"sidecar shed rows: {sorted(set(t_st) | set(i_st))}")
+        return (np.asarray(t_v, dtype=bool), np.asarray(i_v, dtype=bool))
+
+    # ------------------------------------------------------- zk duck-type
+    def submit_range(self, proofs, coms, *, lane: str = LANE_BULK,
+                     deadline_s: float | None = None):
+        proofs = list(proofs)
+        coms = list(coms)
+        return self._call("range", (proofs, coms), len(proofs),
+                          lane=lane, deadline_s=deadline_s)
+
+    def verify_block(self, transfers, issues, *, lane: str = LANE_BULK,
+                     deadline_s: float | None = None):
+        transfers = [tuple(t) for t in transfers]
+        issues = [tuple(i) for i in issues]
+        rows = max(1, len(transfers) + len(issues))
+        return self._call("block", (transfers, issues), rows,
+                          lane=lane, deadline_s=deadline_s)
+
+    def prewarm_shapes(self, buckets, include_block: bool = False):
+        """The sidecar prewarms its own shapes at boot; here this is a
+        readiness gate: one ping round-trip per call."""
+        self.wait_ready(timeout_s=self.call_timeout_s)
+        return {int(b): 0.0 for b in buckets}
+
+    # ----------------------------------------------------------- liveness
+    def ping(self, timeout_s: float = 5.0) -> bool:
+        """One PING/PONG round-trip on the current connection."""
+        ev = threading.Event()
+        with self._cv:
+            sock = self._sock
+            if sock is None or self._dead:
+                return False
+            self._pong_waiters.append(ev)
+        try:
+            with self._send_lock:
+                send_frame_sock(sock, PING, {"t": time.time()},
+                                self.max_frame_bytes)
+        except (OSError, ConnectionError, FrameError):
+            return False
+        self._count_frame("sent", PING)
+        return ev.wait(timeout=timeout_s)
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        """Block until a dial + ping round-trip succeeds."""
+        deadline = time.monotonic() + timeout_s
+        last = "never attempted"
+        while time.monotonic() < deadline:
+            try:
+                self._ensure_conn()
+                if self.ping(timeout_s=min(
+                        5.0, max(0.1, deadline - time.monotonic()))):
+                    return
+                last = "ping timed out"
+            except WorkerUnavailable as exc:
+                last = str(exc)
+            time.sleep(min(0.2, self.tick_s))
+        raise WorkerUnavailable(
+            f"rpc sidecar not ready within {timeout_s}s: {last}")
+
+    def alive(self) -> bool:
+        with self._cv:
+            return self._sock is not None and not self._dead
+
+    # -------------------------------------------------------------- close
+    def close(self) -> None:
+        self._closed = True
+        with self._cv:
+            sock = self._sock
+            self._sock = None
+            self._gen += 1
+            self._cv.notify_all()
+        if sock is not None:
+            try:
+                with self._send_lock:
+                    send_frame_sock(sock, GOAWAY, {"reason": "client close"},
+                                    self.max_frame_bytes)
+            except (OSError, ConnectionError, FrameError):
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        reader = self._reader
+        if reader is not None and reader.is_alive():
+            reader.join(timeout=2 * self.tick_s)
+        self._conn_lost(self._gen, "client closed")
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """``WorkerClient.stop`` duck-type alias."""
+        del timeout_s
+        self.close()

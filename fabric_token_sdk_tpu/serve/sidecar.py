@@ -1,0 +1,217 @@
+"""Supervised TCP sidecar process: a whole serving plane per child.
+
+Where ``worker_main`` moves only the blocking verify call into a child,
+``sidecar_main`` moves the *entire* front door — WAL, admission,
+scheduler, dispatch, resilience ladder and the asyncio ``RpcServer`` —
+into one supervised process that N clients (node processes, bench
+drivers) dial over TCP. The parent-side :class:`RpcSidecar` facade is
+``ChildSpec.start``-compatible, so the existing ``Supervisor`` kill
+ladder, heartbeat-stall detection and cold-restart escalation apply
+unchanged:
+
+  - phase-stamped heartbeats (``boot -> prewarm -> ready``) from a
+    daemon thread, same contract as the pipe worker: SIGSTOP shows as
+    a stall, SIGKILL as an exit;
+  - WAL-backed: the child's ``VerificationService`` appends every
+    admit/resolve to a WAL under ``wal_dir``, so a respawned sidecar
+    replays admitted-but-unresolved requests before accepting new
+    traffic — a killed sidecar loses no acknowledged work;
+  - a fixed port chosen once at facade construction (SO_REUSEADDR), so
+    clients redial the same address across respawns.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import multiprocessing as mp
+import os
+import signal
+import socket
+import threading
+from dataclasses import replace
+
+from ..obs.heartbeat import Heartbeat, read_last
+from .config import ServeConfig
+from .rpc import RpcConfig, RpcServer
+from .wal import WriteAheadLog
+from .worker import PHASE_BOOT, PHASE_PREWARM, PHASE_READY
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """Ephemeral port reserved long enough to hand to a child.
+
+    SO_REUSEADDR on both ends makes the immediate rebind race-free in
+    practice for a single-host harness.
+    """
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def sidecar_main(factory, host: str, port: int, *,
+                 heartbeat_path=None, wal_dir=None,
+                 buckets=(64,), prewarm: bool = True,
+                 include_block: bool = False,
+                 max_wait_s: float = 0.005,
+                 default_deadline_s: float = 30.0,
+                 resilience=None,
+                 rpc: RpcConfig | None = None,
+                 beat_interval_s: float = 0.25) -> None:
+    """Child entry point (spawn context: every arg must pickle).
+
+    Builds the ZK backend from ``factory``, stands up a WAL-backed
+    ``VerificationService`` (recovering + replaying any WAL left by a
+    killed predecessor), prewarms, then serves TCP until SIGTERM/SIGINT
+    — at which point it drains: GOAWAY to every client, in-flight
+    frames finish, service drains, WAL closes.
+    """
+    from .service import VerificationService  # deferred: heavy import
+
+    hb = Heartbeat(heartbeat_path)
+    phase = {"now": PHASE_BOOT}
+    stop_beats = threading.Event()
+
+    def _beater():
+        # same contract as worker_main: SIGSTOP freezes the beats
+        # (stall), a wedged dispatch does not
+        while not stop_beats.wait(beat_interval_s):
+            hb.beat(phase["now"])
+
+    hb.beat(PHASE_BOOT)
+    threading.Thread(target=_beater, name="fts-sidecar-beat",
+                     daemon=True).start()
+
+    zk = factory()
+    config = ServeConfig(buckets=tuple(buckets), max_wait_s=max_wait_s,
+                         default_deadline_s=default_deadline_s,
+                         prewarm_block=include_block)
+    wal = None
+    if wal_dir is not None:
+        wal = WriteAheadLog(wal_dir)
+    service = VerificationService(zk, config, resilience=resilience,
+                                  wal=wal)
+    rpc_config = replace(rpc or RpcConfig(), host=host, port=port)
+
+    async def _amain():
+        loop = asyncio.get_running_loop()
+        stop_ev = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_ev.set)
+        if prewarm:
+            phase["now"] = PHASE_PREWARM
+            hb.beat(PHASE_PREWARM)
+        await service.start(prewarm=prewarm)
+        server = RpcServer(service, rpc_config)
+        await server.start()
+        phase["now"] = PHASE_READY
+        hb.beat(PHASE_READY)
+        await stop_ev.wait()
+        await server.stop(drain=True)
+        await service.stop(drain=True, timeout_s=rpc_config.drain_timeout_s)
+
+    try:
+        asyncio.run(_amain())
+    finally:
+        stop_beats.set()
+        if wal is not None:
+            wal.close()
+        hb.close()
+
+
+class RpcSidecar:
+    """Parent-side facade: spawn/stop/pid/phase, ChildSpec-compatible.
+
+    ``spawn`` is a valid ``ChildSpec.start`` callable (takes an
+    optional ``RestartContext``); ``address`` is fixed for the facade's
+    lifetime so clients redial the same endpoint across respawns.
+    """
+
+    def __init__(self, factory, *, host: str = "127.0.0.1",
+                 port: int | None = None, heartbeat_path=None,
+                 wal_dir=None, buckets=(64,), prewarm: bool = True,
+                 include_block: bool = False, max_wait_s: float = 0.005,
+                 default_deadline_s: float = 30.0, resilience=None,
+                 rpc: RpcConfig | None = None,
+                 name: str = "rpc-sidecar", mp_context: str = "spawn"):
+        self.factory = factory
+        self.host = host
+        self.port = port if port is not None else pick_free_port(host)
+        self.address = (self.host, self.port)
+        self.heartbeat_path = heartbeat_path
+        self.wal_dir = wal_dir
+        self.buckets = tuple(buckets)
+        self.prewarm = prewarm
+        self.include_block = include_block
+        self.max_wait_s = max_wait_s
+        self.default_deadline_s = default_deadline_s
+        self.resilience = resilience
+        self.rpc = rpc
+        self.name = name
+        self._ctx = mp.get_context(mp_context)
+        self._proc = None
+
+    # --------------------------------------------------------- lifecycle
+    def spawn(self, ctx=None):
+        """Spawn a fresh sidecar (``ctx`` is an optional
+        RestartContext; cold-cache env is the supervisor's job)."""
+        proc = self._ctx.Process(
+            target=sidecar_main,
+            args=(self.factory, self.host, self.port),
+            kwargs={
+                "heartbeat_path": self.heartbeat_path,
+                "wal_dir": self.wal_dir,
+                "buckets": self.buckets,
+                "prewarm": self.prewarm,
+                "include_block": self.include_block,
+                "max_wait_s": self.max_wait_s,
+                "default_deadline_s": self.default_deadline_s,
+                "resilience": self.resilience,
+                "rpc": self.rpc,
+            },
+            name=self.name, daemon=True)
+        proc.start()
+        self._proc = proc
+        return proc
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        proc = self._proc
+        self._proc = None
+        if proc is None or not proc.is_alive():
+            return
+        proc.terminate()  # SIGTERM -> child drains (GOAWAY, WAL close)
+        proc.join(timeout=timeout_s)
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=timeout_s)
+
+    # ------------------------------------------------------------- state
+    @property
+    def pid(self) -> int | None:
+        proc = self._proc
+        return proc.pid if proc is not None and proc.is_alive() else None
+
+    def alive(self) -> bool:
+        proc = self._proc
+        return proc is not None and proc.is_alive()
+
+    def phase(self) -> str | None:
+        """Heartbeat phase of the CURRENT sidecar pid (None before its
+        first beat)."""
+        if self.heartbeat_path is None:
+            return PHASE_READY if self.alive() else None
+        stamp = read_last(self.heartbeat_path)
+        if stamp is None or stamp.get("pid") != self.pid:
+            return None
+        return stamp.get("phase")
+
+
+def stale_heartbeat_guard(path) -> None:
+    """Remove a previous incarnation's heartbeat file so the supervisor
+    never reads a dead pid's last beat as fresh liveness."""
+    if path is None:
+        return
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass

@@ -237,6 +237,23 @@ class WriteAheadLog:
     def open_count(self) -> int:
         return len(self._open_ids)
 
+    def summary(self) -> dict:
+        """``/statusz`` payload: segment + open-request accounting."""
+        segments = self._segment_paths()
+        return {
+            "directory": self.directory,
+            "segments": len(segments),
+            "segment_seq": self._segment_seq,
+            "segment_records": self._segment_records,
+            "segment_bytes": self._segment_bytes,
+            "open_requests": len(self._open_ids),
+            "next_id": self._next_id,
+            "recovered": self._recovered,
+            "recovered_entries": len(self.recovered_entries),
+            "torn_records": self.torn_records,
+            "fsync": self.config.fsync,
+        }
+
     # ------------------------------------------------------------ recovery
     def _scan(self, paths: list[str]):
         """(ordered admit records, resolved ids, torn count, max id)."""

@@ -38,8 +38,14 @@ import time
 
 import numpy as np
 
+from ..obs import GLOBAL as _METRICS
 from ..obs.heartbeat import Heartbeat, read_last
 from ..resilience.retry import TransientError
+
+#: Hard cap on an unconfigured reply wait — "no call timeout" must
+#: still mean a *bounded* wait, or a wedged worker hangs the caller
+#: with no diagnosis (see scripts/check_socket_timeouts.py).
+_MAX_REPLY_WAIT_S = 3600.0
 
 #: Worker heartbeat phases, in boot order.
 PHASE_BOOT = "boot"
@@ -95,6 +101,9 @@ def worker_main(conn, factory, heartbeat_path=None, prewarm_buckets=(),
     hb.beat(PHASE_READY)
     while True:
         try:
+            # child idle wait: parent closing the pipe raises EOFError,
+            # and the supervisor's kill ladder bounds a wedged child
+            # io-deadline: bounded from outside (pipe EOF / supervisor)
             msg = conn.recv()
         except (EOFError, OSError):
             break
@@ -243,6 +252,7 @@ class WorkerClient:
                 conn.send(("ping",))
                 while time.monotonic() < deadline:
                     if conn.poll(0.2):
+                        # io-deadline: poll above bounds it
                         tag, payload = conn.recv()
                         if tag == "ok":
                             return payload
@@ -261,20 +271,39 @@ class WorkerClient:
 
     # -------------------------------------------------------------- calls
     def _call(self, op: str, *args):
+        """One pipe round-trip. SINGLE-FLIGHT by design: ``_io_lock``
+        is held across the full send/poll/recv pairing because the pipe
+        is one stream with no request ids — interleaved sends would
+        cross-deliver replies. Concurrent callers therefore serialize
+        behind the slowest in-flight call; ``serve_worker_lock_wait_seconds``
+        measures that queueing so it is visible, and the TCP
+        ``RpcClient`` (serve/rpc_client.py) is the pipelined alternative
+        when it matters."""
         with self._state_lock:
             conn, proc = self._conn, self._proc
         if conn is None or proc is None or not proc.is_alive():
             raise WorkerUnavailable(
                 f"{self.name}: worker process is not running")
+        t_lock = time.perf_counter()
         with self._io_lock:
+            _METRICS.histogram(
+                "serve_worker_lock_wait_seconds",
+                help="Time a WorkerClient call queued behind the "
+                     "single-flight pipe lock, by op",
+                op=op).observe(time.perf_counter() - t_lock)
             try:
                 conn.send((op, *args))
-                if self.call_timeout_s is not None:
-                    if not conn.poll(self.call_timeout_s):
-                        raise WorkerUnavailable(
-                            f"{self.name}: no reply to {op!r} within "
-                            f"{self.call_timeout_s}s")
-                reply = conn.recv()
+                # the reply wait is ALWAYS bounded: call_timeout_s when
+                # configured, else a generous hard cap — an unbounded
+                # recv on a wedged worker is a silent rc=124
+                timeout_s = (self.call_timeout_s
+                             if self.call_timeout_s is not None
+                             else _MAX_REPLY_WAIT_S)
+                if not conn.poll(timeout_s):
+                    raise WorkerUnavailable(
+                        f"{self.name}: no reply to {op!r} within "
+                        f"{timeout_s}s")
+                reply = conn.recv()  # io-deadline: poll above bounds it
             except WorkerUnavailable:
                 raise
             except (EOFError, BrokenPipeError, OSError,

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Lint: every blocking socket/pipe wait in the serving plane must
+carry an explicit timeout or deadline.
+
+A hung read with no deadline is how rc=124-with-no-diagnosis comes
+back: the process is alive, the stack is parked in recv, and nothing
+ever reports why. This lint walks the AST of the network-facing
+modules (``serve/``, ``resilience/``, ``obs/telemetry.py``,
+``obs/aggregate.py``) and flags two classes of unbounded wait:
+
+  1. **Sync waits** — calls to ``.poll`` / ``.wait`` / ``.join`` /
+     ``.get`` with no positional argument and no ``timeout``/
+     ``timeout_s`` kwarg. Exempt: calls under ``await`` (asyncio
+     primitives are cancellable; their deadline is the enclosing task's
+     ``wait_for`` or supervisor), and dict-style lookups (``.get``
+     with arguments is fine by construction).
+  2. **Read waits** — calls to ``.recv`` / ``.recv_bytes`` /
+     ``.accept`` / ``.readexactly`` / ``.readuntil`` with no deadline
+     source. A deadline source is either an enclosing
+     ``wait_for(...)`` call in the same expression, or an explicit
+     waiver comment ``# io-deadline: <why>`` on the call line or the
+     line above — the waiver documents which OUTER mechanism bounds
+     the wait (a poll() guard, a settimeout tick, a supervisor kill
+     ladder).
+
+Runnable standalone (``python scripts/check_socket_timeouts.py`` —
+exits 1 with the offender list) and imported by
+tests/test_socket_timeout_lint.py as a tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "fabric_token_sdk_tpu"
+
+#: Modules whose blocking waits the serving plane depends on.
+SCOPE = [
+    PKG / "serve",
+    PKG / "resilience",
+    PKG / "obs" / "telemetry.py",
+    PKG / "obs" / "aggregate.py",
+]
+
+SYNC_WAITS = {"poll", "wait", "join", "get"}
+READ_WAITS = {"recv", "recv_bytes", "recv_bytes_into", "accept",
+              "readexactly", "readuntil"}
+WAIVER = "# io-deadline:"
+
+
+def _scope_files() -> list[Path]:
+    files: list[Path] = []
+    for entry in SCOPE:
+        if entry.is_dir():
+            files.extend(sorted(entry.rglob("*.py")))
+        elif entry.exists():
+            files.append(entry)
+    return files
+
+
+def _has_timeout_arg(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return any(kw.arg in ("timeout", "timeout_s", "deadline")
+               for kw in call.keywords)
+
+
+class _Walker(ast.NodeVisitor):
+    """Tracks await- and wait_for-enclosure while collecting offenders."""
+
+    def __init__(self, waived_lines: set[int]):
+        self.waived_lines = waived_lines
+        self.offenders: list[tuple[int, str, str]] = []
+        self._await_depth = 0
+        self._wait_for_depth = 0
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._await_depth += 1
+        self.generic_visit(node)
+        self._await_depth -= 1
+
+    def _is_wait_for(self, call: ast.Call) -> bool:
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        return name == "wait_for"
+
+    def _waived(self, node: ast.Call) -> bool:
+        return node.lineno in self.waived_lines \
+            or node.lineno - 1 in self.waived_lines
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_wait_for(node):
+            self._wait_for_depth += 1
+            self.generic_visit(node)
+            self._wait_for_depth -= 1
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+            if name in SYNC_WAITS and not _has_timeout_arg(node) \
+                    and self._await_depth == 0 and not self._waived(node):
+                self.offenders.append(
+                    (node.lineno, name,
+                     "no timeout argument on blocking wait"))
+            elif name in READ_WAITS and self._wait_for_depth == 0 \
+                    and not self._waived(node):
+                self.offenders.append(
+                    (node.lineno, name,
+                     "read with no wait_for() or '# io-deadline:' waiver"))
+        self.generic_visit(node)
+
+
+def find_offenders() -> list[str]:
+    """``file:line  .name  why`` for every unbounded wait in scope."""
+    out: list[str] = []
+    for path in _scope_files():
+        text = path.read_text()
+        waived = {i + 1 for i, line in enumerate(text.splitlines())
+                  if WAIVER in line}
+        walker = _Walker(waived)
+        walker.visit(ast.parse(text, filename=str(path)))
+        rel = path.relative_to(REPO)
+        out.extend(f"{rel}:{line}  .{name}()  {why}"
+                   for line, name, why in sorted(walker.offenders))
+    return out
+
+
+def main() -> int:
+    offenders = find_offenders()
+    if not offenders:
+        print("check_socket_timeouts: every blocking socket/pipe wait "
+              "in scope carries a timeout or documented deadline")
+        return 0
+    print("unbounded blocking waits (add a timeout, wrap in wait_for(), "
+          "or waive with '# io-deadline: <why>'):")
+    for line in offenders:
+        print(f"  {line}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,21 @@ STABLE_FAMILIES = (
     "serve_results_total",
     "serve_shed_total",
     "serve_wait_seconds",
+    # serve/ network front door (RPC sidecar)
+    "rpc_call_seconds",
+    "rpc_connections_active",
+    "rpc_connections_total",
+    "rpc_credit_waits_total",
+    "rpc_credits",
+    "rpc_deadline_expired_total",
+    "rpc_frame_errors_total",
+    "rpc_frames_total",
+    "rpc_goaways_total",
+    "rpc_hedges_total",
+    "rpc_redials_total",
+    "rpc_requests_total",
+    # serve/ pipe worker single-flight contention
+    "serve_worker_lock_wait_seconds",
     # serve/ write-ahead log
     "wal_appends_total",
     "wal_bytes_written_total",
@@ -162,7 +177,8 @@ def test_no_duplicate_family_entries():
                                     "pipeline_", "selector_", "serve_",
                                     "txgen_", "resil_", "telemetry_",
                                     "slo_", "profile_", "journal_",
-                                    "hb_", "fleet_", "wal_", "crash_"])
+                                    "hb_", "fleet_", "wal_", "crash_",
+                                    "rpc_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

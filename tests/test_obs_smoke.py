@@ -390,3 +390,97 @@ def test_wal_and_crash_families_export(tmp_path):
         assert fam in text, f"crash family silent: {fam}"
     assert "# TYPE wal_open_requests gauge" in text
     assert "# TYPE crash_rto_seconds histogram" in text
+
+
+# network front door families (PR: rpc sidecar) — stable interface; the
+# protocol behaviour itself is covered crypto-free in tests/test_rpc.py
+EXPECTED_RPC_FAMILIES = (
+    "rpc_connections_total",
+    "rpc_connections_active",
+    "rpc_frames_total",
+    "rpc_frame_errors_total",
+    "rpc_requests_total",
+    "rpc_credits",
+    "rpc_credit_waits_total",
+    "rpc_redials_total",
+    "rpc_goaways_total",
+    "rpc_deadline_expired_total",
+    "rpc_call_seconds",
+    "rpc_hedges_total",
+)
+
+
+def test_rpc_families_export():
+    """One server lifetime lights every rpc_* family: a round-trip, a
+    hedged interactive call, a poisoned frame, an expired deadline, a
+    credit stall, and a draining GOAWAY stop."""
+    import asyncio
+    import socket
+    import threading
+    import time
+
+    from fabric_token_sdk_tpu.serve import (RpcClient, RpcConfig, RpcServer,
+                                            ServeConfig, StubZK,
+                                            VerificationService,
+                                            WorkerUnavailable)
+    from fabric_token_sdk_tpu.serve.config import LANE_INTERACTIVE
+
+    GLOBAL.reset()
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(30.0)
+
+    async def boot():
+        svc = VerificationService(
+            StubZK(), ServeConfig(buckets=(8,), max_wait_s=0.002))
+        await svc.start(prewarm=False)
+        server = RpcServer(svc, RpcConfig(conn_credits=2))
+        addr = await server.start()
+        return svc, server, addr
+
+    svc, server, addr = run(boot())
+    cli = RpcClient(addr, call_timeout_s=20.0, credit_wait_s=0.2,
+                    hedge_after_s=0.0)
+    try:
+        assert cli.submit_range([True], [None]).tolist() == [True]
+        cli.submit_range([True], [None], lane=LANE_INTERACTIVE)  # hedges
+
+        try:  # 5 rows > 2-credit grant: counted stall, then shed
+            cli.submit_range([True] * 5, [None] * 5)
+        except WorkerUnavailable:
+            pass
+
+        cli.clock_offset_s = -30.0  # skew the wire deadline into the past
+        try:
+            cli.submit_range([True], [None], deadline_s=5.0)
+        except WorkerUnavailable:
+            pass
+        cli.clock_offset_s = 0.0
+
+        poison = socket.create_connection(addr, timeout=5.0)
+        poison.sendall(b"\x00" * 12)  # bad magic
+        poison.close()
+        deadline = time.monotonic() + 5.0
+        while not any(name == "rpc_frame_errors_total"
+                      for (name, _), _ in GLOBAL.snapshot().items()):
+            assert time.monotonic() < deadline, "frame error never counted"
+            time.sleep(0.01)
+
+        run(server.stop(drain=True))  # GOAWAY both roles
+        run(svc.stop(drain=True))
+        assert server.frames_clean
+    finally:
+        cli.close()
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5.0)
+        loop.close()
+
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_RPC_FAMILIES:
+        assert fam in text, f"rpc family silent: {fam}"
+    assert "# TYPE rpc_connections_active gauge" in text
+    assert "# TYPE rpc_call_seconds histogram" in text
+    assert "# HELP rpc_frame_errors_total" in text

@@ -1,0 +1,377 @@
+"""Network front door (serve/rpc.py + serve/rpc_client.py): frame
+codec adversity, credit backpressure, deadline shedding, draining
+GOAWAY stop, and reconnect-after-restart.
+
+Everything runs crypto-free on :class:`StubZK` so this is tier-1: the
+server + ``VerificationService`` live on a background-thread event
+loop, the real ``RpcClient`` dials it over loopback TCP, and the
+adversity cases speak raw bytes on plain sockets.
+
+The invariant under test throughout: a poisoned stream is a *counted*
+``rpc_frame_errors_total{kind}`` increment and the loss of that one
+connection — never a hang, and never the accept loop.
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL
+from fabric_token_sdk_tpu.serve import (RpcClient, RpcConfig, RpcServer,
+                                        ServeConfig, StubZK,
+                                        VerificationService,
+                                        WorkerUnavailable)
+from fabric_token_sdk_tpu.serve.config import LANE_INTERACTIVE
+from fabric_token_sdk_tpu.serve.rpc import (HELLO, MAGIC, PING, WELCOME,
+                                            recv_frame_sock, send_frame_sock)
+
+_HEADER = struct.Struct(">BBHII")
+
+
+# ------------------------------------------------------------- harness
+class _Harness:
+    """Service + RpcServer on a background-thread event loop."""
+
+    def __init__(self, serve_cfg=None, rpc_cfg=None):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self.loop.run_forever, name="rpc-test-loop", daemon=True)
+        self._thread.start()
+        serve_cfg = serve_cfg or ServeConfig(buckets=(8,), max_wait_s=0.002)
+
+        async def _boot():
+            svc = VerificationService(StubZK(), serve_cfg)
+            await svc.start(prewarm=False)
+            server = RpcServer(svc, rpc_cfg)
+            addr = await server.start()
+            return svc, server, addr
+
+        self.svc, self.server, self.address = self.run(_boot())
+        self._stopped = False
+
+    def run(self, coro, timeout=30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop) \
+            .result(timeout)
+
+    def stop_server(self):
+        self.run(self.server.stop(drain=True))
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+
+        async def _down():
+            await self.server.stop(drain=True)
+            await self.svc.stop(drain=True)
+
+        self.run(_down())
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5.0)
+        self.loop.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _client(addr, **kw):
+    kw.setdefault("redial_attempts", 2)
+    kw.setdefault("redial_base_s", 0.01)
+    kw.setdefault("redial_cap_s", 0.05)
+    kw.setdefault("call_timeout_s", 20.0)
+    return RpcClient(addr, **kw)
+
+
+def _count(name, **labels):
+    """Sum a family across label sets matching ``labels`` (counters and
+    gauges numeric; histograms count their observations)."""
+    total = 0
+    for (fam, lab), val in GLOBAL.snapshot().items():
+        if fam != name:
+            continue
+        had = dict(lab)
+        if any(had.get(k) != v for k, v in labels.items()):
+            continue
+        total += val["count"] if isinstance(val, dict) else val
+    return total
+
+
+def _await_count(name, minimum=1, timeout=5.0, **labels):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _count(name, **labels) >= minimum:
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"{name}{labels} stuck at {_count(name, **labels)} < {minimum}")
+
+
+def _raw_conn(addr):
+    sock = socket.create_connection(addr, timeout=5.0)
+    sock.settimeout(1.0)
+    return sock
+
+
+def _handshake(addr, tms="raw"):
+    """Plain-socket HELLO/WELCOME so a test can then misbehave."""
+    sock = _raw_conn(addr)
+    send_frame_sock(sock, HELLO, {"tms_id": tms, "t": time.time()})
+    frame = recv_frame_sock(sock, body_timeout_s=5.0)
+    assert frame is not None and frame[0] == WELCOME
+    return sock
+
+
+def _assert_server_alive(addr):
+    """The accept loop survived: a fresh well-behaved client round-trips."""
+    cli = _client(addr, tms_id="prober")
+    try:
+        out = cli.submit_range([True, False], [None, None])
+        assert out.tolist() == [True, False]
+    finally:
+        cli.close()
+
+
+# ------------------------------------------------------------ happy path
+def test_range_and_block_roundtrip():
+    GLOBAL.reset()
+    with _Harness() as h:
+        cli = _client(h.address, tms_id="alpha")
+        try:
+            out = cli._range.verify([True, False, True, True], [None] * 4)
+            assert isinstance(out, np.ndarray) and out.dtype == bool
+            assert out.tolist() == [True, False, True, True]
+
+            t_ok, i_ok = cli.verify_block(
+                [(True, [], []), (False, [], [])], [(True, [])])
+            assert t_ok.tolist() == [True, False]
+            assert i_ok.tolist() == [True]
+
+            # handshake measured a round trip and granted credits
+            assert cli.rtt_s >= 0.0
+            assert cli.ping(timeout_s=5.0)
+            assert cli.alive()
+
+            # a write in flight holds started > done for one loop tick;
+            # settled accounting must converge
+            deadline = time.monotonic() + 5.0
+            while True:
+                (conn,) = h.server.status()["connections"].values()
+                if conn["frames_started"] == conn["frames_done"]:
+                    break
+                assert time.monotonic() < deadline, conn
+                time.sleep(0.01)
+            assert conn["tms_id"] == "alpha"
+        finally:
+            cli.close()
+        assert _count("rpc_requests_total", tms="alpha", kind="range") == 1
+        assert _count("rpc_requests_total", tms="alpha", kind="block") == 1
+        assert _count("rpc_frame_errors_total") == 0
+        assert h.server.frames_clean
+
+
+def test_multi_tenant_labels_on_shared_server():
+    GLOBAL.reset()
+    with _Harness() as h:
+        clients = [_client(h.address, tms_id=t) for t in ("alice", "bob")]
+        try:
+            for cli in clients:
+                assert cli.submit_range([True], [None]).tolist() == [True]
+        finally:
+            for cli in clients:
+                cli.close()
+        for tenant in ("alice", "bob"):
+            assert _count("rpc_connections_total", tms=tenant) == 1
+            assert _count("rpc_requests_total", tms=tenant,
+                          kind="range") == 1
+
+
+# -------------------------------------------- deadlines and backpressure
+def test_expired_deadline_shed_at_decode():
+    GLOBAL.reset()
+    with _Harness() as h:
+        cli = _client(h.address)
+        try:
+            cli.wait_ready(timeout_s=10.0)
+            # simulate clock skew: the wire deadline lands in the
+            # server's past, so the SUBMIT is shed at decode
+            cli.clock_offset_s = -30.0
+            with pytest.raises(WorkerUnavailable, match="expired"):
+                cli.submit_range([True], [None], deadline_s=5.0)
+        finally:
+            cli.close()
+        assert _count("rpc_deadline_expired_total") == 1
+        # shed before entering the service, so never counted as accepted
+        assert _count("rpc_requests_total", kind="range") == 0
+        _assert_server_alive(h.address)
+
+
+def test_credit_backpressure_stalls_then_sheds():
+    GLOBAL.reset()
+    with _Harness(rpc_cfg=RpcConfig(conn_credits=2)) as h:
+        cli = _client(h.address, credit_wait_s=0.3)
+        try:
+            # 5 rows can never fit a 2-credit grant: the client stalls
+            # on credits (counted) and sheds as transient backpressure
+            with pytest.raises(WorkerUnavailable, match="backpressure"):
+                cli.submit_range([True] * 5, [None] * 5)
+            assert _count("rpc_credit_waits_total") >= 1
+            # a batch within budget still flows, and the RESULT's
+            # replenish restores the grant for the next one
+            for _ in range(3):
+                out = cli.submit_range([True, True], [None, None])
+                assert out.tolist() == [True, True]
+        finally:
+            cli.close()
+
+
+def test_hedged_interactive_send_first_reply_wins():
+    GLOBAL.reset()
+    with _Harness(serve_cfg=ServeConfig(buckets=(8,), max_wait_s=0.05)) as h:
+        cli = _client(h.address, hedge_after_s=0.0)
+        try:
+            out = cli.submit_range([True, False], [None, None],
+                                   lane=LANE_INTERACTIVE)
+            assert out.tolist() == [True, False]
+        finally:
+            cli.close()
+        assert _count("rpc_hedges_total") >= 1
+
+
+# ------------------------------------------------------- frame adversity
+@pytest.mark.parametrize("kind,frame_bytes", [
+    ("bad_magic", b"\x00" * 12),
+    ("oversize", _HEADER.pack(MAGIC, HELLO, 0, 2**31 - 1, 0)),
+    ("checksum", _HEADER.pack(MAGIC, HELLO, 0, 4, 0xDEAD) + b"ruin"),
+    ("decode", _HEADER.pack(MAGIC, HELLO, 0, 4,
+                            zlib.crc32(b"ruin")) + b"ruin"),
+    ("torn", _HEADER.pack(MAGIC, HELLO, 0, 64, 0)[:6]),
+])
+def test_poisoned_hello_is_counted_not_fatal(kind, frame_bytes):
+    GLOBAL.reset()
+    with _Harness(rpc_cfg=RpcConfig(hello_timeout_s=1.0)) as h:
+        sock = _raw_conn(h.address)
+        try:
+            sock.sendall(frame_bytes)
+        finally:
+            sock.close()  # "torn" needs the close; harmless for the rest
+        _await_count("rpc_frame_errors_total", kind=kind)
+        _assert_server_alive(h.address)
+        assert h.server.frames_clean
+
+
+def test_first_frame_must_be_hello():
+    GLOBAL.reset()
+    with _Harness() as h:
+        sock = _raw_conn(h.address)
+        try:
+            send_frame_sock(sock, PING, {"t": time.time()})
+            _await_count("rpc_frame_errors_total", kind="protocol")
+        finally:
+            sock.close()
+        _assert_server_alive(h.address)
+
+
+def test_midframe_disconnect_after_handshake():
+    GLOBAL.reset()
+    with _Harness(rpc_cfg=RpcConfig(frame_timeout_s=1.0)) as h:
+        sock = _handshake(h.address)
+        # half a SUBMIT frame, then vanish
+        sock.sendall(_HEADER.pack(MAGIC, 3, 0, 128, 0) + b"x" * 40)
+        sock.close()
+        _await_count("rpc_frame_errors_total", kind="torn")
+        _assert_server_alive(h.address)
+
+
+def test_slow_loris_frame_hits_deadline_not_a_hang():
+    GLOBAL.reset()
+    with _Harness(rpc_cfg=RpcConfig(frame_timeout_s=0.4,
+                                    idle_tick_s=0.1)) as h:
+        sock = _handshake(h.address)
+        try:
+            # declare a 100B payload, trickle 10B, stall past the
+            # frame deadline: the server must fail it as slow_frame
+            # within frame_timeout_s, not park in recv forever
+            sock.sendall(_HEADER.pack(MAGIC, 3, 0, 100, 0) + b"y" * 10)
+            _await_count("rpc_frame_errors_total", kind="slow_frame",
+                         timeout=5.0)
+        finally:
+            sock.close()
+        _assert_server_alive(h.address)
+
+
+# ----------------------------------------------------- drain and restart
+def test_draining_stop_under_load_closes_no_frame_midwrite():
+    GLOBAL.reset()
+    with _Harness(serve_cfg=ServeConfig(buckets=(8,), max_wait_s=0.05)) as h:
+        cli = _client(h.address)
+        results, sheds = [], []
+
+        def _caller():
+            try:
+                results.append(
+                    cli.submit_range([True] * 8, [None] * 8).tolist())
+            except WorkerUnavailable as exc:
+                sheds.append(exc)
+
+        threads = [threading.Thread(target=_caller) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.02)  # let submits get in flight
+            h.stop_server()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert not any(t.is_alive() for t in threads)
+            # every call resolved: served before the drain, or shed as
+            # transient (goaway) for the caller's ladder to retry
+            assert len(results) + len(sheds) == 4
+            for verdicts in results:
+                assert verdicts == [True] * 8
+            # THE invariant: nothing was cut mid-frame by the drain
+            assert h.server.frames_clean
+            assert _count("rpc_frame_errors_total", kind="midframe_close") \
+                == 0
+            assert _count("rpc_goaways_total", role="server") >= 1
+            # the listener is gone now, so a fresh call exhausts the
+            # redial ladder into WorkerUnavailable — never a hang
+            with pytest.raises(WorkerUnavailable):
+                cli.submit_range([True], [None])
+        finally:
+            cli.close()
+
+
+def test_client_reconnects_after_server_restart_on_same_port():
+    GLOBAL.reset()
+    first = _Harness()
+    host, port = first.address
+    cli = _client((host, port), redial_attempts=6, redial_cap_s=0.2)
+    try:
+        assert cli.submit_range([True], [None]).tolist() == [True]
+        first.stop()
+        with pytest.raises(WorkerUnavailable):
+            cli.submit_range([True], [None])
+        with _Harness(rpc_cfg=RpcConfig(port=port)) as second:
+            assert second.address[1] == port
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    out = cli.submit_range([True, False], [None, None])
+                    break
+                except WorkerUnavailable:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.05)
+            assert out.tolist() == [True, False]
+    finally:
+        cli.close()
+    assert _count("rpc_redials_total", outcome="ok") >= 2
+    assert _count("rpc_redials_total", outcome="error") >= 1

@@ -1,0 +1,80 @@
+"""Tier-1 wrapper around scripts/check_socket_timeouts.py: every
+blocking socket/pipe wait in the serving plane (serve/, resilience/,
+obs/telemetry.py, obs/aggregate.py) must carry an explicit timeout,
+run under an asyncio ``wait_for``, or carry a documented
+``# io-deadline:`` waiver naming what bounds it from outside.
+
+A hung read with no deadline is how rc=124-with-no-diagnosis comes
+back; this test makes the invariant part of the suite so a new
+unbounded wait fails CI, not just the linter nobody ran.
+"""
+
+import ast
+import importlib.util
+import pathlib
+import textwrap
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+           / "check_socket_timeouts.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_socket_timeouts",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _offenders_in(mod, source: str):
+    """Run the lint walker over an inline snippet."""
+    tree = ast.parse(textwrap.dedent(source))
+    waived = {i + 1 for i, line in
+              enumerate(textwrap.dedent(source).splitlines())
+              if mod.WAIVER in line}
+    walker = mod._Walker(waived)
+    walker.visit(tree)
+    return walker.offenders
+
+
+def test_serving_plane_has_no_unbounded_waits():
+    mod = _load()
+    offenders = mod.find_offenders()
+    assert not offenders, (
+        "unbounded blocking waits in the serving plane (add a timeout, "
+        "wrap in wait_for(), or document the outer bound with "
+        f"'# io-deadline: <why>'): {offenders}")
+
+
+def test_linter_sees_the_scope():
+    """Guard the guard: the lint must actually be walking the serving
+    plane, or a path regression turns it into a silent no-op."""
+    mod = _load()
+    files = mod._scope_files()
+    names = {f.name for f in files}
+    assert {"rpc.py", "rpc_client.py", "worker.py", "supervisor.py",
+            "telemetry.py", "aggregate.py"} <= names
+    assert len(files) > 8
+
+
+def test_detects_unbounded_sync_wait():
+    mod = _load()
+    bad = _offenders_in(mod, """
+        def f(conn):
+            conn.poll()
+            conn.recv()
+    """)
+    assert {name for _, name, _ in bad} == {"poll", "recv"}
+
+
+def test_timeouts_and_waivers_satisfy_the_lint():
+    mod = _load()
+    ok = _offenders_in(mod, """
+        async def f(conn, reader, ev):
+            conn.poll(5.0)
+            conn.wait(timeout=1.0)
+            await ev.wait()
+            await wait_for(reader.readexactly(12), 5.0)
+            data = conn.recv(4096)  # io-deadline: settimeout tick
+    """)
+    assert ok == []
