@@ -448,6 +448,147 @@ def _bench_serve():
     }))
 
 
+def _bench_replay():
+    """BENCH_MODE=replay — BASELINE config 5 at fleet scale: the 100k
+    range-proof backlog replay, open-loop through the MULTI-LANE serve
+    frontend. The benchdata corpus is tiled and re-randomized (seeded
+    per-request draw, seeded forgery interleave) into a
+    ``BENCH_REPLAY_PROOFS``-long stream; a Poisson arrival schedule at
+    ``BENCH_REPLAY_RATE`` req/s submits every proof to a
+    ``VerificationService`` with ``n_lanes = BENCH_REPLAY_LANES`` device
+    dispatch lanes (default: one per visible device), so batches overlap
+    across every lane instead of serializing on one dispatcher.
+
+    Reports aggregate proofs/s plus per-lane dispatch counts and
+    utilization (lane busy wall / run wall), and asserts verdict parity
+    two ways: every verdict against the seeded clean/forged expectation,
+    and a spot sample against the pure-host ``rp.range_verify`` oracle
+    (accepts AND rejects)."""
+    import asyncio
+    import copy
+    import random
+
+    import jax
+
+    from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+    from fabric_token_sdk_tpu.crypto import rp
+    from fabric_token_sdk_tpu.harness.txgen import open_loop_arrivals
+    from fabric_token_sdk_tpu.serve import (STATUS_DEADLINE_MISS, STATUS_OK,
+                                            ServeConfig, VerificationService)
+
+    pp, proofs, coms = _load()
+    total = int(os.environ.get("BENCH_REPLAY_PROOFS", "100000"))
+    rate = float(os.environ.get("BENCH_REPLAY_RATE", "4000"))
+    n_lanes = (int(os.environ.get("BENCH_REPLAY_LANES", "0"))
+               or max(1, len(jax.devices())))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", "16,128,256,512,1024").split(","))
+    cfg = ServeConfig(
+        buckets=buckets,
+        max_wait_s=float(os.environ.get("BENCH_SERVE_WAIT", "0.025")),
+        default_deadline_s=float(os.environ.get("BENCH_REPLAY_DEADLINE",
+                                                "120.0")),
+        trace_every=0,                       # 100k spans would swamp RAM
+        n_lanes=n_lanes)
+    zk = ZKVerifier(pp, device=True)
+    _configure_bench_journal()
+    svc = VerificationService(zk, config=cfg)
+    telemetry = _start_bench_telemetry(svc)
+    n = len(proofs)
+    forged = copy.deepcopy(proofs[0])
+    forged.data.tau = (forged.data.tau + 1) % (1 << 250)
+    FORGE_EVERY = 101
+    # re-randomized stream: seeded per-request corpus draw, so the lane
+    # batches mix corpus entries instead of replaying them in phase
+    draw = random.Random(13)
+    picks = [draw.randrange(n) for _ in range(total)]
+
+    def _host_verdict(proof, com) -> bool:
+        rpp = pp.range_proof_params
+        cg = pp.pedersen_generators[1:3]
+        try:
+            rp.range_verify(proof, com, cg, rpp.left_generators,
+                            rpp.right_generators, rpp.P, rpp.Q,
+                            rpp.number_of_rounds, rpp.bit_length)
+            return True
+        except rp.ProofError:
+            return False
+
+    async def run():
+        print(f"replay bench: prewarming {len(cfg.buckets)} buckets "
+              f"x {n_lanes} lanes", file=sys.stderr)
+        prewarm_s = await svc.start()
+        print(f"replay bench: prewarm in {prewarm_s:.1f}s", file=sys.stderr)
+        # spot parity vs the pure-host oracle, accepts AND rejects
+        spot_p = [forged] + proofs[:3]
+        spot_c = [coms[0]] + coms[:3]
+        host = [_host_verdict(p, c) for p, c in zip(spot_p, spot_c)]
+        got = await asyncio.gather(*[
+            svc.submit_range(p, c) for p, c in zip(spot_p, spot_c)])
+        assert [r.accepted for r in got] == host, \
+            "replay verdicts diverge from the host oracle"
+        duration = total / rate
+        arrivals = open_loop_arrivals(rate, duration * 1.1, seed=11)[:total]
+        while len(arrivals) < total:       # top up to exactly `total`
+            arrivals.append((arrivals[-1] if arrivals else 0.0) + 1.0 / rate)
+        print(f"replay bench: open loop, {total} proofs at {rate:.0f}/s "
+              f"over {n_lanes} lanes", file=sys.stderr)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+
+        async def one(i, offset):
+            delay = t0 + offset - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if i % FORGE_EVERY == 0:
+                return await svc.submit_range(forged, coms[picks[i]])
+            return await svc.submit_range(proofs[picks[i]], coms[picks[i]])
+
+        results = await asyncio.gather(
+            *[one(i, off) for i, off in enumerate(arrivals)])
+        elapsed = loop.time() - t0
+        lanes_status = svc.status()["lanes"]
+        await svc.stop(timeout_s=300.0)
+        return prewarm_s, results, elapsed, lanes_status
+
+    prewarm_s, results, elapsed, lanes_status = asyncio.run(run())
+    if telemetry is not None:
+        telemetry.stop()
+    served = [r for r in results
+              if r.status in (STATUS_OK, STATUS_DEADLINE_MISS)
+              and r.accepted is not None]
+    # forged rows reject, everything else accepts: any divergence means a
+    # lane's sharded/batched verdict disagrees with ground truth
+    parity_bad = sum(
+        1 for i, r in enumerate(results)
+        if r.accepted is not None
+        and r.accepted != (i % FORGE_EVERY != 0))
+    lanes_used = sorted({r.device_lane for r in served if r.device_lane >= 0})
+    util = {str(ls["index"]): round(ls["busy_s"] / elapsed, 3)
+            for ls in lanes_status}
+    dispatches = {str(ls["index"]): ls["dispatches"] for ls in lanes_status}
+    ok = [r for r in results if r.status == STATUS_OK]
+    value = len(served) / elapsed
+    print(json.dumps({
+        "metric": f"replay_prewarm_wall_seconds_{BIT_LENGTH}bit",
+        "value": round(prewarm_s, 2),
+        "unit": f"s ({len(cfg.buckets)} buckets x {n_lanes} lanes)",
+    }))
+    print(json.dumps({
+        "metric": f"replay{total}_multilane_proofs_per_sec_{BIT_LENGTH}bit",
+        "value": round(value, 2),
+        "unit": (f"proofs/s served ({len(served)}/{len(results)} verdicts, "
+                 f"{len(ok)} in deadline; {n_lanes} lanes, "
+                 f"used {lanes_used}; dispatches {dispatches}; "
+                 f"utilization {util}; parity errors {parity_bad})"),
+        "vs_baseline": round(value / TARGET_BASELINE, 4),
+    }))
+    assert parity_bad == 0, \
+        "replay bench: verdict parity broken across lanes"
+    assert len(lanes_used) == n_lanes or len(served) < n_lanes, \
+        f"replay bench: only lanes {lanes_used} of {n_lanes} served traffic"
+
+
 def _bench_chaos():
     """BENCH_MODE=chaos: the serve bench under a seeded fault schedule.
 
@@ -1026,6 +1167,10 @@ def main():
 
     if mode == "serve":
         _bench_serve()
+        return
+
+    if mode == "replay":
+        _bench_replay()
         return
 
     if mode == "chaos":

@@ -60,6 +60,7 @@ from ..crypto import bn254, rp
 from ..crypto import serialization as ser
 from ..crypto.bn254 import fr_add, fr_batch_inv, fr_inv, fr_mul, fr_sub
 from ..native import load_frmont
+from ..obs import GLOBAL as _METRICS
 from ..obs import RECORDS as _RECORDS
 from ..obs import TRACER as _TRACER
 from ..obs import BatchRecord, PhaseTimer
@@ -94,10 +95,25 @@ def _count(kind: str) -> None:
 
 def _fused_pipeline_enabled() -> bool:
     """Single-program chunk pipeline (pass-2 var partial merged into the
-    pass-1 chunk program): default on for single-chip on every backend;
-    FTS_NO_FUSED_PIPELINE=1 restores the split per-pass dispatches (the
-    mesh path always keeps them — its var MSM shards over devices)."""
+    pass-1 chunk program): default on for single-chip AND under a mesh
+    (the sharded flavor runs the same fused program per device shard
+    with an all-gather partial fold, _pass12_sharded_fn);
+    FTS_NO_FUSED_PIPELINE=1 restores the split per-pass dispatches."""
     return not os.environ.get("FTS_NO_FUSED_PIPELINE")
+
+
+#: Mesh-path family metadata (HELP independent of call-site order).
+_MESH_FAMILIES = {
+    "mesh_devices": "Devices in the verifier's (dp, tp) mesh",
+    "mesh_chunk_dispatches_total":
+        "Fused chunk programs dispatched under shard_map, whole mesh",
+    "mesh_pad_rows_total":
+        "Identity-padded rows added for per-shard chunk divisibility",
+    "mesh_allgather_bytes_total":
+        "Bytes moved by the per-chunk Jacobian-partial all-gather",
+}
+for _fam, _help in _MESH_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
 
 
 # --------------------------------------------------------------------------
@@ -306,6 +322,43 @@ def _finalize_kernel(tables, fixed_sc, partials):
     return ec.is_identity(ec.add(fixed_pt, var_pt))
 
 
+@jax.jit
+def _finalize_total_kernel(tables, fixed_sc, total):
+    """Finalize against the chain-folded var total -> () bool.
+
+    The cross-chunk fold no longer happens here: every fused chunk
+    program adds its own var partial onto the previous chunk's running
+    total (the ``prev`` input of _pass12_fused_fn), so the last chunk's
+    ``total`` output already carries the whole batch's var point and the
+    finalize shrinks to fixed-MSM + one add + identity — O(1) in the
+    chunk count, no jnp.stack over per-chunk partials. The stacked
+    _finalize_kernel stays for the bisect and split paths, which need
+    per-chunk partials individually."""
+    fixed_pt = ec.fixed_base_msm(tables, fixed_sc)
+    return ec.is_identity(ec.add(fixed_pt, total))
+
+
+@jax.jit
+def _exact_mixed_tail_kernel(planes_f2, planes_f1, f2_sc, f1_sc,
+                             eq1_pts, eq1_sc, eq2_pts, eq2_sc):
+    """Exact tail with lazified FIXED-base gathers: the CPU/XLA twin of
+    the Pallas fused-exact tail (_exact_var_tail_kernel's caller branch).
+
+    The per-proof fixed-generator sums ride ec.fixed_base_msm_mixed —
+    the digit-0-masked madd/lazy-carry gather chain over affine 64-byte
+    planes (one normalize per window chain) — instead of being stuffed
+    into the projective var MSM as 2n+4 extra variable-base terms. The
+    small per-proof tails stay on the lazy-carry mixed-affine var MSM.
+    planes_f2 covers [G.., H.., P, Q], planes_f1 [cg0, cg1]; layout
+    matches the Pallas branch, so verdicts are bit-identical (the accept
+    bit is an identity check, invariant to the fold regrouping)."""
+    f2_pt = ec.fixed_base_msm_mixed(planes_f2, f2_sc)
+    f1_pt = ec.fixed_base_msm_mixed(planes_f1, f1_sc)
+    ok1 = ec.is_identity(ec.add(f1_pt, ec.msm_var_mixed(eq1_pts, eq1_sc)))
+    ok2 = ec.is_identity(ec.add(f2_pt, ec.msm_var_mixed(eq2_pts, eq2_sc)))
+    return jnp.logical_and(ok1, ok2)
+
+
 # --------------------------------------------------------------------------
 # verifier parameters (device-resident, cached per pp)
 # --------------------------------------------------------------------------
@@ -344,6 +397,11 @@ class RangeVerifierParams:
     tables_t_all: jnp.ndarray | None = None   # (2n+5, 32, 64, 256)
     tables_t_rgp: jnp.ndarray | None = None   # (n, 32, 64, 256)
     tables_t_k: jnp.ndarray | None = None     # (n+2, 32, 64, 256)
+    #: generator digest keying the on-disk table cache (empty when the
+    #: params were built without one); the lazy exact-pass affine planes
+    #: (_exact_mixed_planes) reuse it so a warm "affine" cache file makes
+    #: the mixed exact tail free to enable.
+    cache_digest: str = ""
 
     @classmethod
     def from_pp(cls, pp, cache_digest: str = "") -> "RangeVerifierParams":
@@ -405,6 +463,7 @@ class RangeVerifierParams:
             tables_t_all=tables_t_all,
             tables_t_rgp=tables_t_rgp,
             tables_t_k=tables_t_k,
+            cache_digest=cache_digest,
         )
 
 
@@ -430,6 +489,47 @@ def _params_for(pp) -> RangeVerifierParams:
         _PARAMS_CACHE[key] = RangeVerifierParams.from_pp(
             pp, cache_digest=h.hexdigest()[:16])
     return _PARAMS_CACHE[key]
+
+
+#: (bit_length, digest) -> (planes_f2, planes_f1) device pair, or None
+#: when the mixed exact tail is unavailable for that params set.
+_EXACT_MIXED_CACHE: dict = {}
+
+
+def _exact_mixed_planes(params):
+    """Affine (madd) planes for the exact-pass FIXED-base tails, lazily.
+
+    The CPU/XLA param build materializes only the projective 96-byte
+    planes; the mixed exact tail needs the affine 64-plane flavor, whose
+    from-scratch build costs one batched Fermat inversion over
+    T*32*256 table entries (tens of seconds at n=16, minutes at n=64) —
+    far too much to impose on every process that might run one exact
+    pass. So: serve it from the on-disk table cache when a warm "affine"
+    file exists (written by any prior Pallas param build or forced build
+    sharing the generator digest), build it only under FTS_EXACT_MIXED=1
+    (recovering the raw tables from the resident byte planes — exact,
+    values are 0..255), and disable entirely under FTS_EXACT_MIXED=0.
+    Returns (planes_f2 [G..,H..,P,Q], planes_f1 [cg0,cg1]) or None
+    (callers fall back to the all-variable-base exact kernel)."""
+    mode = os.environ.get("FTS_EXACT_MIXED", "")
+    if mode == "0":
+        return None
+    n = params.bit_length
+    key = (n, params.cache_digest)
+    if key in _EXACT_MIXED_CACHE:
+        return _EXACT_MIXED_CACHE[key]
+    planes = _table_cache_load(n, params.cache_digest, "affine")
+    if planes is None:
+        if mode != "1":
+            _EXACT_MIXED_CACHE[key] = None
+            return None
+        raw = jax.jit(ec._from_byte_planes)(
+            params.tables.astype(jnp.float32))
+        planes = _affine_planes_kernel(raw)
+        _table_cache_save(n, params.cache_digest, "affine", planes)
+    out = (planes[:2 * n + 2], planes[2 * n + 2:2 * n + 4])
+    _EXACT_MIXED_CACHE[key] = out
+    return out
 
 
 def _pad_terms(pts: np.ndarray, sc: np.ndarray, t_target: int):
@@ -827,43 +927,34 @@ def _derive_var_scalars(sc4, w12, rdig, rounds: int):
 
 
 _PASS12_FUSED_FNS: dict = {}
+_PASS12_SHARDED_FNS: dict = {}
 
 
-def _pass12_fused_fn(params):
-    """ONE jitted device program for a whole chunk's pass-1 AND its
-    pass-2 var-MSM partial (the single-program chunk pipeline): unpack
-    the single uploaded u32 row -> derive pass-1 scalar vectors ->
-    fixed-base folds -> affine bytes -> transcript SHA -> round digests
-    -> weighted var scalars -> var-MSM partial. One dispatch + one
-    packed upload per chunk where the round-6 pipeline issued ~3 calls
-    + 1 upload (fused pass-1 program, then a weighted-scalar upload and
-    a var-MSM dispatch after the host sync) — per-call tunnel latency
-    (measured ~2.5 ms/dispatch, ~6.5 ms/device_put) was the next wall.
-
-    Both backends share the program STRUCTURE; only the kernel bodies
-    switch: TPU runs the Pallas VMEM kernels, CPU/XLA the gather +
-    msm_var_mixed twins — so the merged pipeline (including the device
-    round-digest and var-scalar derivations) is exercised by the CPU CI,
-    not only on chip.
-
-    Packed row layout (u32): [sc4 64 | xy-as-u16-pairs nv*2*8 | inf nv |
-    ip 8 | w12 32]. Returns ((B, 8) x_ipa digests, (B, rounds, 8) round
-    digests, (B, nv, 3, 16) projective points, (3, 16) var partial).
-    """
-    pallas_on = params.tables_t_rgp is not None
-    key = (params.bit_length, params.q_bytes, params.left_gen_bytes,
-           pallas_on)
-    if key in _PASS12_FUSED_FNS:
-        return _PASS12_FUSED_FNS[key]
-
-    n = params.bit_length
-    rr = params.rounds
-    nv = 2 + 2 * rr + 3
-    xipa = _xipa_device_fn(params)
-    o_xy = 64
-    o_inf = o_xy + nv * 16
+def _pass12_layout(params):
+    """Packed-row offsets shared by the fused and sharded chunk programs:
+    (nv, o_inf, o_ip, o_w) for the u32 layout
+    [sc4 64 | xy-as-u16-pairs nv*2*8 | inf nv | ip 8 | w12 32]."""
+    nv = 2 + 2 * params.rounds + 3
+    o_inf = 64 + nv * 16
     o_ip = o_inf + nv
     o_w = o_ip + 8
+    return nv, o_inf, o_ip, o_w
+
+
+def _pass12_body(params):
+    """Un-jitted chunk body shared by _pass12_fused_fn (single chip) and
+    _pass12_sharded_fn (per device shard under shard_map): unpack the
+    single uploaded u32 row -> derive pass-1 scalar vectors ->
+    fixed-base folds -> affine bytes -> transcript SHA -> round digests
+    -> weighted var scalars -> var-MSM partial. Returns
+    body(packed, rgp_fn, kfixed_fn, mul2_fn, var_fn) ->
+    ((B, 8) x_ipa digests, (B, rounds, 8) round digests,
+    (B, nv, 3, 16) projective points, (3, 16) var partial)."""
+    n = params.bit_length
+    rr = params.rounds
+    nv, o_inf, o_ip, o_w = _pass12_layout(params)
+    xipa = _xipa_device_fn(params)
+    o_xy = 64
 
     def body(packed, rgp_fn, kfixed_fn, mul2_fn, var_fn):
         B = packed.shape[0]
@@ -890,33 +981,157 @@ def _pass12_fused_fn(params):
                          var_sc.reshape(B * nv, limbs.NLIMBS))
         return digests, rdig, pts, partial
 
+    return body
+
+
+def _pass12_xla_kernels(tables, rgp_idx, k_idx):
+    """(rgp_fn, kfixed_fn, mul2_fn, var_fn) — XLA twin kernel bodies."""
+    return (lambda yinv: ec.fixed_base_gather(
+                jnp.take(tables, rgp_idx, axis=0), yinv),
+            lambda kf: ec.fixed_base_msm(
+                jnp.take(tables, k_idx, axis=0), kf),
+            ec.msm_var_mixed,
+            ec.msm_var_mixed)
+
+
+def _pass12_pallas_kernels(t_rgp, t_k):
+    """(rgp_fn, kfixed_fn, mul2_fn, var_fn) — Pallas VMEM kernel bodies."""
+    from ..ops import pallas_fb
+
+    return (lambda yinv: pallas_fb.fixed_base_gather_fused(t_rgp, yinv),
+            lambda kf: pallas_fb.fixed_base_msm_fused(t_k, kf),
+            pallas_fb.mul2_rows_fused,
+            pallas_fb.msm_var_fused)
+
+
+def _pass12_fused_fn(params):
+    """ONE jitted device program for a whole chunk's pass-1 AND its
+    pass-2 var-MSM partial (the single-program chunk pipeline): see
+    _pass12_body for the program structure. One dispatch + one packed
+    upload per chunk where the round-6 pipeline issued ~3 calls + 1
+    upload — per-call tunnel latency (measured ~2.5 ms/dispatch,
+    ~6.5 ms/device_put) was the next wall.
+
+    Both backends share the program STRUCTURE; only the kernel bodies
+    switch: TPU runs the Pallas VMEM kernels, CPU/XLA the gather +
+    msm_var_mixed twins — so the merged pipeline (including the device
+    round-digest and var-scalar derivations) is exercised by the CPU CI,
+    not only on chip.
+
+    ``prev`` chains the cross-chunk fold through the pipeline (ROOFLINE
+    "Remaining items" #2): chunk k's program adds its own partial onto
+    chunk k-1's running ``total``, so the last chunk's total already
+    holds the whole batch's var point and the finalize shrinks to
+    _finalize_total_kernel — the per-verify stack+tree-fold dispatch is
+    gone. Chaining costs one point add per chunk INSIDE the program and
+    does not serialize the host: dispatches stay async, XLA sequences
+    the data dependency device-side.
+
+    Returns (run, nv, o_inf, o_ip, o_w); run(tables, rgp_idx, k_idx,
+    packed, prev) (XLA) or run(t_rgp, t_k, packed, prev) (Pallas) ->
+    (digests, rdig, pts, partial, total)."""
+    pallas_on = params.tables_t_rgp is not None
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes,
+           pallas_on)
+    if key in _PASS12_FUSED_FNS:
+        return _PASS12_FUSED_FNS[key]
+
+    body = _pass12_body(params)
+    nv, o_inf, o_ip, o_w = _pass12_layout(params)
+
     if pallas_on:
-        from ..ops import pallas_fb
 
         @jax.jit
-        def run(t_rgp, t_k, packed):
-            return body(
-                packed,
-                lambda yinv: pallas_fb.fixed_base_gather_fused(t_rgp,
-                                                               yinv),
-                lambda kf: pallas_fb.fixed_base_msm_fused(t_k, kf),
-                pallas_fb.mul2_rows_fused,
-                pallas_fb.msm_var_fused)
+        def run(t_rgp, t_k, packed, prev):
+            digests, rdig, pts, partial = body(
+                packed, *_pass12_pallas_kernels(t_rgp, t_k))
+            return digests, rdig, pts, partial, ec.add(partial, prev)
     else:
 
         @jax.jit
-        def run(tables, rgp_idx, k_idx, packed):
-            return body(
-                packed,
-                lambda yinv: ec.fixed_base_gather(
-                    jnp.take(tables, rgp_idx, axis=0), yinv),
-                lambda kf: ec.fixed_base_msm(
-                    jnp.take(tables, k_idx, axis=0), kf),
-                ec.msm_var_mixed,
-                ec.msm_var_mixed)
+        def run(tables, rgp_idx, k_idx, packed, prev):
+            digests, rdig, pts, partial = body(
+                packed, *_pass12_xla_kernels(tables, rgp_idx, k_idx))
+            return digests, rdig, pts, partial, ec.add(partial, prev)
 
     _PASS12_FUSED_FNS[key] = (run, nv, o_inf, o_ip, o_w)
     return _PASS12_FUSED_FNS[key]
+
+
+def _pass12_sharded_fn(params, mesh):
+    """The fused chunk program under shard_map: every device runs
+    _pass12_body on its row shard, then the per-shard var partials are
+    all-gathered (96 uint32 per device riding ICI) and tree-folded
+    locally, exactly the collective pattern of _make_sharded_combined —
+    point addition is not a psum-able ring op, so gather+fold is the
+    TPU-native collective for it.
+
+    The chunk's rows shard over the WHOLE (dp, tp) device grid: the
+    var-MSM term axis is the flattened (rows * nv) axis, so sharding
+    rows over dp x tp IS the batch-on-dp / terms-on-tp decomposition
+    with strictly less communication than replicating pass-1 across tp
+    would cost (pass-1 runs once per row, nowhere twice). Padded rows
+    carry identity points + zero weights — exact MSM no-ops — so ragged
+    batches just round up to a shard-divisible bucket.
+
+    This replaces the legacy mesh arrangement (one giant single-chunk
+    program over the split per-stage closures) that never finished
+    compiling on the dryrun hosts: per-shard chunks keep every compiled
+    program at the same small shapes the single-chip pipeline uses.
+
+    Returns (run, nv, o_inf, o_ip, o_w); run has the _pass12_fused_fn
+    signature and the same (digests, rdig, pts, partial, total) outputs,
+    with partial/total replicated across the mesh (chunk chaining and
+    the finalize read them anywhere)."""
+    pallas_on = params.tables_t_rgp is not None
+    key = (params.bit_length, params.q_bytes, params.left_gen_bytes,
+           pallas_on, mesh)
+    if key in _PASS12_SHARDED_FNS:
+        return _PASS12_SHARDED_FNS[key]
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import _shard_map
+
+    axes = tuple(mesh.axis_names)
+    body = _pass12_body(params)
+    nv, o_inf, o_ip, o_w = _pass12_layout(params)
+
+    def _fold(partial, prev):
+        gathered = jax.lax.all_gather(partial, axes)    # (ndev, 3, 16)
+        folded = ec._tree_sum_shrink(gathered)
+        return folded, ec.add(folded, prev)
+
+    out_specs = (P(axes, None), P(axes, None, None),
+                 P(axes, None, None, None), P(), P())
+    if pallas_on:
+
+        def shard_body(t_rgp, t_k, packed, prev):
+            digests, rdig, pts, partial = body(
+                packed, *_pass12_pallas_kernels(t_rgp, t_k))
+            folded, total = _fold(partial, prev)
+            return digests, rdig, pts, folded, total
+
+        sharded = _shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(), P(axes, None), P()),
+            out_specs=out_specs)
+    else:
+
+        def shard_body(tables, rgp_idx, k_idx, packed, prev):
+            digests, rdig, pts, partial = body(
+                packed, *_pass12_xla_kernels(tables, rgp_idx, k_idx))
+            folded, total = _fold(partial, prev)
+            return digests, rdig, pts, folded, total
+
+        sharded = _shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axes, None), P()),
+            out_specs=out_specs)
+
+    run = jax.jit(sharded)
+    _PASS12_SHARDED_FNS[key] = (run, nv, o_inf, o_ip, o_w)
+    return _PASS12_SHARDED_FNS[key]
 
 
 @jax.jit
@@ -1115,12 +1330,16 @@ def _host_phase_b(proof: rp.RangeProof, ts: _ProofTranscript,
 class _ChunkStage:
     """Stage-1 state of one chunk in the single-program pipeline.
 
-    ``partial``/``weights`` are populated only on the merged path
-    (_pass12_fused_fn): the pass-2 var-MSM partial is already computed by
-    the stage-1 dispatch, and the RLC weights it used (drawn host-side at
-    dispatch time) are kept so stage 2 can accumulate the matching
-    fixed-generator scalars. On the legacy split path both are None and
-    stage 2 dispatches _combined_chunk as before."""
+    ``partial``/``weights``/``total`` are populated only on the merged
+    path (_pass12_fused_fn / _pass12_sharded_fn): the pass-2 var-MSM
+    partial is already computed by the stage-1 dispatch, and the RLC
+    weights it used (drawn host-side at dispatch time) are kept so stage
+    2 can accumulate the matching fixed-generator scalars. ``total`` is
+    the running cross-chunk fold (this chunk's partial added onto the
+    previous chunk's total, computed INSIDE the chunk program) — the
+    last chunk's total feeds _combined_finalize_total directly. On the
+    legacy split path all three are None and stage 2 dispatches
+    _combined_chunk as before."""
 
     transcripts: dict
     digests_dev: object          # (B, 8) x_ipa digest words, device
@@ -1128,6 +1347,7 @@ class _ChunkStage:
     pts_dev: object              # (B, nv, 3, 16) projective proof points
     partial: object | None       # (3, 16) weighted var-MSM chunk partial
     weights: dict | None         # {proof_idx: (w1, w2)} ints
+    total: object | None         # (3, 16) running cross-chunk var fold
 
 
 def _make_sharded_combined(mesh, fused: bool = False):
@@ -1210,16 +1430,22 @@ def _make_sharded_pass1(mesh, params):
 class BatchRangeVerifier:
     """Vectorized range-proof verification for one public-parameter set.
 
-    With `mesh` (a (dp, tp) jax.sharding.Mesh) the production kernels run
-    SPMD: pass-1 rows are batch-sharded over every device (pure data
-    parallel, no communication) and the combined RLC MSM shards its term
-    axis with one tiny all-gather point-fold — BASELINE config 5's shape.
+    With `mesh` (a (dp, tp) jax.sharding.Mesh) the production pipeline
+    runs SPMD: the SAME fused pass12 chunk program as single-chip runs
+    per device shard under shard_map (rows sharded over the whole
+    device grid, identity-padded to shard divisibility), with one tiny
+    all-gather point-fold of the 96-uint32 var partials per chunk —
+    BASELINE config 5's shape. FTS_NO_FUSED_PIPELINE=1 restores the
+    legacy split per-stage dispatches (one giant single-chunk program
+    under the mesh).
     """
 
     def __init__(self, pp, mesh=None):
         self.params = _params_for(pp)
         self.mesh = mesh
         self._n_shard = int(mesh.devices.size) if mesh is not None else 1
+        if mesh is not None:
+            _METRICS.gauge("mesh_devices").set(float(mesh.devices.size))
         # fused Pallas kernels under the mesh (TPU); the CPU-mesh dryrun
         # keeps the XLA path via _pallas_enabled() -> tables_t_rgp is None
         self._fused_sharded = (mesh is not None
@@ -1348,12 +1574,13 @@ class BatchRangeVerifier:
 
             run, _nv, _oi, _op, o_w = _pass12_fused_fn(params)
             packed = jax.ShapeDtypeStruct((rows, o_w + 32), jnp.uint32)
+            prev = jax.ShapeDtypeStruct((3, limbs.NLIMBS), jnp.uint32)
             if params.tables_t_rgp is not None:
                 args = (jax.ShapeDtypeStruct(params.tables_t_rgp.shape,
                                              params.tables_t_rgp.dtype),
                         jax.ShapeDtypeStruct(params.tables_t_k.shape,
                                              params.tables_t_k.dtype),
-                        packed)
+                        packed, prev)
             else:
                 args = (jax.ShapeDtypeStruct(params.tables.shape,
                                              params.tables.dtype),
@@ -1361,7 +1588,7 @@ class BatchRangeVerifier:
                                              params.rgp_idx.dtype),
                         jax.ShapeDtypeStruct(params.k_idx.shape,
                                              params.k_idx.dtype),
-                        packed)
+                        packed, prev)
             c = PROFILER.capture_kernel_cost("pass12_fused", rows, run,
                                              *args)
             if c is not None:
@@ -1406,9 +1633,13 @@ class BatchRangeVerifier:
         that covers pass-1 AND the chunk's weighted var-MSM partial
         (dispatched async up front), so the host's challenge hashing +
         fixed-scalar accumulation for chunk k overlaps the device's work
-        on chunks k+1... Only the cross-chunk finalize fold stays a
-        separate dispatch. The mesh path keeps one chunk (rows shard
-        over devices instead) and the split per-stage dispatches.
+        on chunks k+1... The cross-chunk var fold chains THROUGH the
+        chunk programs (each adds its partial onto the previous total),
+        so the finalize is one O(1) tail dispatch. Under a mesh the same
+        chunk program runs per device shard (rows sharded over the whole
+        grid, chunk size scaled by the device count) with an all-gather
+        partial fold; FTS_NO_FUSED_PIPELINE restores the legacy split
+        per-stage dispatches.
 
         Observability: each call produces one span tree (root
         "range_verify" with host_prep / device_execute / result_fetch
@@ -1462,15 +1693,28 @@ class BatchRangeVerifier:
             sp.set_attribute("chunk_buckets", ())
             return ok_structure
 
-        chunk = len(live) if self.mesh is not None else _CHUNK_ROWS
+        if self.mesh is not None:
+            # fused: per-shard chunks stay at the single-chip shapes (the
+            # legacy one-giant-chunk program never finished compiling on
+            # the dryrun hosts); legacy split keeps the single chunk.
+            chunk = (_CHUNK_ROWS * self._n_shard
+                     if _fused_pipeline_enabled() else len(live))
+        else:
+            chunk = _CHUNK_ROWS
         chunks = [live[o:o + chunk] for o in range(0, len(live), chunk)]
         sp.set_attribute(
             "chunk_buckets", tuple(_bucket_rows(len(ch)) for ch in chunks))
 
         with pt.phase("host_prep"):
-            # ---- stage 1: all chunks' pass-1 dispatched before any sync
-            stage1 = [self._dispatch_pass1(proofs, commitments, ch)
-                      for ch in chunks]
+            # ---- stage 1: all chunks' pass-1 dispatched before any sync;
+            # prev chains the cross-chunk var fold through the programs
+            # (async — XLA sequences the device-side data dependency)
+            stage1 = []
+            prev = None
+            for ch in chunks:
+                st = self._dispatch_pass1(proofs, commitments, ch, prev)
+                prev = st.total
+                stage1.append(st)
 
             # ---- stage 2: per chunk, sync bytes -> challenges ->
             # equations; combined partial dispatched immediately (device
@@ -1486,7 +1730,8 @@ class BatchRangeVerifier:
             for ch, st in zip(chunks, stage1):
                 eqs_ch = self._host_stage2(proofs, ch, st)
                 equations.update(eqs_ch)
-                if not exact and self.mesh is None:
+                if not exact and (self.mesh is None
+                                  or st.partial is not None):
                     acc = zero_acc if zero_acc is not None else [0] * n_fixed
                     if st.partial is not None:
                         # merged pipeline: the chunk's var partial was
@@ -1507,19 +1752,27 @@ class BatchRangeVerifier:
         bad_rows = live
         if not exact:
             with pt.phase("device_execute", stage="combined"):
-                if self.mesh is not None:
+                if not chunk_rlc:
+                    # legacy split mesh path (FTS_NO_FUSED_PIPELINE)
                     ok = self._verify_combined(proofs, commitments, live,
                                                equations)
                 else:
                     total = self._sum_fixed_accs(
                         [a for _, a, _ in chunk_rlc])
-                    ok = self._combined_finalize(
-                        total, [p for _, _, p in chunk_rlc])
+                    last_total = stage1[-1].total
+                    if last_total is not None:
+                        # cross-chunk fold already chained through the
+                        # chunk programs: O(1) finalize tail
+                        ok = self._combined_finalize_total(total,
+                                                           last_total)
+                    else:
+                        ok = self._combined_finalize(
+                            total, [p for _, _, p in chunk_rlc])
             if ok:
                 self.last_path = "combined"
                 with pt.phase("result_fetch"):
                     return ok_structure
-            if self.mesh is None and len(chunk_rlc) > 1:
+            if len(chunk_rlc) > 1:
                 # bisect: re-check each chunk's RLC; exact only where it
                 # fails (a passing chunk RLC carries the same soundness
                 # as the whole-batch one: fresh per-proof weights)
@@ -1557,17 +1810,21 @@ class BatchRangeVerifier:
         return total
 
     # ------------------------------------------------------------------
-    def _dispatch_pass1(self, proofs, commitments, ch):
+    def _dispatch_pass1(self, proofs, commitments, ch, prev=None):
         """Host phase-a + marshal for one chunk, then async dispatch of
         the chunk's device work; returns a _ChunkStage with the digest
         device->host copies already in flight.
 
-        Single-chip with the pipeline enabled (default) this is ONE
-        packed upload + ONE fused device program covering pass-1 AND the
-        chunk's weighted pass-2 var-MSM partial — the RLC weights are
-        drawn here, ride the packed row, and are kept on the stage for
-        the host-side fixed-scalar accumulation in stage 2. The mesh
-        path and the FTS_NO_FUSED_PIPELINE escape keep the split
+        With the pipeline enabled (default) this is ONE packed upload +
+        ONE fused device program covering pass-1 AND the chunk's
+        weighted pass-2 var-MSM partial — the RLC weights are drawn
+        here, ride the packed row, and are kept on the stage for the
+        host-side fixed-scalar accumulation in stage 2. Under a mesh
+        the same program runs per device shard (_pass12_sharded_fn,
+        rows sharded over the whole grid). ``prev`` is the previous
+        chunk's running var total (identity for chunk 0); the program
+        adds its own partial onto it so the finalize is O(1) in chunk
+        count. The FTS_NO_FUSED_PIPELINE escape keeps the split
         uploads/dispatches (partial=None -> stage 2 runs
         _combined_chunk)."""
         params = self.params
@@ -1619,8 +1876,8 @@ class BatchRangeVerifier:
             b"".join(ser.zr_to_bytes(proofs[i].data.inner_product)
                      for i in ch), dtype=np.uint8).reshape(len(ch), 32)
 
-        partial = weights = None
-        if self.mesh is None and _fused_pipeline_enabled():
+        partial = weights = total = None
+        if _fused_pipeline_enabled():
             # single-program chunk pipeline: ONE packed upload + ONE
             # fused device program per chunk covering pass-1 AND the
             # weighted pass-2 var partial (per-call tunnel latency is a
@@ -1629,7 +1886,11 @@ class BatchRangeVerifier:
             # makes the merge sound (see _derive_var_scalars).
             weights = {i: (1 + secrets.randbelow(R - 1),
                            1 + secrets.randbelow(R - 1)) for i in ch}
-            run, nv_, o_inf, o_ip, o_w = _pass12_fused_fn(params)
+            if self.mesh is not None:
+                run, nv_, o_inf, o_ip, o_w = _pass12_sharded_fn(
+                    params, self.mesh)
+            else:
+                run, nv_, o_inf, o_ip, o_w = _pass12_fused_fn(params)
             packed = np.zeros((len(ch), o_w + 32), dtype=np.uint32)
             packed[:, :64] = sc4_np.reshape(len(ch), 64)
             xyu16 = proj[:, :, :2].astype("<u2")          # (L, nv, 2, 16)
@@ -1644,16 +1905,31 @@ class BatchRangeVerifier:
             ).reshape(len(ch), 32)
             pad_row = np.zeros(o_w + 32, dtype=np.uint32)
             pad_row[o_inf:o_ip] = 1        # identity points, zero weights
-            _count("chunk_upload")
-            packed_dev = jnp.asarray(_pad_rows(packed, b_bucket, pad_row))
+            padded = _pad_rows(packed, b_bucket, pad_row)
+            if prev is None:
+                prev = jnp.asarray(limbs.point_to_projective_limbs(
+                    bn254.G1_IDENTITY))
+            if self.mesh is not None:
+                _METRICS.counter("mesh_chunk_dispatches_total").add()
+                _METRICS.counter("mesh_pad_rows_total").add(
+                    b_bucket - len(ch))
+                # one (3, 16)-u32 Jacobian partial per device rides the
+                # per-chunk all-gather
+                _METRICS.counter("mesh_allgather_bytes_total").add(
+                    3 * limbs.NLIMBS * 4 * self._n_shard)
+                packed_dev = self._put_rows(padded)  # counts the upload
+            else:
+                _count("chunk_upload")
+                packed_dev = jnp.asarray(padded)
             _count("chunk_dispatch")
             if params.tables_t_rgp is not None:     # Pallas kernel bodies
-                digests_dev, rdig_dev, pts_proj, partial = run(
-                    params.tables_t_rgp, params.tables_t_k, packed_dev)
+                digests_dev, rdig_dev, pts_proj, partial, total = run(
+                    params.tables_t_rgp, params.tables_t_k, packed_dev,
+                    prev)
             else:                                   # XLA twin bodies
-                digests_dev, rdig_dev, pts_proj, partial = run(
+                digests_dev, rdig_dev, pts_proj, partial, total = run(
                     params.tables, params.rgp_idx, params.k_idx,
-                    packed_dev)
+                    packed_dev, prev)
         else:
             rdig_dev = None
             sc4 = self._put_rows(_pad_rows(sc4_np, b_bucket, zero_sc))
@@ -1693,7 +1969,7 @@ class BatchRangeVerifier:
             except (AttributeError, NotImplementedError, TypeError):
                 pass
         return _ChunkStage(transcripts, digests_dev, rdig_dev, pts_proj,
-                           partial, weights)
+                           partial, weights, total)
 
     def _host_stage2(self, proofs, ch, st) -> dict:
         """Challenges (vectorized) + per-proof scalar expansion for one
@@ -1846,6 +2122,21 @@ class BatchRangeVerifier:
         return bool(_finalize_kernel(self.params.tables,
                                      jnp.asarray(fixed_np), parts))
 
+    def _combined_finalize_total(self, fixed_acc, total) -> bool:
+        """Finalize against the chain-folded var total (the LAST chunk's
+        ``total`` output): the cross-chunk fold already happened inside
+        the chunk programs (ROOFLINE "Remaining items" #2), so this tail
+        is one fixed-base MSM + one add + one identity test — O(1) in
+        chunk count where _combined_finalize stacks and tree-folds the
+        per-chunk partials. The split finalize stays in use under bisect
+        (per-chunk re-checks need the un-chained partials)."""
+        fixed_np = (limbs.packed_to_limbs(fixed_acc)
+                    if _FRNATIVE is not None
+                    else limbs.scalars_to_limbs(fixed_acc))
+        _count("finalize")
+        return bool(_finalize_total_kernel(self.params.tables,
+                                           jnp.asarray(fixed_np), total))
+
     # ------------------------------------------------------------------
     def _verify_combined(self, proofs, commitments, live,
                          equations) -> bool:
@@ -1894,6 +2185,10 @@ class BatchRangeVerifier:
         zero_sc = np.zeros(limbs.NLIMBS, dtype=np.uint32)
         native = _FRNATIVE is not None
         fused = params.tables_t_all is not None
+        # XLA/CPU twin of the Pallas split: lazified madd planes for the
+        # FIXED-base tails, when the affine table flavor is available
+        mixed_planes = None if fused else _exact_mixed_planes(params)
+        split_fixed = fused or mixed_planes is not None
 
         eq1_pt_rows, eq1_sc_rows = [], []
         eq2_pt_rows, eq2_sc_rows = [], []
@@ -1901,7 +2196,7 @@ class BatchRangeVerifier:
         for i in live:
             eq = equations[i]
             d = proofs[i].data
-            if fused:
+            if split_fixed:
                 # fixed generators ride the Pallas per-lane fixed-base MSM
                 # (tables index order: G.., H.., P, Q | cg0, cg1);
                 # only the per-proof points stay variable-base
@@ -1920,7 +2215,7 @@ class BatchRangeVerifier:
                     + proofs[i].ipa.L + proofs[i].ipa.R)
             if native:
                 f, v = eq.fixed_packed, eq.var_packed
-                if fused:
+                if split_fixed:
                     f2_sc_rows.append(f[:(2 * n + 2) * 32])
                     f1_sc_rows.append(f[(2 * n + 2) * 32:(2 * n + 4) * 32])
                     eq1_sc_rows.append(v[-3 * 32:])
@@ -1931,7 +2226,7 @@ class BatchRangeVerifier:
                     eq2_sc_rows.append(f[:(2 * n + 2) * 32] + v[:2 * 32]
                                        + v[2 * 32:(2 + 2 * rr) * 32])
             else:
-                if fused:
+                if split_fixed:
                     f2_sc_rows.append(eq.fixed[:2 * n + 2])
                     f1_sc_rows.append(eq.fixed[2 * n + 2:2 * n + 4])
                     eq1_sc_rows.append([eq.var[-3], eq.var[-2],
@@ -1966,9 +2261,7 @@ class BatchRangeVerifier:
         eq2_pts_np, eq2_sc_np = _pad_terms(
             eq2_pts_np, eq2_sc_np, _next_pow2(n_eq2))
 
-        if fused:
-            from ..ops import pallas_fb
-
+        if split_fixed:
             if native:
                 f2_np = limbs.packed_to_limbs(b"".join(f2_sc_rows)).reshape(
                     len(live), 2 * n + 2, limbs.NLIMBS)
@@ -1979,14 +2272,25 @@ class BatchRangeVerifier:
                     [limbs.scalars_to_limbs(rw) for rw in f2_sc_rows])
                 f1_np = np.stack(
                     [limbs.scalars_to_limbs(rw) for rw in f1_sc_rows])
+            f2_sc_dev = jnp.asarray(_pad_rows(f2_np, b_bucket, zero_sc))
+            f1_sc_dev = jnp.asarray(_pad_rows(f1_np, b_bucket, zero_sc))
+        if fused:
+            from ..ops import pallas_fb
+
             f2_pt = pallas_fb.fixed_base_msm_fused(
-                params.tables_t_all[:2 * n + 2],
-                jnp.asarray(_pad_rows(f2_np, b_bucket, zero_sc)))
+                params.tables_t_all[:2 * n + 2], f2_sc_dev)
             f1_pt = pallas_fb.fixed_base_msm_fused(
-                params.tables_t_all[2 * n + 2:2 * n + 4],
-                jnp.asarray(_pad_rows(f1_np, b_bucket, zero_sc)))
+                params.tables_t_all[2 * n + 2:2 * n + 4], f1_sc_dev)
             accept = np.asarray(_exact_var_tail_kernel(
                 f1_pt, f2_pt,
+                jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
+                jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),
+                jnp.asarray(_pad_rows(eq2_sc_np, b_bucket, zero_sc))))
+        elif mixed_planes is not None:
+            planes_f2, planes_f1 = mixed_planes
+            accept = np.asarray(_exact_mixed_tail_kernel(
+                planes_f2, planes_f1, f2_sc_dev, f1_sc_dev,
                 jnp.asarray(_pad_rows(eq1_pts_np, b_bucket, id_pt)),
                 jnp.asarray(_pad_rows(eq1_sc_np, b_bucket, zero_sc)),
                 jnp.asarray(_pad_rows(eq2_pts_np, b_bucket, id_pt)),

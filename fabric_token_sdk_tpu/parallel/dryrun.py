@@ -253,9 +253,12 @@ def monitor(n_devices: int, light: bool = False,
     substitute a scripted child); the default runs this module as the
     worker.
 
-    The returned dict always has non-empty ``phase`` and, after any
-    output, non-empty ``tail`` — ``rc=124 with an empty report`` cannot
-    happen by construction.
+    The returned dict always has non-empty ``phase`` and a non-empty
+    ``tail`` (seeded with the phase + diagnosis when the worker produced
+    no output at all) — ``rc=124 with an empty report`` cannot happen by
+    construction. ``total_timeout_s`` bounds the WHOLE run on top of the
+    per-phase stall deadlines; hitting it is reported as a stall with a
+    budget-exceeded diagnosis.
     """
     from ..obs.heartbeat import FileHeartbeatReader, StallDetector
     from ..obs.journal import JOURNAL, configure_from_env
@@ -312,6 +315,7 @@ def monitor(n_devices: int, light: bool = False,
         proc = subprocess.Popen(child_argv, cwd=_REPO_ROOT, env=child_env,
                                 stdout=log_f, stderr=subprocess.STDOUT)
     stall: tuple[str, float] | None = None
+    total_hit = False
     try:
         while True:
             rc = proc.poll()
@@ -331,6 +335,7 @@ def monitor(n_devices: int, light: bool = False,
             if (total_timeout_s is not None
                     and now - t0 > total_timeout_s):
                 stall = (report["phase"], now - t0)
+                total_hit = True
                 break
             hit = detector.check()
             if hit is not None:
@@ -369,10 +374,17 @@ def monitor(n_devices: int, light: bool = False,
         report["ok"] = False
         report["phase"] = phase
         report["last_heartbeat_age_s"] = round(age, 3)
-        report["diagnosis"] = (
-            f"stalled in phase {phase!r}: no heartbeat for "
-            f"{age:.1f}s (deadline {detector.deadline_for(phase):.0f}s); "
-            f"worker killed, stacks in tail")
+        if total_hit:
+            report["diagnosis"] = (
+                f"total dryrun budget exceeded in phase {phase!r} "
+                f"({age:.1f}s > total_timeout_s={total_timeout_s:.0f}s); "
+                f"worker killed, stacks in tail")
+        else:
+            report["diagnosis"] = (
+                f"stalled in phase {phase!r}: no heartbeat for "
+                f"{age:.1f}s (deadline "
+                f"{detector.deadline_for(phase):.0f}s); "
+                f"worker killed, stacks in tail")
         JOURNAL.incident("multichip_stall", reason=report["diagnosis"],
                          extra={"report": report_path,
                                 "phase": phase, "rc": rc})
@@ -381,6 +393,14 @@ def monitor(n_devices: int, light: bool = False,
         report["diagnosis"] = (
             "completed" if rc == 0 else
             f"worker exited rc={rc} in phase {report['phase']!r}")
+    if not report["tail"]:
+        # the empty-tail rc=124 reports are what this monitor exists to
+        # prevent: if the worker really produced no output (died before
+        # its first print, unreadable log), the tail still names the
+        # phase + diagnosis so the artifact is never blank
+        report["tail"] = (f"<no worker output captured> phase="
+                          f"{report['phase']!r} rc={rc} "
+                          f"diagnosis={report['diagnosis']!r}")
     _write_report(report_path, report)
     return report
 

@@ -56,6 +56,13 @@ class ServeConfig:
         ``serve.request`` span (admission → queue wait → linked batch
         dispatch → verdict) under its own trace id. 1 traces everything,
         0 disables request tracing (batch-level spans remain).
+    n_lanes: number of DEVICE dispatch lanes (distinct from the
+        ``lanes`` priority lanes): each dispatch lane owns one device or
+        mesh shard with its own executor thread and prewarm inventory,
+        so up to ``n_lanes`` batches are in flight concurrently — the
+        continuous-batching frontend feeds every device instead of
+        serializing on one dispatcher thread. 1 (the default) preserves
+        the single-dispatcher behaviour exactly.
     """
 
     buckets: tuple = tuple(b for b in B_BUCKETS if b <= 1024)
@@ -67,6 +74,7 @@ class ServeConfig:
     prewarm_block: bool = False
     lanes: tuple = LANES
     trace_every: int = 1
+    n_lanes: int = 1
 
     def __post_init__(self):
         if not self.buckets:
@@ -75,6 +83,8 @@ class ServeConfig:
             raise ValueError("ServeConfig.buckets must be ascending")
         if self.min_batch > self.max_batch:
             raise ValueError("min_batch exceeds max(buckets)")
+        if self.n_lanes < 1:
+            raise ValueError("ServeConfig.n_lanes must be >= 1")
 
     @property
     def max_batch(self) -> int:

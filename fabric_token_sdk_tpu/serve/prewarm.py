@@ -35,11 +35,20 @@ from .config import ServeConfig
 
 
 class PrewarmManager:
-    """Compiles the configured bucket ladder through a ZKVerifier."""
+    """Compiles the configured bucket ladder through a ZKVerifier.
 
-    def __init__(self, zk, config: ServeConfig):
+    One manager per DEVICE dispatch lane (``lane`` is the lane index):
+    each lane keeps its own ``ready`` inventory and per-bucket compile
+    accounting, so a multi-lane service can assert every lane compiled
+    every emittable bucket before its first dispatch. Lanes sharing one
+    in-process verifier still pay each compile only once (the jit cache
+    is per-executable, not per-manager); lanes holding per-device
+    verifiers each warm their own device."""
+
+    def __init__(self, zk, config: ServeConfig, lane: int = 0):
         self.zk = zk
         self.config = config
+        self.lane = lane
         self.compile_s: dict[int, float] = {}
         self.ready: set[int] = set()
         self.total_s: float = 0.0
@@ -64,24 +73,26 @@ class PrewarmManager:
                 pass  # cache is an optimization, never a startup failure
         with _TRACER.span("serve.prewarm",
                           buckets=tuple(self.config.buckets),
+                          lane=self.lane,
                           block=self.config.prewarm_block):
             for bucket in self.config.buckets:
                 if bucket in self.ready:
                     continue
                 JOURNAL.record(EVENT_COMPILE_START, what="serve_prewarm",
-                               bucket=bucket)
+                               bucket=bucket, lane=self.lane)
                 per_shape = self.zk.prewarm_shapes(
                     (bucket,), include_block=self.config.prewarm_block)
                 elapsed = per_shape[bucket]
                 JOURNAL.record(EVENT_COMPILE_END, what="serve_prewarm",
-                               bucket=bucket,
+                               bucket=bucket, lane=self.lane,
                                elapsed_s=round(elapsed, 3))
                 self.compile_s[bucket] = elapsed
                 self.ready.add(bucket)
                 _METRICS.histogram(
                     "serve_prewarm_seconds",
                     help="Per-bucket prewarm compile wall at service start",
-                    bucket=str(bucket)).observe(elapsed)
+                    bucket=str(bucket),
+                    lane=str(self.lane)).observe(elapsed)
                 # profiling telemetry: compile wall + AOT cost analysis of
                 # the dominant kernel at this bucket (lowering only; a
                 # backend without kernel_cost contributes nothing)

@@ -63,6 +63,7 @@ class VerifyResult:
     bucket: int = 0           # scheduler bucket the serving batch filled
     batch_rows: int = 0       # live rows in the serving batch
     served_by: str = ""       # "device" | "host" (fallback); "" if unserved
+    device_lane: int = -1     # dispatch lane that served it; -1 if unserved
 
     @property
     def ok(self) -> bool:

@@ -47,6 +47,24 @@ class BucketScheduler:
         self.config = config
         self._queues: dict[tuple, deque] = {
             (g, lane): deque() for g in GROUPS for lane in config.lanes}
+        # device-lane assignment state: last-emission stamp per dispatch
+        # lane index (pick_lane round-robins over the idle ones)
+        self._lane_stamp: dict[int, int] = {}
+        self._stamp = 0
+
+    # ------------------------------------------------------- device lanes
+    def pick_lane(self, idle: list[int]) -> int | None:
+        """Device dispatch lane for the next emitted bucket: the least-
+        recently-used of the currently idle lanes (round-robin when all
+        are fresh), so consecutive batches spread across every device
+        instead of re-feeding lane 0. Returns None when no lane is idle
+        — the service then sleeps until a lane completes."""
+        if not idle:
+            return None
+        lane = min(idle, key=lambda i: (self._lane_stamp.get(i, -1), i))
+        self._stamp += 1
+        self._lane_stamp[lane] = self._stamp
+        return lane
 
     # ------------------------------------------------------------- queues
     def push(self, req: VerifyRequest) -> None:
@@ -99,11 +117,17 @@ class BucketScheduler:
         return (min(r.enqueue_t + cfg.max_wait_s for r in heads),
                 min(r.deadline - cfg.service_estimate_s for r in heads))
 
-    def next_event(self, now: float | None = None) -> float | None:
+    def next_event(self, now: float | None = None,
+                   include_dispatch: bool = True) -> float | None:
         """Earliest future instant a dispatch or expiry becomes due, or
-        None when nothing is queued (the service sleeps until a push)."""
+        None when nothing is queued (the service sleeps until a push).
+
+        ``include_dispatch=False`` restricts the horizon to deadline
+        EXPIRY instants only — what the service needs while every
+        dispatch lane is busy (a dispatch-due instant in the past would
+        otherwise hot-spin the loop until a lane frees)."""
         instants = []
-        for g in GROUPS:
+        for g in GROUPS if include_dispatch else ():
             due = self._due_instants(g)
             if due is None:
                 continue

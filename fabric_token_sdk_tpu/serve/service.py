@@ -9,12 +9,17 @@ for transfer/issue actions), and demultiplexes the per-row verdicts back
 to each caller's future — bit-identically to what a direct call on the
 same payload would return.
 
-Threading model: all scheduler/queue state lives on the event loop; the
-blocking device call runs on a dedicated single-thread executor (owned by
-the resilience watchdog) via ``run_in_executor``, so exactly one batch is
-in flight at a time and arrivals keep queueing while the device works
-(continuous batching). Futures resolve on the event loop after the
-executor returns — no cross-thread future writes.
+Threading model: all scheduler/queue state lives on the event loop; each
+blocking device call runs on a DISPATCH LANE's dedicated single-thread
+executor (owned by that lane's resilience watchdog) via
+``run_in_executor``. A lane owns one device or mesh shard
+(``lane_verifiers``) with its own prewarm inventory; exactly one batch
+is in flight per lane, and up to ``ServeConfig.n_lanes`` lanes serve
+concurrently, so the continuous-batching frontend feeds every device
+instead of serializing on one dispatcher thread (``n_lanes=1``, the
+default, preserves the historical single-dispatcher behaviour exactly).
+Futures resolve on the event loop after the executor returns — no
+cross-thread future writes.
 
 Failure handling (resilience/): with a :class:`ResilienceConfig` the
 dispatch is wrapped in retry (transient errors, seeded decorrelated
@@ -86,6 +91,39 @@ _SERVE_FAMILIES = {
         "Batches served by the host fallback path, by group",
 }
 
+#: Per-device dispatch-lane families (ServeConfig.n_lanes > 1 feeds all
+#: devices concurrently); new stable families, never renamed.
+_LANE_FAMILIES = {
+    "lane_dispatch_total": "Batches dispatched per device dispatch lane",
+    "lane_rows_total": "Live rows dispatched per device dispatch lane",
+    "lane_busy_seconds":
+        "Wall seconds a device dispatch lane spent serving batches",
+    "lane_inflight": "Batches in flight per device dispatch lane (0/1)",
+}
+
+
+class _DispatchLane:
+    """One device dispatch lane: its own executor thread (the watchdog
+    owns it), its own verifier handle (one device or mesh shard when the
+    caller passes ``lane_verifiers``), its own prewarm inventory, and
+    its dispatch accounting. Exactly one batch is in flight per lane;
+    ``VerificationService`` runs up to ``n_lanes`` lanes concurrently."""
+
+    def __init__(self, index: int, zk, config: ServeConfig,
+                 resilience: ResilienceConfig | None):
+        self.index = index
+        self.zk = zk
+        self.watchdog = DispatchWatchdog(
+            timeout_s=(resilience.watchdog_timeout_s
+                       if resilience is not None else None),
+            thread_name_prefix=f"serve-lane{index}")
+        self.prewarm = PrewarmManager(zk, config, lane=index)
+        self.busy = False
+        self.inflight: list[VerifyRequest] = []
+        self.dispatches = 0
+        self.rows = 0
+        self.busy_s = 0.0
+
 
 class VerificationService:
     """Continuous-batching frontend over a ``ZKVerifier``.
@@ -110,7 +148,8 @@ class VerificationService:
 
     def __init__(self, zk, config: ServeConfig | None = None,
                  resilience: ResilienceConfig | None = None,
-                 fallback=None, slo=None, wal=None):
+                 fallback=None, slo=None, wal=None,
+                 lane_verifiers: list | None = None):
         self.zk = zk
         self.wal = wal
         #: (wal_id, VerifyResult) pairs replayed at the last ``start()``.
@@ -120,14 +159,29 @@ class VerificationService:
         self.slo = slo
         self.scheduler = BucketScheduler(self.config)
         self.admission = AdmissionController(self.config)
-        self.prewarm = PrewarmManager(zk, self.config)
+        for fam, help_text in {**_SERVE_FAMILIES,
+                               **_LANE_FAMILIES}.items():
+            _METRICS.describe(fam, help_text)
+        # device dispatch lanes: lane i serves lane_verifiers[i] (a
+        # per-device / per-mesh-shard verifier) or the shared zk when the
+        # caller passes none — each lane still gets its OWN executor
+        # thread, so batches overlap even on one shared backend handle
+        n_lanes = self.config.n_lanes
+        if lane_verifiers is not None and len(lane_verifiers) != n_lanes:
+            raise ValueError(
+                f"lane_verifiers has {len(lane_verifiers)} entries, "
+                f"config.n_lanes is {n_lanes}")
+        zks = (list(lane_verifiers) if lane_verifiers is not None
+               else [zk] * n_lanes)
+        self._lanes = [_DispatchLane(i, zks[i], self.config, resilience)
+                       for i in range(n_lanes)]
+        self._lane_tasks: set[asyncio.Task] = set()
+        # single-lane compat surfaces (tests, statusz, bench): lane 0's
+        # prewarm inventory and watchdog keep their historical names
+        self.prewarm = self._lanes[0].prewarm
+        self._watchdog = self._lanes[0].watchdog
         self.prewarm_s: float | None = None
         self.first_dispatch_t: float | None = None
-        for fam, help_text in _SERVE_FAMILIES.items():
-            _METRICS.describe(fam, help_text)
-        self._watchdog = DispatchWatchdog(
-            timeout_s=(resilience.watchdog_timeout_s
-                       if resilience is not None else None))
         if resilience is not None:
             self._retry = resilience.build_retry_policy(op="serve_dispatch")
             self._breaker = resilience.build_breaker(name="device")
@@ -138,17 +192,17 @@ class VerificationService:
             self._retry = None
             self._breaker = None
         self._fallback = fallback
-        self._inflight: list[VerifyRequest] = []
         self._task: asyncio.Task | None = None
         self._wake: asyncio.Event | None = None
         self._running = False
         # (group, bucket) shapes already dispatched/prewarmed — the basis
         # of the profile_compile_cache_total hit/miss classification
         self._warm_shapes: set[tuple] = set()
-        # the in-flight batch's span: exactly one batch is in flight at a
-        # time, and the executor thread cannot see the event loop's
-        # contextvars, so explicit hand-off is both safe and required
-        self._batch_span = None
+
+    @property
+    def _inflight(self) -> list:
+        """Every in-flight request across all dispatch lanes."""
+        return [r for lane in self._lanes for r in lane.inflight]
 
     @property
     def breaker(self):
@@ -167,9 +221,16 @@ class VerificationService:
         loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
         if prewarm:
-            # no watchdog here: first-compile legitimately takes minutes
-            self.prewarm_s = await loop.run_in_executor(
-                self._watchdog.executor, self.prewarm.run)
+            # no watchdog here: first-compile legitimately takes minutes.
+            # Lanes warm SEQUENTIALLY: concurrent first-compiles of the
+            # same shapes just contend (same jit cache on a shared
+            # verifier; one compiler on the gate host either way), and
+            # lanes past 0 on a shared verifier hit the warm cache.
+            total = 0.0
+            for lane in self._lanes:
+                total += await loop.run_in_executor(
+                    lane.watchdog.executor, lane.prewarm.run)
+            self.prewarm_s = total
         self._running = True
         self._task = asyncio.create_task(self._dispatch_loop())
         if self.wal is not None:
@@ -220,6 +281,8 @@ class VerificationService:
         if not self._running:
             return
         self._running = False
+        for t in list(self._lane_tasks):
+            t.cancel()
         if self._task is not None:
             self._task.cancel()
             try:
@@ -259,6 +322,8 @@ class VerificationService:
                         status=STATUS_SHUTDOWN,
                         error=f"service stopped after {timeout_s}s drain "
                               "timeout"))
+                for t in list(self._lane_tasks):
+                    t.cancel()
                 self._task.cancel()
                 try:
                     await self._task
@@ -338,31 +403,45 @@ class VerificationService:
             now = time.perf_counter()
             for req in self.scheduler.expire(now):
                 self._complete_expired(req, now)
-            batch = self.scheduler.assemble(now)
-            if batch:
+            # Feed every idle device lane: each assembled batch launches
+            # as its own task on the least-recently-used idle lane, so up
+            # to n_lanes batches overlap (continuous batching across all
+            # devices). The loop itself never blocks on a device call.
+            launched = False
+            while True:
+                idle = [lane for lane in self._lanes if not lane.busy]
+                if not idle:
+                    break
+                batch = self.scheduler.assemble(now)
+                if not batch:
+                    break
                 if self.first_dispatch_t is None:
                     self.first_dispatch_t = now
-                self._inflight = batch
-                try:
-                    verdicts, served_by = await self._dispatch(batch)
-                except Exception as exc:  # noqa: BLE001 — fail the batch, not the loop
-                    msg = f"{type(exc).__name__}: {exc}"
-                    for req in batch:
-                        self._resolve(req, VerifyResult(
-                            status=STATUS_ERROR, error=msg))
-                else:
-                    self._demux(batch, verdicts, dispatch_t=now,
-                                served_by=served_by)
-                finally:
-                    self._inflight = []
+                lane_idx = self.scheduler.pick_lane(
+                    [lane.index for lane in idle])
+                lane = self._lanes[lane_idx]
+                lane.busy = True
+                lane.inflight = list(batch)
+                task = asyncio.create_task(
+                    self._run_lane(lane, batch, now))
+                self._lane_tasks.add(task)
+                task.add_done_callback(self._lane_tasks.discard)
+                launched = True
+            if launched:
                 continue
-            if not self._running and self.scheduler.depth() == 0:
+            if not self._running and self.scheduler.depth() == 0 \
+                    and not any(lane.busy for lane in self._lanes):
                 return
-            nxt = self.scheduler.next_event(time.perf_counter())
+            # With every lane busy, only EXPIRY instants matter: a
+            # dispatch-due instant in the past would hot-spin the loop
+            # until a lane frees (the lane's completion sets _wake).
+            idle_any = any(not lane.busy for lane in self._lanes)
+            nxt = self.scheduler.next_event(time.perf_counter(),
+                                            include_dispatch=idle_any)
             self._wake.clear()
             # Re-check after clear: a push between assemble() and clear()
             # would otherwise sleep through its max-wait window.
-            if self.scheduler.depth() and nxt is None:
+            if self.scheduler.depth() and nxt is None and idle_any:
                 continue
             try:
                 if nxt is None:
@@ -373,7 +452,42 @@ class VerificationService:
             except asyncio.TimeoutError:
                 pass
 
-    async def _dispatch(self, batch: list[VerifyRequest]):
+    async def _run_lane(self, lane: _DispatchLane,
+                        batch: list[VerifyRequest], now: float) -> None:
+        """One batch through one device dispatch lane, as its own task:
+        dispatch, demux, lane accounting, then wake the loop so the
+        freed lane is refilled immediately."""
+        lane_lbl = str(lane.index)
+        _METRICS.gauge("lane_inflight", lane=lane_lbl).set(1)
+        t0 = time.perf_counter()
+        try:
+            verdicts, served_by = await self._dispatch(batch, lane)
+        except Exception as exc:  # noqa: BLE001 — fail the batch, not the lane
+            msg = f"{type(exc).__name__}: {exc}"
+            for req in batch:
+                self._resolve(req, VerifyResult(
+                    status=STATUS_ERROR, error=msg))
+        else:
+            self._demux(batch, verdicts, dispatch_t=now,
+                        served_by=served_by, lane=lane.index)
+        finally:
+            busy_s = time.perf_counter() - t0
+            lane.busy = False
+            lane.inflight = []
+            lane.dispatches += 1
+            lane.rows += len(batch)
+            lane.busy_s += busy_s
+            _METRICS.counter("lane_dispatch_total", lane=lane_lbl).add()
+            _METRICS.counter("lane_rows_total",
+                             lane=lane_lbl).add(len(batch))
+            _METRICS.counter("lane_busy_seconds",
+                             lane=lane_lbl).add(busy_s)
+            _METRICS.gauge("lane_inflight", lane=lane_lbl).set(0)
+            if self._wake is not None:
+                self._wake.set()
+
+    async def _dispatch(self, batch: list[VerifyRequest],
+                        lane: _DispatchLane | None = None):
         """One batch through the resilient device path, under a shared
         ``serve.batch`` span cross-linked with every member request's
         span (the OpenTelemetry link pattern for fan-in: N request traces
@@ -381,34 +495,36 @@ class VerificationService:
 
         Returns ``(verdicts, served_by)``.
         """
+        if lane is None:
+            lane = self._lanes[0]
         group = batch[0].group
         bucket = self.config.bucket_for(len(batch))
         warm_key = (group, bucket)
         # compile-cache classification: prewarm covers range buckets (and
         # block shapes when prewarm_block); anything else is warm only
         # after its first dispatch
-        prewarmed = bucket in self.prewarm.ready and (
+        prewarmed = bucket in lane.prewarm.ready and (
             group == KIND_RANGE or self.config.prewarm_block)
         PROFILER.record_cache_event(
             "serve_dispatch", hit=prewarmed
             or warm_key in self._warm_shapes)
         self._warm_shapes.add(warm_key)
         bspan = _TRACER.start_span("serve.batch", group=group,
-                                   rows=len(batch), bucket=bucket)
+                                   rows=len(batch), bucket=bucket,
+                                   lane=lane.index)
         for req in batch:
             if req.span is not None:
                 bspan.add_link(req.span, role="member")
                 req.span.add_link(bspan, role="batch")
-        self._batch_span = bspan
         JOURNAL.record(EVENT_BATCH_FORMED, group=group, rows=len(batch),
                        bucket=bucket, span_id=bspan.span_id)
         JOURNAL.record(EVENT_DISPATCH_START, group=group,
-                       rows=len(batch), bucket=bucket,
+                       rows=len(batch), bucket=bucket, lane=lane.index,
                        span_id=bspan.span_id)
         outcome = "error"
         try:
-            verdicts, served_by = await self._dispatch_resilient(batch,
-                                                                 bspan)
+            verdicts, served_by = await self._dispatch_resilient(
+                batch, bspan, lane)
             bspan.set_attribute("served_by", served_by)
             outcome = served_by
             return verdicts, served_by
@@ -420,18 +536,19 @@ class VerificationService:
             JOURNAL.record(EVENT_DISPATCH_END, group=group,
                            rows=len(batch), span_id=bspan.span_id,
                            outcome=outcome)
-            self._batch_span = None
             _TRACER.end_span(bspan)
             PROFILER.record_memory_watermark()
 
     async def _dispatch_resilient(self, batch: list[VerifyRequest],
-                                  bspan):
-        """Attempt order: device call (watchdog-bounded) with retry on
+                                  bspan, lane: _DispatchLane):
+        """Attempt order: device call (watchdog-bounded, on the LANE's
+        executor thread against the lane's verifier) with retry on
         transient errors while the breaker admits traffic; then the host
         fallback; then raise the last error (the batch completes with
         ``status="error"``)."""
         if self.resilience is None:
-            return (await self._watchdog.run(self._run_batch, batch),
+            return (await lane.watchdog.run(self._run_batch, batch,
+                                            bspan, lane),
                     SERVED_BY_DEVICE)
         last_exc: Exception | None = None
         delays = self._retry.delays()
@@ -439,7 +556,8 @@ class VerificationService:
             if not self._breaker.allow():
                 break
             try:
-                verdicts = await self._watchdog.run(self._run_batch, batch)
+                verdicts = await lane.watchdog.run(self._run_batch, batch,
+                                                   bspan, lane)
             except Exception as exc:  # noqa: BLE001 — classified below
                 self._breaker.record_failure()
                 last_exc = exc
@@ -464,7 +582,7 @@ class VerificationService:
             with _TRACER.span("resil.fallback", parent=bspan, group=group,
                               rows=len(batch)):
                 verdicts = await asyncio.get_running_loop().run_in_executor(
-                    self._watchdog.executor,
+                    lane.watchdog.executor,
                     self._fallback.verify_batch, batch)
             _METRICS.counter("resil_fallback_batches_total",
                              group=group).add()
@@ -475,23 +593,25 @@ class VerificationService:
             "circuit breaker open and no host fallback configured")
 
     # ----------------------------------------------------- device batches
-    def _run_batch(self, batch: list[VerifyRequest]) -> np.ndarray:
-        """Runs on the executor thread: one blocking device call.
+    def _run_batch(self, batch: list[VerifyRequest], bspan,
+                   lane: _DispatchLane) -> np.ndarray:
+        """Runs on the lane's executor thread: one blocking device call
+        against the lane's verifier.
 
         Returns a bool vector aligned with ``batch`` order.
         """
         group = batch[0].group
         t0 = time.perf_counter()
-        # explicit parent: contextvars do not cross run_in_executor, and
-        # exactly one batch is in flight, so _batch_span is unambiguous
-        with _TRACER.span("serve.dispatch", parent=self._batch_span,
+        # explicit parent: contextvars do not cross run_in_executor, so
+        # the batch span is threaded through as an argument
+        with _TRACER.span("serve.dispatch", parent=bspan,
                           group=group, rows=len(batch),
                           bucket=self.config.bucket_for(len(batch))):
             if group == KIND_RANGE:
                 proofs = [r.payload[0] for r in batch]
                 coms = [r.payload[1] for r in batch]
                 verdicts = np.asarray(
-                    self.zk._range.verify(proofs, coms), dtype=bool)
+                    lane.zk._range.verify(proofs, coms), dtype=bool)
             else:
                 transfers, issues, slots = [], [], []
                 for r in batch:
@@ -501,7 +621,7 @@ class VerificationService:
                     else:
                         slots.append((1, len(issues)))
                         issues.append(r.payload)
-                t_ok, i_ok = self.zk.verify_block(transfers, issues)
+                t_ok, i_ok = lane.zk.verify_block(transfers, issues)
                 t_ok = np.asarray(t_ok, dtype=bool).reshape(-1)
                 i_ok = np.asarray(i_ok, dtype=bool).reshape(-1)
                 verdicts = np.asarray(
@@ -514,7 +634,7 @@ class VerificationService:
 
     # -------------------------------------------------------- completion
     def _demux(self, batch, verdicts, dispatch_t: float,
-               served_by: str = SERVED_BY_DEVICE) -> None:
+               served_by: str = SERVED_BY_DEVICE, lane: int = 0) -> None:
         now = time.perf_counter()
         rows = len(batch)
         bucket = self.config.bucket_for(rows)
@@ -535,7 +655,8 @@ class VerificationService:
                 status=status, accepted=bool(acc),
                 wait_s=dispatch_t - req.enqueue_t,
                 total_s=now - req.enqueue_t,
-                bucket=bucket, batch_rows=rows, served_by=served_by))
+                bucket=bucket, batch_rows=rows, served_by=served_by,
+                device_lane=lane))
 
     def _complete_expired(self, req: VerifyRequest, now: float) -> None:
         _METRICS.counter("serve_deadline_miss_total",
@@ -592,6 +713,14 @@ class VerificationService:
             "queue_depth": {lane: self.scheduler.lane_depth(lane)
                             for lane in self.config.lanes},
             "inflight_rows": len(self._inflight),
+            "lanes": [{
+                "index": lane.index,
+                "busy": lane.busy,
+                "dispatches": lane.dispatches,
+                "rows": lane.rows,
+                "busy_s": round(lane.busy_s, 3),
+                "prewarm_ready": sorted(lane.prewarm.ready),
+            } for lane in self._lanes],
             "prewarm": {
                 "ready": sorted(self.prewarm.ready),
                 "compile_s": {str(b): round(s, 3) for b, s in

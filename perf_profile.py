@@ -36,6 +36,12 @@ Modes (--mode):
            pipeline a chunk must cost exactly 1 packed upload + 1 fused
            dispatch; FTS_NO_FUSED_PIPELINE=1 re-runs the audit on the
            legacy split pipeline for the before/after.
+  mesh     multi-chip scaling audit: the sharded verify() over a
+           (dp, tp) mesh must keep the SAME per-chunk contract (1 packed
+           upload + 1 fused sharded dispatch + 1 finalize per verify),
+           produce verdicts bit-identical to the single-device verifier,
+           and reports the single-vs-mesh wall ratio. On CPU, 8 virtual
+           host devices are forced automatically (JAX_PLATFORMS=cpu).
 
 Output: human-readable table on stderr, one JSON document on stdout.
 --trace <path> additionally writes the span tree as Chrome trace-event
@@ -50,6 +56,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -158,8 +165,8 @@ def _mode_barrier(args, tracer, records) -> dict:
 
     dispatch = verifier._dispatch_pass1
 
-    def fenced_dispatch(pfs, cms, ch):
-        st = dispatch(pfs, cms, ch)      # a rv._ChunkStage
+    def fenced_dispatch(pfs, cms, ch, prev=None):
+        st = dispatch(pfs, cms, ch, prev)    # a rv._ChunkStage
         jax.block_until_ready(
             [x for x in (st.digests_dev, st.rdig_dev, st.pts_dev,
                          st.partial) if hasattr(x, "dtype")])
@@ -318,18 +325,22 @@ def _mode_pipeline(args, tracer, records) -> dict:
     n_chunks = max(1, rec.chunks if rec is not None else 1) * args.reps
     per_chunk = {k: counts[k] / n_chunks
                  for k in ("chunk_upload", "chunk_dispatch")}
-    fused_on = rv._fused_pipeline_enabled() and verifier.mesh is None
+    fused_on = rv._fused_pipeline_enabled()
     doc["dispatch_counts"] = dict(counts)
     doc["chunks_counted"] = n_chunks
     doc["per_chunk"] = per_chunk
     doc["fused_pipeline"] = fused_on
+    doc["finalize_per_verify"] = counts["finalize"] / args.reps
     print(f"{n_chunks} chunks: {per_chunk['chunk_upload']:.2f} uploads + "
           f"{per_chunk['chunk_dispatch']:.2f} dispatches per chunk, "
-          f"{counts['finalize']} finalize folds "
+          f"{counts['finalize']} finalize folds over {args.reps} verifies "
           f"(fused_pipeline={fused_on})", file=sys.stderr)
     if fused_on:
         assert per_chunk["chunk_upload"] == 1.0, per_chunk
         assert per_chunk["chunk_dispatch"] == 1.0, per_chunk
+        # finalize is folded ACROSS chunks: exactly one O(1) total-fold
+        # dispatch per verify, however many chunks the batch split into
+        assert counts["finalize"] == args.reps, counts
 
     if rec is not None:
         tot = rec.total_s or 1.0
@@ -376,16 +387,121 @@ def _mode_pipeline(args, tracer, records) -> dict:
     return doc
 
 
+def _mode_mesh(args, tracer, records) -> dict:
+    """Multi-chip scaling audit: the fused-chunk dispatch contract under
+    a (dp, tp) mesh (round 8).
+
+    Three artifacts:
+      1. Dispatch/upload counts per chunk from the production sharded
+         verify(): the mesh path must keep the merged-pipeline contract
+         — exactly ONE packed upload + ONE fused sharded program per
+         chunk plus ONE O(1) finalize per verify — i.e. sharding must
+         not reintroduce the per-stage dispatch ladder it replaced.
+      2. Verdict parity: the sharded verifier's verdict vector must be
+         bit-identical to the single-device verifier on the same corpus.
+      3. A scaling estimate: single-device wall vs mesh wall at the same
+         batch (honest on a real multi-chip; on CPU the 8 'devices' are
+         virtual threads on the same cores, so the ratio only checks the
+         mesh path is not pathologically slower).
+
+    tp defaults to 2 (FTS_MESH_TP overrides; falls back to 1 when it
+    does not divide the device count).
+    """
+    import collections
+
+    import jax
+
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        raise SystemExit(
+            "--mode mesh needs more than one device (on CPU set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8, or let JAX_PLATFORMS"
+            "=cpu do it here)")
+    tp = int(os.environ.get("FTS_MESH_TP", "2"))
+    if n_dev % tp:
+        tp = 1
+    mesh = make_mesh(n_dev, dp=n_dev // tp, tp=tp)
+    pp, proofs, coms = _load_corpus(args.batch)
+    single = rv.BatchRangeVerifier(pp)
+    sharded = rv.BatchRangeVerifier(pp, mesh=mesh)
+    print(f"mesh {n_dev} devices (dp={n_dev // tp}, tp={tp}); "
+          "warm-up single-device verify (compiles)", file=sys.stderr)
+    base = single.verify(proofs, coms)
+    assert base.all()
+    print("warm-up sharded verify (compiles)", file=sys.stderr)
+    out = sharded.verify(proofs, coms)
+    assert (out == base).all(), \
+        "sharded verdicts diverge from the single-device path"
+
+    counts: collections.Counter = collections.Counter()
+    rv._DISPATCH_HOOK = lambda kind: counts.update((kind,))
+    try:
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            assert sharded.verify(proofs, coms).all()
+        mesh_wall = time.perf_counter() - t0
+    finally:
+        rv._DISPATCH_HOOK = None
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        assert single.verify(proofs, coms).all()
+    single_wall = time.perf_counter() - t0
+
+    doc = _report(tracer, "range_verify", records, mesh_wall,
+                  args.reps * args.batch, args.trace)
+    rec = records.last()
+    n_chunks = max(1, rec.chunks if rec is not None else 1) * args.reps
+    per_chunk = {k: counts[k] / n_chunks
+                 for k in ("chunk_upload", "chunk_dispatch")}
+    fused_on = rv._fused_pipeline_enabled()
+    doc.update({
+        "devices": n_dev, "dp": n_dev // tp, "tp": tp,
+        "fused_pipeline": fused_on,
+        "dispatch_counts": dict(counts),
+        "chunks_counted": n_chunks,
+        "per_chunk": per_chunk,
+        "finalize_per_verify": counts["finalize"] / args.reps,
+        "single_device_wall_s": round(single_wall, 4),
+        "mesh_wall_s": round(mesh_wall, 4),
+        "mesh_speedup": (round(single_wall / mesh_wall, 3)
+                         if mesh_wall else None)})
+    print(f"{n_chunks} sharded chunks: "
+          f"{per_chunk['chunk_upload']:.2f} uploads + "
+          f"{per_chunk['chunk_dispatch']:.2f} fused dispatches per chunk, "
+          f"{counts['finalize']} finalize folds over {args.reps} verifies; "
+          f"single {single_wall:.2f}s vs mesh {mesh_wall:.2f}s "
+          f"(x{single_wall / mesh_wall:.2f} over {n_dev} devices)",
+          file=sys.stderr)
+    if fused_on:
+        assert per_chunk["chunk_upload"] == 1.0, per_chunk
+        assert per_chunk["chunk_dispatch"] == 1.0, per_chunk
+        assert counts["finalize"] == args.reps, counts
+    return doc
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("range", "block", "barrier", "fold",
-                                       "pipeline"),
+                                       "pipeline", "mesh"),
                     default="range")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--trace", help="write Chrome trace-event JSON here")
     ap.add_argument("--xprof", help="jax.profiler trace dir for root spans")
     args = ap.parse_args()
+
+    if args.mode == "mesh":
+        # must land before the first backend touch: on CPU the host
+        # platform defaults to ONE device, and the flag is read at
+        # backend initialization
+        flags = os.environ.get("XLA_FLAGS", "")
+        if (os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                and "xla_force_host_platform_device_count" not in flags):
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
 
     from fabric_token_sdk_tpu.obs import RECORDS, TRACER
     from fabric_token_sdk_tpu.utils.jaxcfg import configure_jax_cache
@@ -395,7 +511,7 @@ def main() -> None:
         TRACER.profile_dir = args.xprof
     mode = {"range": _mode_range, "block": _mode_block,
             "barrier": _mode_barrier, "fold": _mode_fold,
-            "pipeline": _mode_pipeline}[args.mode]
+            "pipeline": _mode_pipeline, "mesh": _mode_mesh}[args.mode]
     doc = mode(args, TRACER, RECORDS)
     doc["mode"] = args.mode
     doc["batch"] = args.batch

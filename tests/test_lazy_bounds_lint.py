@@ -47,8 +47,9 @@ def test_linter_sees_the_lazy_boundaries():
     assert len(mixed) >= 2, found
     # the exact-pass tails consume the lazified MSM interior -> the
     # same-module closure + *_mixed-callee rule must surface them
+    # (_exact_mixed_tail_kernel is the round-8 lazified FIXED-base tail)
     for tail in ("_exact_pass_kernel", "_exact_var_tail_kernel",
-                 "_k_pass_kernel"):
+                 "_k_pass_kernel", "_exact_mixed_tail_kernel"):
         assert any(k.endswith(tail) for k in found), (tail, sorted(found))
     # and every one it found is currently clean
     assert all(info["normalizers"] for info in found.values()), found

@@ -22,6 +22,11 @@ Four layers of defense for the bit-identical-verdict contract:
      zero scalar, scalar one, duplicate point — in both the flat and the
      batched (exact-tail / fused-chunk) forms, plus the canonical-limb
      readback contract.
+  5. Oracle parity of the round-8 lazified FIXED-base mixed MSM
+     (ec.fixed_base_msm_mixed over affine byte-plane tables — the entry
+     the exact-pass _exact_mixed_tail_kernel consumes) over corner
+     scalars (zero, one, r-1, random) in flat and batched forms, plus
+     the same canonical readback contract.
 """
 
 import secrets
@@ -458,4 +463,56 @@ class TestVarMsmLazyParity:
             jnp.broadcast_to(scl, (B,) + scl.shape)))
         assert int(batched.max()) <= 0xFFFF
         for b in range(B):
+            assert (batched[b] == flat).all(), b
+
+
+# --------------------------------------------------------------------------
+# 5. round-8 lazified FIXED-base tails: oracle parity + canonical-out
+# --------------------------------------------------------------------------
+
+class TestFixedBaseMixedParity:
+    """ec.fixed_base_msm_mixed is the XLA entry the round-8 lazified
+    exact-pass FIXED-base tails (_exact_mixed_tail_kernel) consume: madd
+    window chains over the affine byte-plane tables (digit-0 entries
+    masked to identity), one normalize per chain, then the projective
+    cross-term tree. Parity vs the host oracle over the corner scalars
+    — zero, one, r-1, random — in both the flat and the batched
+    (exact-tail) forms is what keeps the FTS_EXACT_MIXED path's verdicts
+    bit-identical to the unfused exact pass."""
+
+    T = 2
+
+    @pytest.fixture(scope="class")
+    def tables(self):
+        pts = _rand_pts(self.T)
+        proj = jnp.asarray(L.points_to_projective_limbs(pts))
+        return pts, ec.fixed_base_affine_planes(proj)
+
+    def test_oracle_parity_corner_scalars(self, tables):
+        pts, aff = tables
+        rows = [
+            [0, secrets.randbelow(bn254.R)],         # zero scalar
+            [1, bn254.R - 1],                        # one + max scalar
+            [secrets.randbelow(bn254.R) for _ in range(self.T)],
+        ]
+        scl = jnp.asarray(np.stack([L.scalars_to_limbs(r) for r in rows]))
+        got = np.asarray(ec.fixed_base_msm_mixed(aff, scl))   # (B, 3, 16)
+        # readback boundary contract: fully canonical limbs
+        assert int(got.max()) <= 0xFFFF
+        for b, sc in enumerate(rows):
+            want = bn254.msm(pts, sc)
+            gp = L.projective_limbs_to_point(got[b])
+            assert not want.inf and _same(gp, want), b
+
+    def test_flat_matches_batched(self, tables):
+        """The flat (T, 16) scalar form and the batched (B, T, 16) form
+        the exact tails use must agree bit-for-bit row-by-row."""
+        _, aff = tables
+        sc = [secrets.randbelow(bn254.R) for _ in range(self.T)]
+        scl = jnp.asarray(L.scalars_to_limbs(sc))
+        flat = np.asarray(ec.fixed_base_msm_mixed(aff, scl))
+        batched = np.asarray(ec.fixed_base_msm_mixed(
+            aff, jnp.broadcast_to(scl, (2,) + scl.shape)))
+        assert int(flat.max()) <= 0xFFFF
+        for b in range(2):
             assert (batched[b] == flat).all(), b

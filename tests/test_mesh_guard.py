@@ -14,6 +14,8 @@ import json
 import sys
 import textwrap
 
+import pytest
+
 from fabric_token_sdk_tpu.parallel.dryrun import monitor
 
 # scripted child: beats phases over the monitor's heartbeat protocol
@@ -95,6 +97,65 @@ def test_monitor_child_that_never_beats_trips_no_heartbeat(tmp_path):
     assert report["stalled"] is True
     assert report["phase"] == "(no heartbeat)"
     assert "no beats ever" in report["tail"]
+
+
+def test_monitor_seeds_tail_when_worker_produced_no_output(tmp_path):
+    """A worker that dies before its first print must still leave a
+    non-empty tail naming the phase and diagnosis — the historical
+    ``rc=124, tail=""`` artifact is impossible by construction."""
+    report = _monitor(tmp_path, """
+        sys.exit(5)
+        """)
+    assert not report["ok"] and report["rc"] == 5
+    assert report["phase"] == "spawn"
+    assert "rc=5" in report["diagnosis"]
+    assert report["tail"], "tail must never be empty"
+    assert "no worker output captured" in report["tail"]
+    assert "rc=5" in report["tail"]
+    disk = json.loads((tmp_path / "report.json").read_text())
+    assert disk["tail"] == report["tail"]
+
+
+def test_monitor_total_timeout_bounds_a_healthy_looking_run(tmp_path):
+    """A worker that heartbeats forever (no per-phase stall ever fires)
+    must still be bounded by ``total_timeout_s`` — and the kill is
+    reported as a budget-exceeded stall, not a bare timeout."""
+    report = _monitor(tmp_path, """
+        print("beating forever", flush=True)
+        while True:
+            beat("verify"); time.sleep(0.1)
+        """, deadlines={"verify": 60.0}, total_timeout_s=1.5)
+    assert report["stalled"] is True and not report["ok"]
+    assert report["phase"] == "verify"
+    assert "total dryrun budget exceeded" in report["diagnosis"]
+    assert "total_timeout_s=2s" in report["diagnosis"]   # 1.5 -> :.0f
+    assert report["elapsed_s"] < 30.0
+    assert report["rc"] is not None and report["rc"] != 0
+    assert "beating forever" in report["tail"]
+
+
+@pytest.mark.slow
+def test_real_full_production_dryrun_on_8_simulated_devices(tmp_path):
+    """The full multichip dryrun: the production 16-bit verifier built
+    with ``mesh=make_mesh(8, dp=4, tp=2)``, a sharded verify of real
+    proofs, and a tamper check that must flip exactly row 0 — the run
+    the driver's MULTICHIP rounds execute. Slow-marked: first-compile
+    of the fused sharded chunk program costs minutes per shape on the
+    1-core gate host; tier-1 covers the same path in-process via
+    tests/test_range_verifier_sharded.py."""
+    report = monitor(
+        8, light=False, report_path=str(tmp_path / "full.json"),
+        poll_s=1.0, total_timeout_s=5400.0)
+    assert report["schema"] == "fts-multichip-v2"
+    assert report["phase"] not in ("", "spawn"), report
+    assert report["diagnosis"], report
+    assert report["tail"], "worker produced no output at all"
+    if not report["ok"]:
+        raise AssertionError(
+            f"full dryrun failed (but was attributed): "
+            f"{report['diagnosis']}\n--- tail ---\n{report['tail']}")
+    assert report["phase"] == "done"
+    assert "tamper check flipped row 0 only" in report["tail"]
 
 
 def test_real_light_dryrun_on_8_simulated_devices(tmp_path):

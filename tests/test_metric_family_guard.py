@@ -67,6 +67,16 @@ STABLE_FAMILIES = (
     "serve_results_total",
     "serve_shed_total",
     "serve_wait_seconds",
+    # serve/ per-device dispatch lanes (multi-chip continuous batching)
+    "lane_busy_seconds",
+    "lane_dispatch_total",
+    "lane_inflight",
+    "lane_rows_total",
+    # models/ multi-chip mesh pipeline
+    "mesh_allgather_bytes_total",
+    "mesh_chunk_dispatches_total",
+    "mesh_devices",
+    "mesh_pad_rows_total",
     # serve/ network front door (RPC sidecar)
     "rpc_call_seconds",
     "rpc_connections_active",
@@ -178,7 +188,7 @@ def test_no_duplicate_family_entries():
                                     "txgen_", "resil_", "telemetry_",
                                     "slo_", "profile_", "journal_",
                                     "hb_", "fleet_", "wal_", "crash_",
-                                    "rpc_"])
+                                    "rpc_", "mesh_", "lane_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

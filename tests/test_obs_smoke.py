@@ -167,6 +167,66 @@ def test_serve_family_stable_names():
     assert "# TYPE serve_queue_depth gauge" in text
 
 
+# serve/ per-device dispatch lanes (multi-chip continuous batching) —
+# stable interface; every sample carries a lane="<index>" label
+EXPECTED_LANE_FAMILIES = (
+    "lane_dispatch_total",
+    "lane_rows_total",
+    "lane_busy_seconds",
+    "lane_inflight",
+)
+
+
+def test_lane_family_stable_names_multi_lane():
+    """Drive a 2-lane service with a slow (blocking) verifier so the
+    dispatch loop overlaps batches across lanes, then assert every
+    lane_* family exports with per-lane labels. describe() alone does
+    not render a family — the instruments must actually fire."""
+    import asyncio
+    import time
+
+    import numpy as np
+
+    from fabric_token_sdk_tpu.serve import ServeConfig, VerificationService
+
+    class _SlowRange:
+        def verify(self, proofs, commitments):
+            time.sleep(0.05)          # hold the lane busy -> overlap
+            return np.ones(len(proofs), dtype=bool)
+
+    class _FakeZK:
+        _range = _SlowRange()
+
+    GLOBAL.reset()
+    svc = VerificationService(
+        _FakeZK(),
+        config=ServeConfig(buckets=(4,), max_wait_s=0.001, n_lanes=2))
+
+    async def run():
+        await svc.start(prewarm=False)
+        out = await asyncio.gather(*[
+            svc.submit_range(object(), object()) for _ in range(12)])
+        await svc.stop()
+        return out
+
+    results = asyncio.run(run())
+    assert all(r.ok for r in results)
+    lanes_used = {r.device_lane for r in results}
+    assert lanes_used == {0, 1}, lanes_used
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_LANE_FAMILIES:
+        assert fam in text, f"lane family silent: {fam}"
+    for lane in (0, 1):
+        assert re.search(r'lane_dispatch_total\{[^}]*lane="%d"' % lane,
+                         text), (lane, text)
+    assert "# TYPE lane_inflight gauge" in text
+    # lane bookkeeping rolled up in status()
+    st = svc.status()
+    assert len(st["lanes"]) == 2
+    assert sum(l["dispatches"] for l in st["lanes"]) >= 2
+    assert sum(l["rows"] for l in st["lanes"]) == 12
+
+
 # live telemetry plane families (PR: telemetry) — stable interface; the
 # endpoint behaviour itself is covered crypto-free in tests/test_telemetry.py
 EXPECTED_TELEMETRY_FAMILIES = (

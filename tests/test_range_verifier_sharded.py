@@ -5,13 +5,17 @@ shape — pass-1 rows dp-sharded, combined RLC terms sharded with the
 all-gather point-fold)."""
 
 import random
+import re
+from collections import Counter
 
 import jax
 import numpy as np
 import pytest
 
 from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+import fabric_token_sdk_tpu.models.range_verifier as rv
 from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
+from fabric_token_sdk_tpu.obs import GLOBAL
 from fabric_token_sdk_tpu.parallel import make_mesh
 
 rng = random.Random(0x5AAD)
@@ -72,3 +76,52 @@ def test_sharded_all_valid_takes_combined_path(pp, mesh):
     accepts = v.verify(proofs, coms)
     assert accepts.all()
     assert v.last_path == "combined"
+
+
+def test_sharded_ragged_batch_identity_padding(pp, mesh):
+    """A batch size not divisible by dp rides identity-padded shard rows
+    (identity points, zero RLC weights): verdicts must match the
+    single-device path exactly, and the pad accounting must light the
+    stable mesh_* families (ROADMAP stable-metric-names)."""
+    GLOBAL.reset()
+    proofs, coms = [], []
+    for v in [9, 10, 11, 12, 13]:          # 5 rows over dp=4
+        pf, com = _prove_one(pp, v)
+        proofs.append(pf)
+        coms.append(com)
+    sharded = BatchRangeVerifier(pp, mesh=mesh).verify(proofs, coms)
+    single = BatchRangeVerifier(pp).verify(proofs, coms)
+    assert (sharded == single).all(), f"{sharded} != {single}"
+    assert sharded.all()
+    text = GLOBAL.prometheus_text()
+    assert re.search(r"^mesh_devices(?:\{[^}]*\})? 8(\.0)?$", text, re.M), \
+        text
+    for fam in ("mesh_chunk_dispatches_total", "mesh_pad_rows_total",
+                "mesh_allgather_bytes_total"):
+        m = re.search(r"^%s(?:\{[^}]*\})? ([0-9.e+]+)$" % fam, text, re.M)
+        assert m, f"mesh family silent: {fam}"
+        assert float(m.group(1)) > 0, fam
+
+
+def test_sharded_dispatch_counts_stay_fused(pp, mesh):
+    """Scaling out must not reintroduce the per-pass dispatch ladder:
+    under the mesh each verify is still ONE packed upload + ONE fused
+    chunk program per chunk, with the O(1) finalize folded across
+    chunks (same invariant perf_profile.py --mode mesh asserts)."""
+    counts = Counter()
+    old = rv._DISPATCH_HOOK
+    rv._DISPATCH_HOOK = lambda kind: counts.update((kind,))
+    try:
+        proofs, coms = [], []
+        for v in [41, 42, 43]:
+            pf, com = _prove_one(pp, v)
+            proofs.append(pf)
+            coms.append(com)
+        ver = BatchRangeVerifier(pp, mesh=mesh)
+        accepts = ver.verify(proofs, coms)
+    finally:
+        rv._DISPATCH_HOOK = old
+    assert accepts.all() and ver.last_path == "combined"
+    assert counts["chunk_upload"] == 1, dict(counts)
+    assert counts["chunk_dispatch"] == 1, dict(counts)
+    assert counts["finalize"] == 1, dict(counts)

@@ -1,0 +1,155 @@
+"""Tier-1 (crypto-free) tests for the per-device dispatch lanes.
+
+The multi-chip serve frontend runs one DISPATCH LANE per device: each
+lane owns a verifier handle, a single-thread executor, and a prewarm
+inventory, and the dispatch loop keeps assembling batches while any
+lane is idle so all devices verify concurrently. These tests drive the
+whole service against stub verifiers (no jax, no crypto) and pin down:
+
+  * batches SPREAD across lanes when a lane blocks (continuous
+    batching actually overlaps device calls),
+  * per-lane verifier routing (``lane_verifiers``) and its length
+    validation,
+  * per-lane prewarm inventories all populated before first dispatch,
+  * the LRU lane assignment round-robins over idle lanes,
+  * ``n_lanes=1`` preserves the historical single-dispatcher surface
+    (``svc.prewarm``, ``svc._watchdog``, ``device_lane == 0``).
+
+Device-side parity of the lanes is covered by the heavy smoke
+(tests/test_serve_smoke.py) and BENCH_MODE=replay.
+"""
+
+import asyncio
+import re
+import time
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL
+from fabric_token_sdk_tpu.serve import (ServeConfig, VerificationService)
+from fabric_token_sdk_tpu.serve.scheduler import BucketScheduler
+
+
+class _StubRange:
+    """Blocking stand-in for BatchRangeVerifier: optional sleep holds
+    the lane's executor thread busy, forcing the loop to other lanes."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+        self.rows = 0
+
+    def verify(self, proofs, commitments):
+        self.calls += 1
+        self.rows += len(proofs)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.ones(len(proofs), dtype=bool)
+
+
+class _StubZK:
+    def __init__(self, delay_s: float = 0.0):
+        self._range = _StubRange(delay_s)
+        self.prewarmed: list[tuple] = []
+
+    def prewarm_shapes(self, buckets, include_block=False):
+        self.prewarmed.append(tuple(buckets))
+        return {b: 0.001 for b in buckets}
+
+
+def _drive(svc, n_requests, prewarm=False):
+    async def run():
+        await svc.start(prewarm=prewarm)
+        out = await asyncio.gather(*[
+            svc.submit_range(object(), object()) for _ in range(n_requests)])
+        await svc.stop()
+        return out
+
+    return asyncio.run(run())
+
+
+def test_batches_spread_across_lanes():
+    """With lane 0 blocked mid-verify, the loop must keep assembling
+    and hand the next batches to the other lanes — all three serve."""
+    GLOBAL.reset()
+    svc = VerificationService(
+        _StubZK(delay_s=0.05),
+        config=ServeConfig(buckets=(8,), max_wait_s=0.001, n_lanes=3))
+    results = _drive(svc, 24)
+    assert all(r.ok and r.accepted for r in results)
+    assert {r.device_lane for r in results} == {0, 1, 2}
+    st = svc.status()
+    assert [l["index"] for l in st["lanes"]] == [0, 1, 2]
+    assert all(l["dispatches"] >= 1 for l in st["lanes"])
+    assert sum(l["rows"] for l in st["lanes"]) == 24
+    assert not any(l["busy"] for l in st["lanes"])
+    # stable lane_* families export with per-lane labels (the crypto-full
+    # twin of this assertion lives in tests/test_obs_smoke.py)
+    text = GLOBAL.prometheus_text()
+    for fam in ("lane_dispatch_total", "lane_rows_total",
+                "lane_busy_seconds", "lane_inflight"):
+        assert fam in text, f"lane family silent: {fam}"
+    for lane in (0, 1, 2):
+        assert re.search(r'lane_dispatch_total\{[^}]*lane="%d"' % lane,
+                         text), lane
+
+
+def test_per_lane_verifier_routing_and_validation():
+    """Each lane dispatches on ITS OWN verifier handle (per-device
+    placement), and a lane_verifiers list of the wrong length is a
+    construction-time error."""
+    zks = [_StubZK(delay_s=0.05) for _ in range(2)]
+    svc = VerificationService(
+        zks[0],
+        config=ServeConfig(buckets=(4,), max_wait_s=0.001, n_lanes=2),
+        lane_verifiers=zks)
+    results = _drive(svc, 16)
+    assert all(r.ok for r in results)
+    assert {r.device_lane for r in results} == {0, 1}
+    assert all(zk._range.calls >= 1 for zk in zks)
+    assert sum(zk._range.rows for zk in zks) == 16
+
+    with pytest.raises(ValueError, match="lane_verifiers"):
+        VerificationService(
+            zks[0],
+            config=ServeConfig(buckets=(4,), n_lanes=3),
+            lane_verifiers=zks)
+
+
+def test_per_lane_prewarm_inventory():
+    """start(prewarm=True) must compile every bucket on EVERY lane's
+    own verifier before the first dispatch — per-lane inventories, not
+    one shared set."""
+    zks = [_StubZK() for _ in range(2)]
+    svc = VerificationService(
+        zks[0],
+        config=ServeConfig(buckets=(4, 8), max_wait_s=0.001, n_lanes=2),
+        lane_verifiers=zks)
+    results = _drive(svc, 4, prewarm=True)
+    assert all(r.ok for r in results)
+    for lane in svc._lanes:
+        assert lane.prewarm.ready == {4, 8}, lane.index
+    # each lane warmed through its own zk handle
+    assert all(zk.prewarmed for zk in zks)
+    # compat alias surfaces lane 0's inventory
+    assert svc.prewarm is svc._lanes[0].prewarm
+
+
+def test_pick_lane_is_lru_round_robin():
+    sched = BucketScheduler(ServeConfig(buckets=(4,), n_lanes=3))
+    picks = [sched.pick_lane([0, 1, 2]) for _ in range(6)]
+    assert picks == [0, 1, 2, 0, 1, 2]
+    # only idle lanes are candidates; least-recently-used wins
+    assert sched.pick_lane([2, 1]) == 1
+    assert sched.pick_lane([]) is None
+
+
+def test_single_lane_preserves_legacy_surface():
+    svc = VerificationService(
+        _StubZK(), config=ServeConfig(buckets=(4,), max_wait_s=0.001))
+    assert len(svc._lanes) == 1
+    results = _drive(svc, 8)
+    assert all(r.ok and r.device_lane == 0 for r in results)
+    assert svc._watchdog is svc._lanes[0].watchdog
+    assert svc.status()["lanes"][0]["dispatches"] >= 1
