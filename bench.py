@@ -448,6 +448,85 @@ def _bench_serve():
     }))
 
 
+def _bench_prove():
+    """BENCH_MODE=prove — device proof SYNTHESIS throughput: seeded
+    witnesses stream through ``prover.DeviceRangeProver`` in fused
+    chunks; reports proofs/s, the XLA cost analysis of the prove chunk
+    program, and the speedup over the host prover's measured wall-clock
+    (the "as fast as we verify" bar shares TARGET_BASELINE). A seeded
+    spot sample of the synthesized proofs (plus one forged row) is
+    checked against the pure-host verifier."""
+    import random
+
+    from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+    from fabric_token_sdk_tpu.harness.corpus import _seeded_draws
+    from fabric_token_sdk_tpu.prover import DeviceRangeProver
+
+    pp = setup.PublicParams.deserialize((BENCH_DIR / "pp.json").read_bytes())
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    total = int(os.environ.get("BENCH_PROVE_COUNT", "256"))
+    chunk = int(os.environ.get("BENCH_PROVE_CHUNK", "64"))
+    rng = random.Random(int(os.environ.get("BENCH_PROVE_SEED", "17")))
+    values = [rng.randrange(1 << BIT_LENGTH) for _ in range(total)]
+    bfs = [rng.randrange(1, bn254.R) for _ in range(total)]
+    draws = [_seeded_draws(rng, BIT_LENGTH) for _ in range(total)]
+
+    prover = DeviceRangeProver(pp, chunk_rows=chunk)
+    print(f"prove bench: warm-up chunk ({chunk} rows)", file=sys.stderr)
+    t0 = time.perf_counter()
+    prover.prove(values[:chunk], bfs[:chunk], draws=draws[:chunk])
+    prewarm_s = time.perf_counter() - t0
+    print(f"prove bench: warm-up in {prewarm_s:.1f}s; timing {total} "
+          f"proofs", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    proofs, coms = prover.prove(values, bfs, draws=draws)
+    elapsed = time.perf_counter() - t0
+    value = total / elapsed
+
+    # host prover wall-clock on the same witnesses (a couple of rows)
+    t0 = time.perf_counter()
+    host_rows = 2
+    for i in range(host_rows):
+        rp.range_prove(coms[i], values[i], cg, bfs[i],
+                       rpp.left_generators, rpp.right_generators,
+                       rpp.P, rpp.Q, rpp.number_of_rounds,
+                       rpp.bit_length, draws=draws[i])
+    host_s = (time.perf_counter() - t0) / host_rows
+    speedup = host_s * value               # host s/proof * proofs/s
+
+    # spot verification: a clean row accepts, a forged row rejects
+    def _host_ok(proof, com):
+        try:
+            rp.range_verify(proof, com, cg, rpp.left_generators,
+                            rpp.right_generators, rpp.P, rpp.Q,
+                            rpp.number_of_rounds, rpp.bit_length)
+            return True
+        except rp.ProofError:
+            return False
+
+    assert _host_ok(proofs[0], coms[0]), "prove bench: clean row rejected"
+    fp, fc = prover.prove([(1 << BIT_LENGTH) + 1], [bfs[0]],
+                          draws=[draws[0]], forge=True)
+    assert not _host_ok(fp[0], fc[0]), "prove bench: forged row accepted"
+
+    cost = prover.kernel_cost(rows=chunk) or {}
+    print(json.dumps({
+        "metric": f"prove_prewarm_wall_seconds_{BIT_LENGTH}bit",
+        "value": round(prewarm_s, 2),
+        "unit": f"s (chunk {chunk} rows)",
+    }))
+    print(json.dumps({
+        "metric": f"prove_proofs_per_sec_{BIT_LENGTH}bit",
+        "value": round(value, 2),
+        "unit": (f"proofs/s synthesized ({total} proofs, chunk {chunk}; "
+                 f"host {host_s:.2f}s/proof -> {speedup:.0f}x; "
+                 f"chunk flops {cost.get('flops', 0):.3g}"),
+        "vs_baseline": round(value / TARGET_BASELINE, 4),
+    }))
+
+
 def _bench_replay():
     """BENCH_MODE=replay — BASELINE config 5 at fleet scale: the 100k
     range-proof backlog replay, open-loop through the MULTI-LANE serve
@@ -479,6 +558,33 @@ def _bench_replay():
     pp, proofs, coms = _load()
     total = int(os.environ.get("BENCH_REPLAY_PROOFS", "100000"))
     rate = float(os.environ.get("BENCH_REPLAY_RATE", "4000"))
+    # BENCH_REPLAY_SOURCE=prover: the replay stream draws from a corpus
+    # the device prover synthesized (diverse seeded values incl. the
+    # range edges) instead of tiling the 4 benchdata proofs; forged rows
+    # come from seeded out-of-range witnesses with their OWN commitments
+    # rather than a tau-tampered copy.
+    replay_source = os.environ.get("BENCH_REPLAY_SOURCE", "benchdata")
+    forged_pool: list = []
+    if replay_source == "prover":
+        from fabric_token_sdk_tpu.harness.corpus import ProofCorpus
+
+        seed = int(os.environ.get("BENCH_REPLAY_SEED", "17"))
+        csize = int(os.environ.get("BENCH_REPLAY_CORPUS", "1024"))
+        corpus = ProofCorpus(pp, source="device", seed=seed)
+        print(f"replay bench: synthesizing {csize}-proof corpus "
+              f"(+8 forged) on device", file=sys.stderr)
+        entries = corpus.generate(csize)
+        proofs = [e.proof for e in entries]
+        coms = [e.commitment for e in entries]
+        forged_pool = ProofCorpus(pp, source="device", seed=seed + 1,
+                                  forge_every=1).generate(8)
+        corpus_prov = dict(corpus.provenance(), count=csize,
+                           forged_pool=len(forged_pool))
+    elif replay_source == "benchdata":
+        corpus_prov = {"source": "benchdata", "count": len(proofs),
+                       "forged_pool": 0}
+    else:
+        raise SystemExit(f"unknown BENCH_REPLAY_SOURCE: {replay_source!r}")
     n_lanes = (int(os.environ.get("BENCH_REPLAY_LANES", "0"))
                or max(1, len(jax.devices())))
     buckets = tuple(int(b) for b in os.environ.get(
@@ -503,6 +609,15 @@ def _bench_replay():
     draw = random.Random(13)
     picks = [draw.randrange(n) for _ in range(total)]
 
+    def _forged_req(i):
+        """(proof, commitment) for a forged submission: a prover-corpus
+        out-of-range entry when available, the tau-tampered copy (paired
+        with a mismatched commitment) for the benchdata source."""
+        if forged_pool:
+            e = forged_pool[picks[i] % len(forged_pool)]
+            return e.proof, e.commitment
+        return forged, coms[picks[i]]
+
     def _host_verdict(proof, com) -> bool:
         rpp = pp.range_proof_params
         cg = pp.pedersen_generators[1:3]
@@ -520,8 +635,9 @@ def _bench_replay():
         prewarm_s = await svc.start()
         print(f"replay bench: prewarm in {prewarm_s:.1f}s", file=sys.stderr)
         # spot parity vs the pure-host oracle, accepts AND rejects
-        spot_p = [forged] + proofs[:3]
-        spot_c = [coms[0]] + coms[:3]
+        fp0, fc0 = _forged_req(0)
+        spot_p = [fp0] + proofs[:3]
+        spot_c = [fc0] + coms[:3]
         host = [_host_verdict(p, c) for p, c in zip(spot_p, spot_c)]
         got = await asyncio.gather(*[
             svc.submit_range(p, c) for p, c in zip(spot_p, spot_c)])
@@ -541,7 +657,8 @@ def _bench_replay():
             if delay > 0:
                 await asyncio.sleep(delay)
             if i % FORGE_EVERY == 0:
-                return await svc.submit_range(forged, coms[picks[i]])
+                fp, fc = _forged_req(i)
+                return await svc.submit_range(fp, fc)
             return await svc.submit_range(proofs[picks[i]], coms[picks[i]])
 
         results = await asyncio.gather(
@@ -582,6 +699,7 @@ def _bench_replay():
                  f"used {lanes_used}; dispatches {dispatches}; "
                  f"utilization {util}; parity errors {parity_bad})"),
         "vs_baseline": round(value / TARGET_BASELINE, 4),
+        "corpus": corpus_prov,
     }))
     assert parity_bad == 0, \
         "replay bench: verdict parity broken across lanes"
@@ -1171,6 +1289,10 @@ def main():
 
     if mode == "replay":
         _bench_replay()
+        return
+
+    if mode == "prove":
+        _bench_prove()
         return
 
     if mode == "chaos":

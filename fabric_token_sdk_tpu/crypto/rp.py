@@ -41,6 +41,35 @@ class ProofError(Exception):
     """Raised when a proof fails verification; message mirrors the Go error."""
 
 
+@dataclass
+class RangeProverDraws:
+    """Every blinding draw `range_prove` consumes, as an explicit record.
+
+    The prover seam for externally-generated randomness (the TPU prover
+    draws these host-side, packs them into the witness upload, and the
+    device synthesizes the proof deterministically from them): handing
+    the SAME draws to `range_prove` and to the device prover must yield
+    byte-identical proofs, which is what tests/test_prover_parity.py
+    pins. Fields are named for the reference's locals (bulletproof.go:
+    336-466) rather than positionally, so the host prover's internal
+    draw ORDER can change without breaking recorded draws.
+    """
+
+    rho: int
+    eta: int
+    random_left: list[int]
+    random_right: list[int]
+    tau1: int
+    tau2: int
+
+    @classmethod
+    def random(cls, bit_length: int) -> "RangeProverDraws":
+        return cls(rho=fr_rand(), eta=fr_rand(),
+                   random_left=[fr_rand() for _ in range(bit_length)],
+                   random_right=[fr_rand() for _ in range(bit_length)],
+                   tau1=fr_rand(), tau2=fr_rand())
+
+
 # --------------------------------------------------------------------------
 # shared vector helpers (reference rp/ipa.go:358-373)
 # --------------------------------------------------------------------------
@@ -122,7 +151,24 @@ def ipa_round_challenge(L: G1, Rp: G1) -> int:
 def ipa_prove(ip: int, left: list[int], right: list[int], Q: G1,
               left_gen: list[G1], right_gen: list[G1], commitment: G1,
               rounds: int) -> IPA:
-    """reference rp/ipa.go:158-186,267-322."""
+    """reference rp/ipa.go:158-186,267-322.
+
+    Transcript layout (must match ipa_verify and the device verifier /
+    prover bit-for-bit):
+
+    - first challenge x = HashToZr(MarshalStd[[]byte]([array_bytes,
+      "||", Zr.Bytes(ip)])) where array_bytes hex-encodes, joined by
+      "||", the points [right_gen' .. , left_gen .. , Q, commitment] —
+      note the RIGHT generators hash FIRST (ipa.go:159-173) and ip is
+      the 32-byte big-endian CANONICAL reduced scalar.
+    - per round r: L_r, R_r are committed, then
+      x_r = HashToZr(hex(L_r) || "||" || hex(R_r)) (ipa_round_challenge)
+      folds generators as lg' = x_r^-1*lg[:h] + x_r*lg[h:],
+      rg' = x_r*rg[:h] + x_r^-1*rg[h:] and vectors with the transposed
+      coefficients (reduce_vectors), h = len/2.
+    - every hex() above is the lowercase ascii hex of the 64-byte
+      uncompressed big-endian x||y encoding (identity = 64 zero bytes).
+    """
     x = ipa_first_challenge(left_gen, right_gen, Q, commitment, ip)
     X = g1_mul(Q, x)
     L_arr: list[G1] = []
@@ -231,21 +277,40 @@ def challenges_y_z(C: G1, D: G1, commitment: G1) -> tuple[int, int]:
 
 def range_prove(commitment: G1, value: int, commitment_gen: list[G1],
                 blinding_factor: int, left_gen: list[G1], right_gen: list[G1],
-                P: G1, Q: G1, rounds: int, bit_length: int) -> RangeProof:
-    """reference rp/bulletproof.go:209-249,336-466."""
+                P: G1, Q: G1, rounds: int, bit_length: int,
+                draws: RangeProverDraws | None = None) -> RangeProof:
+    """reference rp/bulletproof.go:209-249,336-466.
+
+    `draws` injects every blinding draw (RangeProverDraws); None keeps
+    the fresh-`fr_rand` behavior. With pinned draws the prover is a pure
+    function of (commitment, value, blinding_factor) — the parity oracle
+    the device prover (fabric_token_sdk_tpu.prover.range) is pinned to.
+
+    Transcript layout (mirrors range_verify and the device paths):
+
+    - (y, z) = challenges_y_z(C, D, com): y = HashToZr(hex(C) || "||" ||
+      hex(D) || "||" || hex(com)), z = HashToZr(Zr.Bytes(y)) — so y's
+      CANONICAL 32-byte big-endian reduction re-enters the transcript.
+    - x = challenge_x(T1, T2) = HashToZr(hex(T1) || "||" || hex(T2)).
+    - the IPA then runs over com_ipa = <left, G> + <right, H'> with
+      H'_i = y^-i * H_i and ip = <left, right> (see ipa_prove's
+      docstring for the x_ipa / round-challenge layout).
+    - hex() is lowercase ascii of the 64-byte uncompressed big-endian
+      x||y point encoding (identity = 64 zero bytes).
+    """
     # -------- preprocess (bulletproof.go:336-466)
-    rho = fr_rand()
-    eta = fr_rand()
+    if draws is None:
+        draws = RangeProverDraws.random(bit_length)
+    rho = draws.rho
+    eta = draws.eta
     left = []
     right = []
-    random_left = []
-    random_right = []
+    random_left = list(draws.random_left)
+    random_right = list(draws.random_right)
     for i in range(bit_length):
         b = 1 if (value >> i) & 1 else 0
         left.append(b)
         right.append(fr_sub(b, 1))
-        random_left.append(fr_rand())
-        random_right.append(fr_rand())
 
     C = g1_add(commit_vector(left, right, left_gen, right_gen), g1_mul(P, rho))
     D = g1_add(commit_vector(random_left, random_right, left_gen, right_gen),
@@ -269,11 +334,11 @@ def range_prove(commitment: G1, value: int, commitment_gen: list[G1],
     t1 = inner_product(left_prime, rand_right_prime)
     t1 = fr_add(t1, inner_product(right_prime, random_left))
     t1 = fr_add(t1, inner_product(z_prime, random_left))
-    tau1 = fr_rand()
+    tau1 = draws.tau1
     T1 = g1_add(g1_mul(commitment_gen[0], t1), g1_mul(commitment_gen[1], tau1))
 
     t2 = inner_product(random_left, rand_right_prime)
-    tau2 = fr_rand()
+    tau2 = draws.tau2
     T2 = g1_add(g1_mul(commitment_gen[0], t2), g1_mul(commitment_gen[1], tau2))
 
     x = challenge_x(T1, T2)
@@ -388,11 +453,14 @@ def range_correctness_prove(commitments: list[G1], values: list[int],
                             pedersen_params: list[G1],
                             left_gen: list[G1], right_gen: list[G1],
                             P: G1, Q: G1, bit_length: int,
-                            rounds: int) -> RangeCorrectness:
+                            rounds: int,
+                            draws: list[RangeProverDraws] | None = None,
+                            ) -> RangeCorrectness:
     proofs = [
         range_prove(commitments[i], values[i], pedersen_params,
                     blinding_factors[i], left_gen, right_gen, P, Q,
-                    rounds, bit_length)
+                    rounds, bit_length,
+                    draws=draws[i] if draws is not None else None)
         for i in range(len(commitments))
     ]
     return RangeCorrectness(proofs)

@@ -28,7 +28,51 @@ from .bn254 import (
     g1_neg,
     hash_to_zr,
 )
-from .rp import ProofError, RangeCorrectness
+from .rp import ProofError, RangeCorrectness, RangeProverDraws
+
+
+@dataclass
+class TypeAndSumDraws:
+    """Every blinding draw `type_and_sum_prove` consumes (the same
+    externally-generated-randomness seam as rp.RangeProverDraws: the TPU
+    prover draws these host-side and synthesizes the Σ-protocol
+    commitments/responses on device; same draws => identical proofs)."""
+
+    r_type: int
+    r_type_bf: int
+    r_in_values: list[int]
+    r_in_bfs: list[int]
+    r_sum_bf: int
+
+    @classmethod
+    def random(cls, n_inputs: int) -> "TypeAndSumDraws":
+        return cls(r_type=fr_rand(), r_type_bf=fr_rand(),
+                   r_in_values=[fr_rand() for _ in range(n_inputs)],
+                   r_in_bfs=[fr_rand() for _ in range(n_inputs)],
+                   r_sum_bf=fr_rand())
+
+
+@dataclass
+class TransferDraws:
+    """Draw record for a whole `transfer_prove`: the type blinding
+    factor, the type-and-sum Σ draws, and one RangeProverDraws per
+    output range proof (empty for the 1-in/1-out shape, which skips the
+    range part)."""
+
+    type_bf: int
+    ts: TypeAndSumDraws
+    ranges: list[RangeProverDraws]
+
+    @classmethod
+    def random(cls, n_inputs: int, n_outputs: int,
+               bit_length: int) -> "TransferDraws":
+        skip_range = n_inputs == 1 and n_outputs == 1
+        return cls(
+            type_bf=fr_rand(),
+            ts=TypeAndSumDraws.random(n_inputs),
+            ranges=[] if skip_range else [
+                RangeProverDraws.random(bit_length)
+                for _ in range(n_outputs)])
 
 
 @dataclass
@@ -74,19 +118,30 @@ def _transcript_bytes(in_coms: list[G1], type_com: G1, sum_com: G1,
 def type_and_sum_prove(ped_params: list[G1], inputs: list[G1], outputs: list[G1],
                        commitment_to_type: G1, in_values: list[int],
                        in_bfs: list[int], out_bfs: list[int], type_zr: int,
-                       type_bf: int) -> TypeAndSumProof:
-    """reference typeandsum.go:189-227,280-356."""
+                       type_bf: int,
+                       draws: TypeAndSumDraws | None = None) -> TypeAndSumProof:
+    """reference typeandsum.go:189-227,280-356.
+
+    `draws` pins the Σ-protocol randomness (TypeAndSumDraws); None keeps
+    fresh draws. The challenge is HashToZr over the hex-"||" G1 array of
+    [com_inputs.., com_type, com_sum, adj_in.., adj_out..,
+    commitment_to_type, sum_] (_transcript_bytes, typeandsum.go:214,267)
+    with adj_i = point - commitment_to_type and
+    sum_ = sum(adj_in) - sum(adj_out).
+    """
     # randomness + commitments (computeCommitments, typeandsum.go:319-356)
-    r_type = fr_rand()
-    r_type_bf = fr_rand()
+    if draws is None:
+        draws = TypeAndSumDraws.random(len(inputs))
+    r_type = draws.r_type
+    r_type_bf = draws.r_type_bf
     com_type = g1_add(g1_mul(ped_params[0], r_type), g1_mul(ped_params[2], r_type_bf))
-    r_in_values = [fr_rand() for _ in inputs]
-    r_in_bfs = [fr_rand() for _ in inputs]
+    r_in_values = list(draws.r_in_values)
+    r_in_bfs = list(draws.r_in_bfs)
     com_inputs = [
         g1_add(g1_mul(ped_params[1], r_in_values[i]), g1_mul(ped_params[2], r_in_bfs[i]))
         for i in range(len(inputs))
     ]
-    r_sum_bf = fr_rand()
+    r_sum_bf = draws.r_sum_bf
     com_sum = g1_mul(ped_params[2], r_sum_bf)
 
     # adjusted statement (Prove, typeandsum.go:195-211)
@@ -188,14 +243,20 @@ class TransferProof:
 
 def transfer_prove(input_witness: list[tuple[str, int, int]],
                    output_witness: list[tuple[str, int, int]],
-                   inputs: list[G1], outputs: list[G1], pp) -> bytes:
+                   inputs: list[G1], outputs: list[G1], pp,
+                   draws: TransferDraws | None = None) -> bytes:
     """reference transfer.go:69-150. Witnesses are (type, value, blinding_factor).
 
-    pp is a crypto.setup.PublicParams.
+    pp is a crypto.setup.PublicParams. `draws` pins all randomness
+    (TransferDraws); None keeps fresh draws.
     """
     token_type = input_witness[0][0]
     type_zr = hash_to_zr(token_type.encode())
-    type_bf = fr_rand()
+    if draws is None:
+        draws = TransferDraws.random(len(input_witness),
+                                     len(output_witness),
+                                     pp.range_proof_params.bit_length)
+    type_bf = draws.type_bf
     commitment_to_type = g1_add(g1_mul(pp.pedersen_generators[0], type_zr),
                                 g1_mul(pp.pedersen_generators[2], type_bf))
 
@@ -205,7 +266,7 @@ def transfer_prove(input_witness: list[tuple[str, int, int]],
 
     ts = type_and_sum_prove(pp.pedersen_generators, inputs, outputs,
                             commitment_to_type, in_values, in_bfs, out_bfs,
-                            type_zr, type_bf)
+                            type_zr, type_bf, draws=draws.ts)
 
     rc = None
     if len(input_witness) != 1 or len(output_witness) != 1:
@@ -217,7 +278,8 @@ def transfer_prove(input_witness: list[tuple[str, int, int]],
         rc = rp_mod.range_correctness_prove(
             coms, values, bfs, pp.pedersen_generators[1:],
             rpp.left_generators, rpp.right_generators, rpp.P, rpp.Q,
-            rpp.bit_length, rpp.number_of_rounds)
+            rpp.bit_length, rpp.number_of_rounds,
+            draws=draws.ranges or None)
 
     return TransferProof(type_and_sum=ts, range_correctness=rc).serialize()
 

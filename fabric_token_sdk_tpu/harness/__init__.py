@@ -7,4 +7,5 @@ same planes the reference runs over websockets + Fabric delivery
 (SURVEY.md §2.5).
 """
 
+from .corpus import CorpusEntry, ProofCorpus  # noqa: F401
 from .nwo import Platform, NodeSpec  # noqa: F401

@@ -1,0 +1,179 @@
+"""ProofCorpus: seeded range-proof corpora for benches and harnesses.
+
+The replay bench (bench.py BENCH_MODE=replay) historically tiled four
+pre-generated benchdata proofs; a prover-fed corpus replaces that with a
+stream of DISTINCT proofs — diverse values (the 0 and 2^n - 1 edges are
+always pinned in), seeded blinding draws so a corpus replays
+byte-identically run-over-run (the txgen determinism contract), and a
+deliberately forged out-of-range witness every ``forge_every`` rows so
+the reject path is exercised at a known cadence.
+
+Sources:
+  * ``device`` — ``prover.DeviceRangeProver`` synthesizes the corpus in
+    fused on-device chunks (the BENCH_REPLAY_SOURCE=prover arm);
+  * ``host``   — ``crypto.rp.range_prove`` row by row (slow; the parity
+    oracle and the CPU-only tier-1 tests).
+
+Both sources share one seeded witness plan, so a device corpus and a
+host corpus from the same seed are byte-identical proof-for-proof.
+``provenance()`` reports the generation parameters for the BENCH report.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from ..crypto import bn254, rp
+from ..obs import GLOBAL as _METRICS
+from .txgen import open_loop_arrivals
+
+R = bn254.R
+
+#: Corpus metric family metadata (stable name, HELP-linted).
+_CORPUS_FAMILIES = {
+    "prover_corpus_proofs_total":
+        "Corpus range proofs generated, by source, bits and forged",
+}
+for _fam, _help in _CORPUS_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
+
+
+@dataclass
+class CorpusEntry:
+    proof: rp.RangeProof
+    commitment: bn254.G1
+    value: int
+    forged: bool
+
+
+def _seeded_draws(rng: random.Random, bit_length: int) -> rp.RangeProverDraws:
+    return rp.RangeProverDraws(
+        rho=rng.randrange(1, R), eta=rng.randrange(1, R),
+        random_left=[rng.randrange(1, R) for _ in range(bit_length)],
+        random_right=[rng.randrange(1, R) for _ in range(bit_length)],
+        tau1=rng.randrange(1, R), tau2=rng.randrange(1, R))
+
+
+class ProofCorpus:
+    """Deterministic range-proof corpus for one PublicParams set.
+
+    ``forge_every=N`` plants an out-of-range witness at every index with
+    ``i % N == N - 1`` (never displacing the pinned edge values at
+    indices 0 and 1); ``forge_every=0`` disables forgeries. Entries
+    carry their ground-truth ``forged`` flag so a replay harness can
+    assert every verdict.
+    """
+
+    def __init__(self, pp, source: str = "device", seed: int = 17,
+                 forge_every: int = 0, chunk_rows: int | None = None):
+        if source not in ("device", "host"):
+            raise ValueError(f"unknown corpus source: {source!r}")
+        self.pp = pp
+        self.source = source
+        self.seed = seed
+        self.forge_every = forge_every
+        self.chunk_rows = chunk_rows
+        self.bit_length = pp.range_proof_params.bit_length
+
+    # ------------------------------------------------------- witness plan
+    def _plan(self, count: int):
+        """Seeded (values, bfs, draws, forged_flags): indices 0/1 pin
+        the range edges, every forge_every-th row is out of range."""
+        n = self.bit_length
+        rng = random.Random(self.seed)
+        values, forged = [], []
+        for i in range(count):
+            forge = (self.forge_every > 0
+                     and i % self.forge_every == self.forge_every - 1)
+            if forge:
+                v = (1 << n) + rng.randrange(1, 1 << n)
+            elif i == 0:
+                v = 0
+            elif i == 1:
+                v = (1 << n) - 1
+            else:
+                v = rng.randrange(1 << n)
+            values.append(v)
+            forged.append(forge)
+        bfs = [rng.randrange(1, R) for _ in range(count)]
+        draws = [_seeded_draws(rng, n) for _ in range(count)]
+        return values, bfs, draws, forged
+
+    # --------------------------------------------------------- generation
+    def generate(self, count: int) -> list[CorpusEntry]:
+        values, bfs, draws, forged = self._plan(count)
+        if self.source == "device":
+            proofs, coms = self._device_rows(values, bfs, draws, forged)
+        else:
+            proofs, coms = self._host_rows(values, bfs, draws)
+        n_forged = sum(forged)
+        bits = str(self.bit_length)
+        _METRICS.counter("prover_corpus_proofs_total", source=self.source,
+                         bits=bits, forged="false").add(count - n_forged)
+        if n_forged:
+            _METRICS.counter("prover_corpus_proofs_total",
+                             source=self.source, bits=bits,
+                             forged="true").add(n_forged)
+        return [CorpusEntry(p, c, v, f) for p, c, v, f in
+                zip(proofs, coms, values, forged)]
+
+    def _device_rows(self, values, bfs, draws, forged):
+        from ..prover import DeviceRangeProver
+
+        prover = DeviceRangeProver(self.pp, chunk_rows=self.chunk_rows)
+        # valid and forged rows go through separate prove() calls (the
+        # forge=True contract stays per-call), then re-interleave
+        ok_idx = [i for i, f in enumerate(forged) if not f]
+        bad_idx = [i for i, f in enumerate(forged) if f]
+        proofs = [None] * len(values)
+        coms = [None] * len(values)
+        for idxs, forge in ((ok_idx, False), (bad_idx, True)):
+            if not idxs:
+                continue
+            ps, cs = prover.prove([values[i] for i in idxs],
+                                  [bfs[i] for i in idxs],
+                                  draws=[draws[i] for i in idxs],
+                                  forge=forge)
+            for j, i in enumerate(idxs):
+                proofs[i], coms[i] = ps[j], cs[j]
+        return proofs, coms
+
+    def _host_rows(self, values, bfs, draws):
+        pp = self.pp
+        rpp = pp.range_proof_params
+        cg = pp.pedersen_generators[1:3]
+        proofs, coms = [], []
+        for v, bf, d in zip(values, bfs, draws):
+            com = bn254.g1_add(bn254.g1_mul(cg[0], v),
+                               bn254.g1_mul(cg[1], bf))
+            proofs.append(rp.range_prove(
+                com, v, cg, bf, rpp.left_generators, rpp.right_generators,
+                rpp.P, rpp.Q, rpp.number_of_rounds, rpp.bit_length,
+                draws=d))
+            coms.append(com)
+        return proofs, coms
+
+    # ----------------------------------------------------------- plumbing
+    def provenance(self) -> dict:
+        """Generation parameters for the BENCH report (config 5 replay
+        records where its corpus came from)."""
+        return {
+            "generator": "harness.corpus.ProofCorpus",
+            "source": self.source,
+            "bits": self.bit_length,
+            "seed": self.seed,
+            "forge_every": self.forge_every,
+            "edge_values": [0, (1 << self.bit_length) - 1],
+        }
+
+    def arrival_schedule(self, count: int, rate_hz: float,
+                         seed: int = 11) -> list[float]:
+        """Open-loop Poisson offsets for replaying ``count`` corpus
+        entries at ``rate_hz`` (txgen.open_loop_arrivals, topped up to
+        exactly ``count`` arrivals)."""
+        duration = count / rate_hz
+        out = open_loop_arrivals(rate_hz, duration * 1.1, seed=seed)[:count]
+        while len(out) < count:
+            out.append((out[-1] if out else 0.0) + 1.0 / rate_hz)
+        return out

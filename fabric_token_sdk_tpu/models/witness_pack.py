@@ -1,0 +1,106 @@
+"""Witness-row packing for the device prover (models layer).
+
+Mirrors the verifier's pipeline shape (range_verifier._pack_rows): every
+witness a range-proof chunk needs — value, blinding factor, and the six
+blinding-draw groups of ``crypto.rp.RangeProverDraws`` — is packed into
+ONE contiguous (B, W) uint32 row matrix so a chunk costs exactly one
+host->device upload. The unpack direction turns the device program's
+(point bytes, scalar limbs) outputs back into ``rp.RangeProof`` host
+objects whose ``serialize()`` is byte-identical to the host prover's.
+
+Row layout, W = (6 + 2n) * 16 u32 words of 16-bit LE limbs:
+
+    [value | bf | rho | eta | tau1 | tau2 | random_left*n | random_right*n]
+
+Values are stored mod R (the device commits ``cg0^value`` from the full
+residue while the bit decomposition uses only the low n bits — exactly
+the host prover's truncating behavior, which is what makes seeded
+out-of-range FORGED witnesses produce byte-identical invalid proofs on
+both paths).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto import bn254
+from ..crypto import rp
+from ..ops import limbs
+
+R = bn254.R
+_NL = limbs.NLIMBS
+
+
+def witness_width(bit_length: int) -> int:
+    """Packed u32 row width for one witness at ``bit_length`` bits."""
+    return (6 + 2 * bit_length) * _NL
+
+
+def pack_range_witnesses(values, blinding_factors, draws,
+                         bit_length: int) -> np.ndarray:
+    """(values, bfs, RangeProverDraws list) -> (B, W) uint32 packed rows."""
+    B = len(values)
+    out = np.zeros((B, witness_width(bit_length)), dtype=np.uint32)
+    for r in range(B):
+        d = draws[r]
+        if (len(d.random_left) != bit_length
+                or len(d.random_right) != bit_length):
+            raise ValueError(
+                f"draws row {r}: expected {bit_length} random_left/right "
+                f"draws, got {len(d.random_left)}/{len(d.random_right)}")
+        row = ([values[r] % R, blinding_factors[r] % R, d.rho % R,
+                d.eta % R, d.tau1 % R, d.tau2 % R]
+               + [v % R for v in d.random_left]
+               + [v % R for v in d.random_right])
+        out[r] = limbs.ints_to_limbs(row).reshape(-1)
+    return out
+
+
+def pad_witness_rows(packed: np.ndarray, target_rows: int) -> np.ndarray:
+    """Pad the row axis with all-zero witnesses (value 0, bf 0, zero
+    draws — valid degenerate proofs) so every chunk reuses one compiled
+    (B, W) program shape; callers drop the padded tail after unpack."""
+    B = packed.shape[0]
+    if B == target_rows:
+        return packed
+    pad = np.zeros((target_rows - B, packed.shape[1]), dtype=np.uint32)
+    return np.concatenate([packed, pad], axis=0)
+
+
+def _point(b64: np.ndarray) -> bn254.G1:
+    """64 canonical device bytes -> host affine point (no curve check:
+    device outputs feed the verifiers, which reject off-curve bytes)."""
+    raw = b64.tobytes()
+    if raw == b"\x00" * 64:
+        return bn254.G1_IDENTITY
+    return bn254.G1(int.from_bytes(raw[:32], "big"),
+                    int.from_bytes(raw[32:], "big"))
+
+
+def unpack_range_outputs(pts_bytes: np.ndarray, scalars: np.ndarray,
+                         rounds: int):
+    """Device prover outputs -> (proofs, commitments) host objects.
+
+    pts_bytes: (B, 5 + 2*rounds, 64) u8 canonical G1 bytes in the order
+        [C, D, com, T1, T2, L_0..L_{r-1}, R_0..R_{r-1}];
+    scalars: (B, 5, 16) u32 canonical plain limbs in the order
+        [tau, delta, inner_product, ipa.left, ipa.right].
+    """
+    pts_bytes = np.asarray(pts_bytes, dtype=np.uint8)
+    scalars = np.asarray(scalars, dtype=np.uint32)
+    proofs: list[rp.RangeProof] = []
+    commitments: list[bn254.G1] = []
+    for r in range(pts_bytes.shape[0]):
+        row = pts_bytes[r]
+        sc = [limbs.limbs_to_int(scalars[r, k]) for k in range(5)]
+        data = rp.RangeProofData(
+            T1=_point(row[3]), T2=_point(row[4]), tau=sc[0],
+            C=_point(row[0]), D=_point(row[1]), delta=sc[1],
+            inner_product=sc[2])
+        ipa = rp.IPA(
+            left=sc[3], right=sc[4],
+            L=[_point(row[5 + i]) for i in range(rounds)],
+            R=[_point(row[5 + rounds + i]) for i in range(rounds)])
+        proofs.append(rp.RangeProof(data=data, ipa=ipa))
+        commitments.append(_point(row[2]))
+    return proofs, commitments

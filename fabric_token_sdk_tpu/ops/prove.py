@@ -1,0 +1,109 @@
+"""Shared device codecs for the TPU-side prover (ops layer).
+
+Digest -> Fr challenge reduction, canonical byte serialization and the
+Montgomery inner-product folds used by ``prover/range.py`` and
+``prover/transfer.py``. Lives in ops/ so the prover kernels ride the
+same layer the verifier kernels do (models/ and prover/ may import it,
+never the reverse), and so `scripts/check_lazy_bounds.py` sees any
+lazy-API use here under the ops discipline.
+
+The canonicalization split mirrors the verifier's transcripts exactly:
+
+* challenges only ever USED arithmetically (x, z, x_ipa, the IPA round
+  challenges x_r) take ONE conditional subtract — the same rule-R3
+  argument as ``_derive_var_scalars`` in models/range_verifier.py;
+* challenges whose canonical BYTES re-enter a transcript or the proof
+  (y, whose 32 big-endian bytes are hashed for z; the type-and-sum
+  challenge, which is serialized) take the full reduction.
+
+Everything the prover SERIALIZES (tau, delta, ipa.left/right, the
+sigma responses) comes out of ``field.from_mont``, whose result is
+already canonical — no extra reduction needed there.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import ec, field, limbs
+
+
+def digest_to_fr(words: jnp.ndarray, full: bool = False) -> jnp.ndarray:
+    """SHA-256 digest words -> Fr scalar limbs (plain, not Montgomery).
+
+    words: (..., 8) u32 big-endian digest words (``sha256.digest_padded``
+    output). Returns (..., 16) limbs representing digest mod R — the
+    device twin of ``bn254.hash_to_zr``.
+
+    full=False: one conditional subtract. The raw 256-bit digest is
+    < 2^256 ~ 5.3R; one subtract brings the value under 2^256 - R < 5R,
+    inside mont_mul's single-lazy-operand value bound (rule R3,
+    ops/tfield.py), so ``to_mont`` of the result lands exactly on
+    to_mont(digest mod R).
+
+    full=True: five conditional subtracts -> the canonical residue
+    (digest < 6R, so five provably suffice). Required when the reduced
+    value's canonical bytes are themselves transcript or proof material.
+    """
+    lim = jnp.stack([words & 0xFFFF, words >> 16], axis=-1)
+    lim = lim[..., ::-1, :].reshape(*words.shape[:-1], limbs.NLIMBS)
+    zero = jnp.zeros(lim.shape[:-1] + (1,), dtype=jnp.uint32)
+    out = field._cond_sub_mod(jnp.concatenate([lim, zero], axis=-1),
+                              field.FR)
+    if full:
+        for _ in range(4):
+            out = field._cond_sub_mod(
+                jnp.concatenate([out, zero], axis=-1), field.FR)
+    return out
+
+
+def fr_limbs_to_bytes(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical plain Fr limbs -> 32-byte big-endian encoding.
+
+    (..., 16) u32 -> (..., 32) u8, the device twin of
+    ``serialization.zr_to_bytes`` (which requires its input reduced —
+    hence callers feed ``digest_to_fr(..., full=True)`` or ``from_mont``
+    output only)."""
+    le = a[..., ::-1]
+    hi = (le >> 8).astype(jnp.uint8)
+    lo = (le & 0xFF).astype(jnp.uint8)
+    return jnp.stack([hi, lo], axis=-1).reshape(*a.shape[:-1], 32)
+
+
+def points_to_bytes(pts: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery projective points -> canonical mathlib G1 bytes.
+
+    (..., K, 3, 16) -> (..., K, 64) u8 (x||y, 32-byte big-endian each),
+    one batched Fermat inversion per leading row via ``to_affine_batch``.
+    The identity comes out (0, 0) -> 64 zero bytes, matching
+    ``serialization.g1_to_bytes`` on the host.
+    """
+    aff = ec.to_affine_batch(pts)                  # (..., K, 2, 16) plain
+    a = aff[..., ::-1]
+    hi = (a >> 8).astype(jnp.uint8)
+    lo = (a & 0xFF).astype(jnp.uint8)
+    inter = jnp.stack([hi, lo], axis=-1)           # (..., K, 2, 16, 2)
+    return inter.reshape(*a.shape[:-2], 64)
+
+
+def fr_sum(a: jnp.ndarray) -> jnp.ndarray:
+    """Tree-fold field sum over axis -2: (..., m, 16) -> (..., 16).
+
+    log2(m) levels of the exact ``field.add`` (canonical in/out); odd
+    levels carry their tail term to the next level unchanged."""
+    while a.shape[-2] > 1:
+        m = a.shape[-2]
+        h = m // 2
+        s = field.add(a[..., :h, :], a[..., h:2 * h, :], field.FR)
+        if m % 2:
+            s = jnp.concatenate([s, a[..., 2 * h:, :]], axis=-2)
+        a = s
+    return a[..., 0, :]
+
+
+def fr_dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery inner product over axis -2.
+
+    (..., m, 16) x (..., m, 16) Montgomery limbs -> (..., 16) Montgomery
+    limbs of sum_i a_i * b_i."""
+    return fr_sum(field.mont_mul(a, b, field.FR))

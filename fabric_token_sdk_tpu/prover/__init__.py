@@ -1,0 +1,15 @@
+"""TPU-side proof synthesis (ROADMAP open item 2).
+
+The prover subsystem mirrors the verifier's architecture one layer up:
+``prover/range.py`` synthesizes Bulletproofs-style range proofs (and
+their IPA) in one fused device program per witness chunk, and
+``prover/transfer.py`` adds the sigma-protocol type-and-sum proof plus
+the full transfer composition. Both are pinned byte-for-byte to the
+host provers in ``crypto/rp.py`` / ``crypto/transfer_proof.py`` through
+the ``RangeProverDraws`` / ``TransferDraws`` seams.
+"""
+
+from .range import DeviceRangeProver
+from .transfer import DeviceTransferProver
+
+__all__ = ["DeviceRangeProver", "DeviceTransferProver"]

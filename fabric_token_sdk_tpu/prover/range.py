@@ -1,0 +1,465 @@
+"""Device range-proof synthesis: the whole prover as ONE fused program.
+
+A chunk of B witnesses (value, blinding factor, pinned blinding draws)
+becomes one packed (B, W) u32 upload and one fused dispatch that runs
+the complete ``crypto.rp.range_prove`` computation on device:
+
+  stage A   C = <bits, G> + <bits-1, H> + rho*P,
+            D = <random_left, G> + <random_right, H> + eta*P,
+            com = value*cg0 + bf*cg1             (one 3B-stacked MSM)
+  y, z      SHA-256 transcripts of the stage-A bytes (y takes the FULL
+            canonical reduction — its 32 big-endian bytes are re-hashed
+            for z; everything else rides the verifier's one-cond-sub
+            rule, ops/prove.py)
+  stage B   T1 = t1*cg0 + tau1*cg1, T2 = t2*cg0 + tau2*cg1
+            (one 2B-stacked MSM), then x, the final folded vectors,
+            tau, delta and ip = <left, right>
+  IPA       rgp = y^-i * H_i (fixed-base gather), com_ipa (one MSM),
+            x_ipa via the verifier's own transcript template
+            (_xipa_device_fn), then `rounds` folding rounds as ONE
+            lax.scan whose body is shape-uniform in the ORIGINAL index
+            space — so the whole IPA compiles a single 2B-stacked MSM
+            instead of one kernel per round.
+
+Scan-uniform round state: for every original index i we track the
+generator fold coefficients c_i (of G_i in the folded left generator)
+and d_i (of H'_i in the folded right generator) plus lval_i/rval_i, the
+CURRENT vector entries at position e_i = i mod n_r (n_r = n/2^r — a
+static per-round constant, so the partner gathers i +- h and the
+low/high masks are baked numpy tables). Every round then reads
+
+  L = sum_{e_i >= h} c_i lval_{i-h} G_i + sum_{e_i < h} d_i rval_{i+h} H_i
+      + (x_ipa * <l[:h], r[h:]>) Q
+  R = sum_{e_i < h} c_i lval_{i+h} G_i + sum_{e_i >= h} d_i rval_{i-h} H_i
+      + (x_ipa * <l[h:], r[:h]>) Q
+
+off one full-width fixed-base MSM (zero scalars are exact no-ops), and
+folds lval/rval/c/d with the round challenge. After the last round
+lval_0/rval_0 are ipa.left/ipa.right.
+
+Everything serialized (tau, delta, ip, ipa.left/right, all point bytes)
+leaves the device canonical, so ``models.witness_pack.unpack`` rebuilds
+``rp.RangeProof`` objects byte-identical to the host prover under the
+same ``RangeProverDraws`` — the parity bar tests/test_prover_parity.py
+pins against BOTH verifier paths.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bn254
+from ..crypto import rp
+from ..crypto import serialization as ser
+from ..models import range_verifier as rv
+from ..models import witness_pack
+from ..models.batching import next_pow2 as _next_pow2
+from ..obs import GLOBAL as _METRICS
+from ..obs import PROFILER
+from ..obs import TRACER as _TRACER
+from ..ops import ec, field, limbs
+from ..ops import prove as dprove
+from ..ops import sha256 as dsha
+
+R = bn254.R
+FR = field.FR
+_NL = limbs.NLIMBS
+
+#: rows per fused prove chunk (shared compiled shape across calls).
+_CHUNK_ROWS = max(1, int(os.environ.get("FTS_PROVE_CHUNK", "64")))
+
+#: Prover metric family metadata (HELP independent of call-site order;
+#: tests/test_metric_family_guard.py pins the names, check_metric_help
+#: lints the HELP text).
+_PROVER_FAMILIES = {
+    "prover_proofs_total":
+        "Range proofs synthesized by the device prover",
+    "prover_rows_total":
+        "Witness rows packed into prover chunk uploads (incl. padding)",
+    "prover_pad_rows_total":
+        "All-zero witness rows padded in for chunk shape reuse",
+    "prover_chunks_total":
+        "Fused prover chunk programs dispatched",
+    "prover_synthesize_seconds":
+        "Wall seconds per fused prover chunk (upload->unpack)",
+}
+for _fam, _help in _PROVER_FAMILIES.items():
+    _METRICS.describe(_fam, _help)
+
+
+def _observe_chunk(bits_lbl: str, rows: int, live_rows: int,
+                   seconds: float) -> None:
+    """Per-chunk instrument writes (one fused upload->unpack cycle).
+
+    Module-level so the exposition smoke test can light the chunk
+    families through the production write path without paying a device
+    compile (tests/test_obs_smoke.py)."""
+    _METRICS.histogram("prover_synthesize_seconds",
+                       bits=bits_lbl).observe(seconds)
+    _METRICS.counter("prover_chunks_total", bits=bits_lbl).add()
+    _METRICS.counter("prover_rows_total", bits=bits_lbl).add(rows)
+    _METRICS.counter("prover_pad_rows_total",
+                     bits=bits_lbl).add(rows - live_rows)
+
+
+def _observe_proofs(bits_lbl: str, count: int, forged: bool) -> None:
+    _METRICS.counter("prover_proofs_total", bits=bits_lbl,
+                     forged=str(bool(forged)).lower()).add(count)
+
+
+def _round_consts(n: int):
+    """Static per-round index tables for the scan-uniform IPA.
+
+    Returns (rounds, mask_lo, a_idx, b_idx, ip_mask), each table shaped
+    (rounds, n): mask_lo[r, i] = (i mod n_r) < h; a/b are the fold
+    partner gathers (lval' = x*lval[a] + x^-1*lval[b]); ip_mask marks
+    the representative indices i < h whose lval/rval pairs form the
+    round inner products."""
+    rounds = n.bit_length() - 1
+    idx = np.arange(n)
+    mask_lo = np.zeros((rounds, n), dtype=bool)
+    a_idx = np.zeros((rounds, n), dtype=np.int32)
+    b_idx = np.zeros((rounds, n), dtype=np.int32)
+    ip_mask = np.zeros((rounds, n), dtype=bool)
+    for r in range(rounds):
+        n_r = n >> r
+        h = n_r >> 1
+        e = idx % n_r
+        lo = e < h
+        mask_lo[r] = lo
+        a_idx[r] = np.where(lo, idx, idx - h)
+        b_idx[r] = np.where(lo, idx + h, idx)
+        ip_mask[r] = idx < h
+    return rounds, mask_lo, a_idx, b_idx, ip_mask
+
+
+_PROVE_FNS: dict = {}
+
+
+def _prove_fn(params, B: int):
+    """Jitted fused prove program for (params, B): (tables, packed) ->
+    ((B, 5 + 2*rounds, 64) u8 point bytes in the order
+    [C, D, com, T1, T2, L.., R..], (B, 5, 16) u32 canonical scalars
+    [tau, delta, ip, ipa.left, ipa.right])."""
+    key = (params.bit_length, params.cache_digest, params.q_bytes,
+           params.left_gen_bytes, B)
+    if key in _PROVE_FNS:
+        return _PROVE_FNS[key]
+
+    n = params.bit_length
+    rounds = params.rounds
+    T = 2 * n + 5
+    nw = 6 + 2 * n
+    c_rounds, mask_lo, a_idx, b_idx, ip_mask = _round_consts(n)
+    assert c_rounds == rounds
+    xipa_fn = rv._xipa_device_fn(params)
+    two_i = jnp.asarray(rv._pow2_mont_limbs(n))          # 2^i mont
+    rgp_idx = params.rgp_idx
+    sep = np.frombuffer(ser.SEPARATOR, dtype=np.uint8)
+    mont_neg1 = jnp.asarray(
+        limbs.int_to_limbs((R - 1) * limbs.MONT_R % R))  # mont(-1)
+    r_minus_1 = jnp.asarray(limbs.int_to_limbs(R - 1))   # plain -1
+    bit_limb = np.arange(n) // 16
+    bit_shift = jnp.asarray(np.arange(n) % 16, dtype=np.uint32)
+    consts = (jnp.asarray(mask_lo), jnp.asarray(a_idx),
+              jnp.asarray(b_idx), jnp.asarray(ip_mask))
+
+    def seg(arr, L):
+        return jnp.broadcast_to(jnp.asarray(arr), (B, L))
+
+    def pow_chain(shifter_m, count):
+        """[1, s, s^2, ..., s^(count-1)] in mont form by log-doubling."""
+        pows = jnp.broadcast_to(FR.r1_arr, (B, 1, _NL))
+        sh = shifter_m
+        while pows.shape[1] < count:
+            nxt = field.mont_mul(pows, sh[:, None], FR)
+            pows = jnp.concatenate([pows, nxt], axis=1)
+            if pows.shape[1] < count:
+                sh = field.mont_mul(sh, sh, FR)
+        return pows[:, :count]
+
+    def pts_bytes_flat(pts):
+        """(B, K, 3, 16) -> (B, K, 64) with ONE Fermat for the chunk."""
+        K = pts.shape[1]
+        flat = pts.reshape(1, B * K, 3, _NL)
+        return dprove.points_to_bytes(flat).reshape(B, K, 64)
+
+    def fn(tables, packed):
+        w = packed.reshape(B, nw, _NL)
+        value, bf = w[:, 0], w[:, 1]
+        rho, eta, tau1, tau2 = w[:, 2], w[:, 3], w[:, 4], w[:, 5]
+        rl, rr = w[:, 6:6 + n], w[:, 6 + n:6 + 2 * n]
+
+        bits = (value[:, bit_limb] >> bit_shift) & 1       # (B, n)
+        bit_on = bits[..., None] != 0
+
+        # ---- stage A: {C, D, com} off one 3B-stacked fixed-base MSM.
+        # C's G/H scalars need no mont trip: left_i IS the bit, right_i
+        # is bit-1 = 0 or R-1 (plain residues).
+        left_plain = jnp.zeros((B, n, _NL), jnp.uint32
+                               ).at[..., 0].set(bits)
+        right_plain = jnp.where(bit_on, jnp.zeros((B, n, _NL), jnp.uint32),
+                                jnp.broadcast_to(r_minus_1, (B, n, _NL)))
+        scA = jnp.zeros((B, 3, T, _NL), jnp.uint32)
+        scA = scA.at[:, 0, 0:n].set(left_plain)
+        scA = scA.at[:, 0, n:2 * n].set(right_plain)
+        scA = scA.at[:, 0, 2 * n].set(rho)
+        scA = scA.at[:, 1, 0:n].set(rl)
+        scA = scA.at[:, 1, n:2 * n].set(rr)
+        scA = scA.at[:, 1, 2 * n].set(eta)
+        scA = scA.at[:, 2, 2 * n + 2].set(value)
+        scA = scA.at[:, 2, 2 * n + 3].set(bf)
+        bytesA = pts_bytes_flat(ec.fixed_base_msm(tables, scA))
+
+        # ---- y, z (bulletproof.go:276-282 layout, 388-byte message)
+        hexA = rv._hex_ascii_dev(bytesA)                   # (B, 3, 128)
+        msgy = jnp.concatenate(
+            [hexA[:, 0], seg(sep, 2), hexA[:, 1], seg(sep, 2),
+             hexA[:, 2], seg(dsha.pad_tail(388), 60)], axis=1)
+        y = dprove.digest_to_fr(dsha.digest_padded(msgy), full=True)
+        msgz = jnp.concatenate(
+            [dprove.fr_limbs_to_bytes(y), seg(dsha.pad_tail(32), 32)],
+            axis=1)
+        z = dprove.digest_to_fr(dsha.digest_padded(msgz))
+        y_m, z_m = field.to_mont(y, FR), field.to_mont(z, FR)
+
+        # ---- polynomial commitment inputs (bulletproof.go:336-466)
+        y_pows = pow_chain(y_m, n)                         # y^i
+        yinv_m = field.inv(y_m, FR)
+        yinv_pows = pow_chain(yinv_m, n)                   # y^-i
+        z_b = jnp.broadcast_to(z_m[:, None], (B, n, _NL))
+        z_sq = field.mont_sqr(z_m, FR)
+        left_m = jnp.where(bit_on,
+                           jnp.broadcast_to(FR.r1_arr, (B, n, _NL)),
+                           jnp.zeros((B, n, _NL), jnp.uint32))
+        right_m = jnp.where(bit_on, jnp.zeros((B, n, _NL), jnp.uint32),
+                            jnp.broadcast_to(mont_neg1, (B, n, _NL)))
+        rl_m, rr_m = field.to_mont(rl, FR), field.to_mont(rr, FR)
+        lp_m = field.sub(left_m, z_b, FR)
+        rp_m = field.mont_mul(field.add(right_m, z_b, FR), y_pows, FR)
+        rrp_m = field.mont_mul(rr_m, y_pows, FR)
+        zp_m = field.mont_mul(
+            jnp.broadcast_to(z_sq[:, None], (B, n, _NL)),
+            jnp.broadcast_to(two_i[None], (B, n, _NL)), FR)
+        t1_m = field.add(
+            field.add(dprove.fr_dot(lp_m, rrp_m),
+                      dprove.fr_dot(rp_m, rl_m), FR),
+            dprove.fr_dot(zp_m, rl_m), FR)
+        t2_m = dprove.fr_dot(rl_m, rrp_m)
+
+        # ---- stage B: {T1, T2} off one 2B-stacked MSM, then x.
+        scB = jnp.zeros((B, 2, T, _NL), jnp.uint32)
+        scB = scB.at[:, 0, 2 * n + 2].set(field.from_mont(t1_m, FR))
+        scB = scB.at[:, 0, 2 * n + 3].set(tau1)
+        scB = scB.at[:, 1, 2 * n + 2].set(field.from_mont(t2_m, FR))
+        scB = scB.at[:, 1, 2 * n + 3].set(tau2)
+        bytesB = pts_bytes_flat(ec.fixed_base_msm(tables, scB))
+        hexB = rv._hex_ascii_dev(bytesB)
+        msgx = jnp.concatenate(
+            [hexB[:, 0], seg(sep, 2), hexB[:, 1],
+             seg(dsha.pad_tail(258), 62)], axis=1)
+        x = dprove.digest_to_fr(dsha.digest_padded(msgx))
+        x_m = field.to_mont(x, FR)
+        x_b = jnp.broadcast_to(x_m[:, None], (B, n, _NL))
+
+        # ---- final folded vectors + serialized scalars
+        lfin = field.add(lp_m, field.mont_mul(x_b, rl_m, FR), FR)
+        rfin = field.add(
+            field.add(rp_m, field.mont_mul(x_b, rrp_m, FR), FR),
+            zp_m, FR)
+        tau_m = field.add(
+            field.add(field.mont_mul(x_m, field.to_mont(tau1, FR), FR),
+                      field.mont_mul(field.to_mont(tau2, FR),
+                                     field.mont_sqr(x_m, FR), FR), FR),
+            field.mont_mul(z_sq, field.to_mont(bf, FR), FR), FR)
+        delta_m = field.add(field.to_mont(rho, FR),
+                            field.mont_mul(field.to_mont(eta, FR), x_m,
+                                           FR), FR)
+        ip_m = dprove.fr_dot(lfin, rfin)
+        ip_plain = field.from_mont(ip_m, FR)
+
+        # ---- IPA setup: rgp points, com_ipa, x_ipa
+        yinv_plain = field.from_mont(yinv_pows, FR)
+        rgp_pts = ec.fixed_base_gather(
+            jnp.take(tables, rgp_idx, axis=0), yinv_plain)
+        rgp_bytes = dprove.points_to_bytes(rgp_pts)        # (B, n, 64)
+        scI = jnp.zeros((B, T, _NL), jnp.uint32)
+        scI = scI.at[:, 0:n].set(field.from_mont(lfin, FR))
+        scI = scI.at[:, n:2 * n].set(
+            field.from_mont(field.mont_mul(yinv_pows, rfin, FR), FR))
+        com_ipa_pt = ec.fixed_base_msm(tables, scI)        # (B, 3, 16)
+        com_ipa_bytes = dprove.points_to_bytes(
+            com_ipa_pt.reshape(1, B, 3, _NL)).reshape(B, 64)
+        ip_bytes = dprove.fr_limbs_to_bytes(ip_plain)
+        x_ipa = dprove.digest_to_fr(
+            xipa_fn(rgp_bytes, com_ipa_bytes, ip_bytes))
+        x_ipa_m = field.to_mont(x_ipa, FR)
+
+        # ---- IPA rounds: one scan, one 2B-stacked MSM per round body
+        zero_v = jnp.zeros((B, n, _NL), jnp.uint32)
+        tail258 = dsha.pad_tail(258)
+
+        def body(carry, xs):
+            lval, rval, c, d = carry
+            lo, a, b, ipm = xs
+            lo_b = jnp.broadcast_to(lo[None, :], (B, n))
+            hi_b = jnp.logical_not(lo_b)
+            ipm_b = jnp.broadcast_to(ipm[None, :], (B, n))
+            lval_a = jnp.take(lval, a, axis=1)
+            lval_b = jnp.take(lval, b, axis=1)
+            rval_a = jnp.take(rval, a, axis=1)
+            rval_b = jnp.take(rval, b, axis=1)
+            lip = dprove.fr_sum(field.select(
+                ipm_b, field.mont_mul(lval, rval_b, FR), zero_v))
+            rip = dprove.fr_sum(field.select(
+                ipm_b, field.mont_mul(lval_b, rval, FR), zero_v))
+            sc2 = jnp.zeros((B, 2, T, _NL), jnp.uint32)
+            sc2 = sc2.at[:, 0, 0:n].set(field.from_mont(field.select(
+                hi_b, field.mont_mul(c, lval_a, FR), zero_v), FR))
+            sc2 = sc2.at[:, 0, n:2 * n].set(field.from_mont(field.select(
+                lo_b, field.mont_mul(d, rval_b, FR), zero_v), FR))
+            sc2 = sc2.at[:, 0, 2 * n + 1].set(field.from_mont(
+                field.mont_mul(x_ipa_m, lip, FR), FR))
+            sc2 = sc2.at[:, 1, 0:n].set(field.from_mont(field.select(
+                lo_b, field.mont_mul(c, lval_b, FR), zero_v), FR))
+            sc2 = sc2.at[:, 1, n:2 * n].set(field.from_mont(field.select(
+                hi_b, field.mont_mul(d, rval_a, FR), zero_v), FR))
+            sc2 = sc2.at[:, 1, 2 * n + 1].set(field.from_mont(
+                field.mont_mul(x_ipa_m, rip, FR), FR))
+            pb = pts_bytes_flat(ec.fixed_base_msm(tables, sc2))
+            hexLR = rv._hex_ascii_dev(pb)
+            msg = jnp.concatenate(
+                [hexLR[:, 0], seg(sep, 2), hexLR[:, 1],
+                 seg(tail258, 62)], axis=1)
+            xr = dprove.digest_to_fr(dsha.digest_padded(msg))
+            xr_m = field.to_mont(xr, FR)
+            xrinv_m = field.inv(xr_m, FR)
+            xr_b = jnp.broadcast_to(xr_m[:, None], (B, n, _NL))
+            xrinv_b = jnp.broadcast_to(xrinv_m[:, None], (B, n, _NL))
+            c = field.mont_mul(c, field.select(lo_b, xrinv_b, xr_b), FR)
+            d = field.mont_mul(d, field.select(lo_b, xr_b, xrinv_b), FR)
+            lval = field.add(field.mont_mul(xr_b, lval_a, FR),
+                             field.mont_mul(xrinv_b, lval_b, FR), FR)
+            rval = field.add(field.mont_mul(xrinv_b, rval_a, FR),
+                             field.mont_mul(xr_b, rval_b, FR), FR)
+            return (lval, rval, c, d), pb
+
+        c0 = jnp.broadcast_to(FR.r1_arr, (B, n, _NL))
+        (lval, rval, _, _), pbs = jax.lax.scan(
+            body, (lfin, rfin, c0, yinv_pows), consts)
+        lr = jnp.transpose(pbs, (1, 2, 0, 3))              # (B, 2, r, 64)
+
+        pts_out = jnp.concatenate(
+            [bytesA, bytesB, lr[:, 0], lr[:, 1]], axis=1)
+        scalars_out = jnp.stack(
+            [field.from_mont(tau_m, FR), field.from_mont(delta_m, FR),
+             ip_plain, field.from_mont(lval[:, 0], FR),
+             field.from_mont(rval[:, 0], FR)], axis=1)
+        return pts_out, scalars_out
+
+    _PROVE_FNS[key] = jax.jit(fn)
+    return _PROVE_FNS[key]
+
+
+class DeviceRangeProver:
+    """Batched on-device range prover for one PublicParams set.
+
+    Reuses the verifier's fixed-base tables (`rv._params_for`) — the
+    prover adds no table memory of its own. ``prove`` rejects
+    out-of-range witnesses up front (the host ``range_prove`` silently
+    truncates; the prove-time contract lives here) unless ``forge=True``
+    seeds deliberately invalid rows for adversarial corpora — those
+    produce proofs byte-identical to the host prover's on the same
+    draws, and both verifiers reject them.
+    """
+
+    def __init__(self, pp, chunk_rows: int | None = None):
+        self.pp = pp
+        self.bit_length = pp.range_proof_params.bit_length
+        self.rounds = pp.range_proof_params.number_of_rounds
+        self.chunk_rows = chunk_rows
+        self._params = None
+
+    @property
+    def params(self):
+        """Verifier-shared device params; built lazily so witness
+        validation (and its tests) never pays the table build."""
+        if self._params is None:
+            self._params = rv._params_for(self.pp)
+        return self._params
+
+    def _chunk_rows_for(self, total: int) -> int:
+        if self.chunk_rows is not None:
+            return self.chunk_rows
+        return min(_CHUNK_ROWS, _next_pow2(total))
+
+    def prove(self, values, blinding_factors, draws=None,
+              forge: bool = False):
+        """Synthesize proofs for every (value, bf) witness row.
+
+        Returns (proofs, commitments): ``rp.RangeProof`` objects plus
+        the device-computed Pedersen commitments value*cg0 + bf*cg1.
+        Raises ValueError at prove time for out-of-range values unless
+        ``forge=True``.
+        """
+        n = self.bit_length
+        values = list(values)
+        bfs = list(blinding_factors)
+        if len(values) != len(bfs):
+            raise ValueError(
+                f"{len(values)} values vs {len(bfs)} blinding factors")
+        if not forge:
+            for i, v in enumerate(values):
+                if not 0 <= v < (1 << n):
+                    raise ValueError(
+                        f"witness {i} out of range for {n}-bit proof: "
+                        f"{v} (pass forge=True to seed invalid rows)")
+        if draws is None:
+            draws = [rp.RangeProverDraws.random(n) for _ in values]
+        if len(draws) != len(values):
+            raise ValueError(
+                f"{len(draws)} draws rows vs {len(values)} values")
+
+        rows = self._chunk_rows_for(len(values))
+        fn = _prove_fn(self.params, rows)
+        bits_lbl = str(n)
+        proofs: list[rp.RangeProof] = []
+        commitments: list[bn254.G1] = []
+        for lo in range(0, len(values), rows):
+            hi = min(lo + rows, len(values))
+            packed = witness_pack.pack_range_witnesses(
+                values[lo:hi], bfs[lo:hi], draws[lo:hi], n)
+            padded = witness_pack.pad_witness_rows(packed, rows)
+            t0 = time.perf_counter()
+            with _TRACER.span("prover.synthesize", rows=hi - lo,
+                              chunk=rows, bits=n):
+                dev = jnp.asarray(padded)
+                rv._count("prove_chunk_upload")
+                pts, sc = fn(self.params.tables, dev)
+                rv._count("prove_chunk_dispatch")
+                pts_np = np.asarray(jax.device_get(pts))
+                sc_np = np.asarray(jax.device_get(sc))
+            _observe_chunk(bits_lbl, rows, hi - lo,
+                           time.perf_counter() - t0)
+            ch_proofs, ch_coms = witness_pack.unpack_range_outputs(
+                pts_np[:hi - lo], sc_np[:hi - lo], self.rounds)
+            proofs.extend(ch_proofs)
+            commitments.extend(ch_coms)
+        _observe_proofs(bits_lbl, len(proofs), forge)
+        return proofs, commitments
+
+    def kernel_cost(self, rows: int | None = None) -> dict | None:
+        """XLA cost analysis of the fused prove chunk program, published
+        under the `profile_bucket_*` gauges as kind "prove_chunk"."""
+        rows = rows or self._chunk_rows_for(_CHUNK_ROWS)
+        fn = _prove_fn(self.params, rows)
+        packed_sd = jax.ShapeDtypeStruct(
+            (rows, witness_pack.witness_width(self.bit_length)),
+            jnp.uint32)
+        return PROFILER.capture_kernel_cost(
+            "prove_chunk", rows, fn, self.params.tables, packed_sd)

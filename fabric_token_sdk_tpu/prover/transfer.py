@@ -1,0 +1,292 @@
+"""Device transfer proving: type-and-sum sigma protocol + composition.
+
+The type-and-sum proof (crypto/transfer_proof.py, reference
+typeandsum.go) is one fused device program per (n_inputs, n_outputs, B)
+shape: a single packed u32 upload carries the witness scalars AND the
+statement points (inputs, outputs, commitment_to_type as projective
+limbs), one dispatch computes
+
+  - the sigma commitments com_type / com_inputs / com_sum off one
+    fixed-base MSM over a 3-generator [ped0, ped1, ped2] plane table,
+  - the adjusted points adj = pt - com_type (complete projective adds)
+    and their signed sum via an add_zlazy chain (Z-carry resolution
+    deferred to one normalize_point — the same lazy discipline
+    `scripts/check_lazy_bounds.py` enforces on the verifier kernels),
+  - the Fiat-Shamir challenge over the canonical point bytes
+    (typeandsum.go:214,267 ordering; FULL digest reduction — the
+    challenge is serialized into the proof),
+  - and the sigma responses, all leaving the device canonical.
+
+Parity bar: the same ``TypeAndSumDraws`` fed to the host
+``type_and_sum_prove`` must yield a byte-identical ``serialize()``.
+``DeviceTransferProver.transfer_prove`` composes this with
+``DeviceRangeProver`` for the output range proofs — the adjusted output
+commitment outputs_i - com_type equals cg0^value * cg1^(bf - type_bf),
+exactly the commitment the range chunk program computes on device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bn254
+from ..crypto import transfer_proof as tp
+from ..crypto import serialization as ser
+from ..crypto.bn254 import fr_sub, g1_add, g1_mul, hash_to_zr
+from ..models import range_verifier as rv
+from ..obs import TRACER as _TRACER
+from ..ops import ec, field, limbs
+from ..ops import prove as dprove
+from ..ops import sha256 as dsha
+from .range import _observe_chunk, _observe_proofs
+
+R = bn254.R
+FR = field.FR
+_NL = limbs.NLIMBS
+
+#: pedersen-generator plane tables for the sigma commitments, keyed by
+#: the generator digest (same never-share-across-pp rule as the
+#: verifier's _PARAMS_CACHE).
+_PED_TABLES: dict = {}
+
+
+def _ped_tables(pp):
+    h = hashlib.sha256()
+    for p in pp.pedersen_generators[:3]:
+        h.update(ser.g1_to_bytes(p))
+    key = h.digest()
+    if key not in _PED_TABLES:
+        pts = jnp.asarray(limbs.points_to_projective_limbs(
+            list(pp.pedersen_generators[:3])))
+        _PED_TABLES[key] = (key.hex()[:16], rv._tables_kernel(pts))
+    return _PED_TABLES[key]
+
+
+def _adjusted_sum(adj_in, adj_out_neg):
+    """sum(adj_in) - sum(adj_out) as one lazy-Z fold: (B, k, 3, 16)
+    Montgomery projective operands (already-negated outputs), carries
+    resolved once at the chain end."""
+    B = adj_in.shape[0]
+    acc = jnp.broadcast_to(
+        jnp.asarray(limbs.point_to_projective_limbs(bn254.G1_IDENTITY)),
+        (B, 3, _NL))
+    for i in range(adj_in.shape[1]):
+        acc = ec.add_zlazy(acc, adj_in[:, i])
+    for j in range(adj_out_neg.shape[1]):
+        acc = ec.add_zlazy(acc, adj_out_neg[:, j])
+    return ec.normalize_point(acc)
+
+
+_TS_FNS: dict = {}
+
+
+def _ts_fn(digest: str, n_in: int, n_out: int, B: int):
+    """Jitted fused type-and-sum program: (tables3, packed) ->
+    (B, 4 + 2*n_in, 16) canonical plain scalars in the order
+    [challenge, type_, type_blinding_factor, equality_of_sum,
+    input_values.., input_blinding_factors..]."""
+    key = (digest, n_in, n_out, B)
+    if key in _TS_FNS:
+        return _TS_FNS[key]
+
+    ns = 5 + 4 * n_in + n_out               # packed scalar count
+    npts = n_in + n_out + 1                 # inputs ++ outputs ++ ct
+    M = 2 * n_in + n_out + 4                # transcript point count
+    msg_len = 130 * M - 2
+    sep = np.frombuffer(ser.SEPARATOR, dtype=np.uint8)
+    tail = dsha.pad_tail(msg_len)
+
+    def fn(tables3, packed):
+        sc = packed[:, :ns * _NL].reshape(B, ns, _NL)
+        pts = packed[:, ns * _NL:].reshape(B, npts, 3, _NL)
+        type_zr, type_bf = sc[:, 0], sc[:, 1]
+        r_type, r_type_bf, r_sum_bf = sc[:, 2], sc[:, 3], sc[:, 4]
+        in_values = sc[:, 5:5 + n_in]
+        in_bfs = sc[:, 5 + n_in:5 + 2 * n_in]
+        r_in_values = sc[:, 5 + 2 * n_in:5 + 3 * n_in]
+        r_in_bfs = sc[:, 5 + 3 * n_in:5 + 4 * n_in]
+        out_bfs = sc[:, 5 + 4 * n_in:]
+        inputs = pts[:, :n_in]
+        outputs = pts[:, n_in:n_in + n_out]
+        ct = pts[:, n_in + n_out]
+
+        # sigma commitments: one (n_in + 2)-row fixed-base MSM over
+        # [ped0, ped1, ped2]; row order [com_inputs.., com_type, com_sum]
+        scm = jnp.zeros((B, n_in + 2, 3, _NL), jnp.uint32)
+        scm = scm.at[:, :n_in, 1].set(r_in_values)
+        scm = scm.at[:, :n_in, 2].set(r_in_bfs)
+        scm = scm.at[:, n_in, 0].set(r_type)
+        scm = scm.at[:, n_in, 2].set(r_type_bf)
+        scm = scm.at[:, n_in + 1, 2].set(r_sum_bf)
+        coms = ec.fixed_base_msm(tables3, scm)   # (B, n_in + 2, 3, 16)
+
+        # adjusted statement: adj = pt - com_type, signed lazy-Z sum
+        neg_ct = ec.neg(ct)
+        adj_in = ec.add(
+            inputs, jnp.broadcast_to(neg_ct[:, None], inputs.shape))
+        adj_out = ec.add(
+            outputs, jnp.broadcast_to(neg_ct[:, None], outputs.shape))
+        sum_ = _adjusted_sum(adj_in, ec.neg(adj_out))
+
+        # transcript: [com_inputs.., com_type, com_sum, adj_in..,
+        # adj_out.., ct, sum_] -> hex-"||" join -> SHA-256 -> chal
+        allpts = jnp.concatenate(
+            [coms, adj_in, adj_out, ct[:, None], sum_[:, None]], axis=1)
+        hexes = rv._hex_ascii_dev(dprove.points_to_bytes(allpts))
+        sep_b = jnp.broadcast_to(jnp.asarray(sep), (B, M, 2))
+        joined = jnp.concatenate([hexes, sep_b], axis=2).reshape(
+            B, 130 * M)[:, :msg_len]
+        msg = jnp.concatenate(
+            [joined, jnp.broadcast_to(jnp.asarray(tail),
+                                      (B, len(tail)))], axis=1)
+        chal = dprove.digest_to_fr(dsha.digest_padded(msg), full=True)
+
+        # sigma responses (typeandsum.go:280-316)
+        chal_m = field.to_mont(chal, FR)
+        tm = lambda a: field.to_mont(a, FR)
+        resp = lambda w, r: field.from_mont(
+            field.add(field.mont_mul(
+                jnp.broadcast_to(chal_m[..., None, :]
+                                 if w.ndim == 3 else chal_m, w.shape),
+                w, FR), r, FR), FR)
+        type_resp = resp(tm(type_zr), tm(r_type))
+        tbf_resp = resp(tm(type_bf), tm(r_type_bf))
+        t = field.sub(tm(in_bfs),
+                      jnp.broadcast_to(tm(type_bf)[:, None],
+                                       (B, n_in, _NL)), FR)
+        iv_resp = resp(tm(in_values), tm(r_in_values))
+        ibf_resp = resp(t, tm(r_in_bfs))
+        t_out = field.sub(tm(out_bfs),
+                          jnp.broadcast_to(tm(type_bf)[:, None],
+                                           (B, n_out, _NL)), FR)
+        sum_bf = field.sub(dprove.fr_sum(t), dprove.fr_sum(t_out), FR)
+        eq_resp = resp(sum_bf, tm(r_sum_bf))
+
+        return jnp.concatenate(
+            [jnp.stack([chal, type_resp, tbf_resp, eq_resp], axis=1),
+             iv_resp, ibf_resp], axis=1)
+
+    _TS_FNS[key] = jax.jit(fn)
+    return _TS_FNS[key]
+
+
+class DeviceTransferProver:
+    """Device type-and-sum + transfer composition for one PublicParams.
+
+    ``prove_type_and_sum`` batches same-shape sigma proofs;
+    ``transfer_prove`` is the device twin of
+    ``crypto.transfer_proof.transfer_prove`` (same TransferDraws seam,
+    byte-identical serialized proof)."""
+
+    def __init__(self, pp, range_chunk_rows: int | None = None):
+        self.pp = pp
+        self._digest, self._tables3 = _ped_tables(pp)
+        self._range = None
+        self._range_chunk_rows = range_chunk_rows
+
+    def _range_prover(self):
+        if self._range is None:
+            from .range import DeviceRangeProver
+
+            self._range = DeviceRangeProver(
+                self.pp, chunk_rows=self._range_chunk_rows)
+        return self._range
+
+    def prove_type_and_sum(self, statements, draws=None):
+        """statements: list of dicts with keys inputs, outputs (G1
+        lists, same shape across the batch), commitment_to_type (G1),
+        in_values, in_bfs, out_bfs, type_zr, type_bf. Returns one
+        ``TypeAndSumProof`` per statement."""
+        B = len(statements)
+        n_in = len(statements[0]["inputs"])
+        n_out = len(statements[0]["outputs"])
+        if draws is None:
+            draws = [tp.TypeAndSumDraws.random(n_in) for _ in statements]
+        ns = 5 + 4 * n_in + n_out
+        packed = np.zeros((B, (ns + (n_in + n_out + 1) * 3) * _NL),
+                          dtype=np.uint32)
+        for r, st in enumerate(statements):
+            if (len(st["inputs"]) != n_in
+                    or len(st["outputs"]) != n_out):
+                raise ValueError("mixed statement shapes in one batch")
+            d = draws[r]
+            row = ([st["type_zr"] % R, st["type_bf"] % R, d.r_type % R,
+                    d.r_type_bf % R, d.r_sum_bf % R]
+                   + [v % R for v in st["in_values"]]
+                   + [v % R for v in st["in_bfs"]]
+                   + [v % R for v in d.r_in_values]
+                   + [v % R for v in d.r_in_bfs]
+                   + [v % R for v in st["out_bfs"]])
+            packed[r, :ns * _NL] = limbs.ints_to_limbs(row).reshape(-1)
+            pts = limbs.points_to_projective_limbs(
+                list(st["inputs"]) + list(st["outputs"])
+                + [st["commitment_to_type"]])
+            packed[r, ns * _NL:] = pts.reshape(-1)
+
+        fn = _ts_fn(self._digest, n_in, n_out, B)
+        t0 = time.perf_counter()
+        with _TRACER.span("prover.synthesize", kind="type_and_sum",
+                          rows=B, n_in=n_in, n_out=n_out):
+            dev = jnp.asarray(packed)
+            rv._count("prove_ts_upload")
+            out = fn(self._tables3, dev)
+            rv._count("prove_ts_dispatch")
+            out_np = np.asarray(jax.device_get(out))
+        _observe_chunk("ts", B, B, time.perf_counter() - t0)
+        _observe_proofs("ts", B, forged=False)
+
+        proofs = []
+        for r, st in enumerate(statements):
+            sc = [limbs.limbs_to_int(out_np[r, k])
+                  for k in range(out_np.shape[1])]
+            proofs.append(tp.TypeAndSumProof(
+                commitment_to_type=st["commitment_to_type"],
+                challenge=sc[0], type_=sc[1],
+                type_blinding_factor=sc[2], equality_of_sum=sc[3],
+                input_values=sc[4:4 + n_in],
+                input_blinding_factors=sc[4 + n_in:4 + 2 * n_in]))
+        return proofs
+
+    def transfer_prove(self, input_witness, output_witness, inputs,
+                       outputs, draws=None) -> bytes:
+        """Device twin of ``transfer_proof.transfer_prove``: witnesses
+        are (type, value, blinding_factor) tuples; returns the
+        serialized TransferProof."""
+        pp = self.pp
+        token_type = input_witness[0][0]
+        type_zr = hash_to_zr(token_type.encode())
+        if draws is None:
+            draws = tp.TransferDraws.random(
+                len(input_witness), len(output_witness),
+                pp.range_proof_params.bit_length)
+        type_bf = draws.type_bf
+        commitment_to_type = g1_add(
+            g1_mul(pp.pedersen_generators[0], type_zr),
+            g1_mul(pp.pedersen_generators[2], type_bf))
+
+        ts = self.prove_type_and_sum([{
+            "inputs": inputs, "outputs": outputs,
+            "commitment_to_type": commitment_to_type,
+            "in_values": [w[1] for w in input_witness],
+            "in_bfs": [w[2] for w in input_witness],
+            "out_bfs": [w[2] for w in output_witness],
+            "type_zr": type_zr, "type_bf": type_bf,
+        }], draws=[draws.ts])[0]
+
+        rc = None
+        if len(input_witness) != 1 or len(output_witness) != 1:
+            from ..crypto import rp as rp_mod
+
+            range_proofs, _ = self._range_prover().prove(
+                [w[1] for w in output_witness],
+                [fr_sub(w[2], type_bf) for w in output_witness],
+                draws=draws.ranges or None)
+            rc = rp_mod.RangeCorrectness(range_proofs)
+
+        return tp.TransferProof(
+            type_and_sum=ts, range_correctness=rc).serialize()

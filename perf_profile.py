@@ -42,6 +42,11 @@ Modes (--mode):
            produce verdicts bit-identical to the single-device verifier,
            and reports the single-vs-mesh wall ratio. On CPU, 8 virtual
            host devices are forced automatically (JAX_PLATFORMS=cpu).
+  prove    device-prover audit: DeviceRangeProver.prove() must cost
+           exactly 1 packed witness upload + 1 fused synthesis dispatch
+           per chunk (asserted via the same dispatch hook); prints the
+           XLA cost analysis of the prove chunk program and device
+           proofs/s vs the host prover's measured wall-clock.
 
 Output: human-readable table on stderr, one JSON document on stdout.
 --trace <path> additionally writes the span tree as Chrome trace-event
@@ -387,6 +392,89 @@ def _mode_pipeline(args, tracer, records) -> dict:
     return doc
 
 
+def _mode_prove(args, tracer, records) -> dict:
+    """Device prover audit: cost analysis + dispatch contract (prover/).
+
+    Three artifacts:
+      1. Dispatch/upload counts from the production DeviceRangeProver
+         .prove(), via the same range_verifier dispatch hook the verify
+         pipeline audits ride: a prove chunk must cost exactly ONE
+         packed witness upload + ONE fused synthesis dispatch.
+      2. Lower-only XLA cost analysis of the fused prove chunk program
+         (kernel_cost publishes it under profile_bucket_* as kind
+         "prove_chunk").
+      3. Device proofs/s vs the host prover's measured wall-clock on
+         the same witnesses — the prover twin of the verify headline.
+    """
+    import collections
+    import random
+
+    from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+    from fabric_token_sdk_tpu.harness.corpus import _seeded_draws
+    from fabric_token_sdk_tpu.models import range_verifier as rv
+    from fabric_token_sdk_tpu.prover import DeviceRangeProver
+
+    import bench
+
+    pp = setup.PublicParams.deserialize(
+        (bench.BENCH_DIR / "pp.json").read_bytes())
+    rpp = pp.range_proof_params
+    bits = rpp.bit_length
+    rng = random.Random(17)
+    values = [rng.randrange(1 << bits) for _ in range(args.batch)]
+    bfs = [rng.randrange(1, bn254.R) for _ in range(args.batch)]
+    draws = [_seeded_draws(rng, bits) for _ in range(args.batch)]
+
+    prover = DeviceRangeProver(pp)
+    chunk = prover._chunk_rows_for(args.batch)
+    print(f"warm-up prove chunk ({chunk} rows, compiles)", file=sys.stderr)
+    prover.prove(values[:chunk], bfs[:chunk], draws=draws[:chunk])
+
+    counts: collections.Counter = collections.Counter()
+    rv._DISPATCH_HOOK = lambda kind: counts.update((kind,))
+    try:
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            proofs, coms = prover.prove(values, bfs, draws=draws)
+        wall = time.perf_counter() - t0
+    finally:
+        rv._DISPATCH_HOOK = None
+
+    doc = _report(tracer, "prover.synthesize", records, wall,
+                  args.reps * args.batch, args.trace)
+    n_chunks = args.reps * -(-args.batch // chunk)
+    per_chunk = {k: counts[k] / n_chunks
+                 for k in ("prove_chunk_upload", "prove_chunk_dispatch")}
+    doc["dispatch_counts"] = dict(counts)
+    doc["chunks_counted"] = n_chunks
+    doc["per_chunk"] = per_chunk
+    print(f"{n_chunks} prove chunks: "
+          f"{per_chunk['prove_chunk_upload']:.2f} uploads + "
+          f"{per_chunk['prove_chunk_dispatch']:.2f} dispatches per chunk",
+          file=sys.stderr)
+    # the packed-witness contract: ONE upload + ONE fused program per
+    # chunk, same bar as the verify pipeline
+    assert per_chunk["prove_chunk_upload"] == 1.0, per_chunk
+    assert per_chunk["prove_chunk_dispatch"] == 1.0, per_chunk
+
+    doc["cost_analysis"] = prover.kernel_cost(rows=chunk)
+
+    cg = pp.pedersen_generators[1:3]
+    t0 = time.perf_counter()
+    rp.range_prove(coms[0], values[0], cg, bfs[0], rpp.left_generators,
+                   rpp.right_generators, rpp.P, rpp.Q,
+                   rpp.number_of_rounds, bits, draws=draws[0])
+    host_s = time.perf_counter() - t0
+    dev_s = wall / (args.reps * args.batch)
+    doc["host_prover_s_per_proof"] = round(host_s, 4)
+    doc["device_s_per_proof"] = round(dev_s, 6)
+    doc["device_over_host_speedup"] = round(host_s / dev_s, 2) if dev_s \
+        else None
+    print(f"host {host_s:.2f} s/proof vs device {dev_s * 1e3:.2f} "
+          f"ms/proof ({host_s / dev_s:.0f}x)", file=sys.stderr)
+    return doc
+
+
 def _mode_mesh(args, tracer, records) -> dict:
     """Multi-chip scaling audit: the fused-chunk dispatch contract under
     a (dp, tp) mesh (round 8).
@@ -485,7 +573,7 @@ def _mode_mesh(args, tracer, records) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("range", "block", "barrier", "fold",
-                                       "pipeline", "mesh"),
+                                       "pipeline", "mesh", "prove"),
                     default="range")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=3)
@@ -511,7 +599,8 @@ def main() -> None:
         TRACER.profile_dir = args.xprof
     mode = {"range": _mode_range, "block": _mode_block,
             "barrier": _mode_barrier, "fold": _mode_fold,
-            "pipeline": _mode_pipeline, "mesh": _mode_mesh}[args.mode]
+            "pipeline": _mode_pipeline, "mesh": _mode_mesh,
+            "prove": _mode_prove}[args.mode]
     doc = mode(args, TRACER, RECORDS)
     doc["mode"] = args.mode
     doc["batch"] = args.batch

@@ -63,6 +63,7 @@ _HEAVY_MODULES = {
     "test_range_verifier.py",
     "test_range_verifier_multibit.py",
     "test_range_verifier_sharded.py",
+    "test_prover_parity.py",
     "test_zkatdlog_e2e.py",
     "test_zk_audit.py",
     "test_ops_windowed.py",

@@ -146,6 +146,13 @@ STABLE_FAMILIES = (
     "fleet_node_age_seconds",
     "fleet_nodes",
     "fleet_samples",
+    # prover/ device proof synthesis + harness corpus
+    "prover_chunks_total",
+    "prover_corpus_proofs_total",
+    "prover_pad_rows_total",
+    "prover_proofs_total",
+    "prover_rows_total",
+    "prover_synthesize_seconds",
 )
 
 #: Families whose names are built dynamically: family -> the source
@@ -188,7 +195,7 @@ def test_no_duplicate_family_entries():
                                     "txgen_", "resil_", "telemetry_",
                                     "slo_", "profile_", "journal_",
                                     "hb_", "fleet_", "wal_", "crash_",
-                                    "rpc_", "mesh_", "lane_"])
+                                    "rpc_", "mesh_", "lane_", "prover_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

@@ -544,3 +544,47 @@ def test_rpc_families_export():
     assert "# TYPE rpc_connections_active gauge" in text
     assert "# TYPE rpc_call_seconds histogram" in text
     assert "# HELP rpc_frame_errors_total" in text
+
+
+# prover/ device proof synthesis families (PR: tpu-side prover) — stable
+# interface; the synthesis path itself is covered in tests/test_prover.py
+# and tests/test_prover_parity.py
+EXPECTED_PROVER_FAMILIES = (
+    "prover_proofs_total",
+    "prover_rows_total",
+    "prover_pad_rows_total",
+    "prover_chunks_total",
+    "prover_synthesize_seconds",
+    "prover_corpus_proofs_total",
+)
+
+
+def test_prover_families_export():
+    """The prover metric write path (the same helpers prove() calls per
+    chunk) plus one host-source corpus generation light every prover_*
+    family in a single exposition — without a device compile."""
+    from fabric_token_sdk_tpu.crypto import setup
+    from fabric_token_sdk_tpu.harness.corpus import ProofCorpus
+    from fabric_token_sdk_tpu.prover import range as prover_range
+
+    GLOBAL.reset()
+    # production write path: one padded chunk (4 slots, 3 live rows)
+    prover_range._observe_chunk("16", rows=4, live_rows=3, seconds=0.01)
+    prover_range._observe_proofs("16", count=3, forged=False)
+    prover_range._observe_proofs("16", count=1, forged=True)
+    # corpus provenance counter: a tiny host-source corpus with one
+    # forged row (forge_every=3 -> index 2)
+    pp = setup.setup(4)
+    entries = ProofCorpus(pp, source="host", seed=5,
+                          forge_every=3).generate(3)
+    assert [e.forged for e in entries] == [False, False, True]
+
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_PROVER_FAMILIES:
+        assert fam in text, f"prover family silent: {fam}"
+    assert "# TYPE prover_synthesize_seconds histogram" in text
+    assert re.search(r'prover_pad_rows_total\{[^}]*bits="16"', text)
+    assert re.search(
+        r'prover_proofs_total\{[^}]*forged="true"[^}]*\} 1\.0', text)
+    assert re.search(
+        r'prover_corpus_proofs_total\{[^}]*source="host"', text)

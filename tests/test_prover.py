@@ -1,0 +1,176 @@
+"""Host-prover edge cases + prover API contracts (cheap, CPU tier-1).
+
+These pin the HOST prover semantics the device path must match (the
+byte-for-byte match itself is tests/test_prover_parity.py): the range
+edges value=0 and value=2^n - 1 prove and verify, an out-of-range
+witness silently truncates into an invalid proof, pinned
+``RangeProverDraws`` / ``TypeAndSumDraws`` make proofs deterministic,
+and the ``DeviceRangeProver`` prove-time contract (out-of-range raises
+unless forge=True) fires before any device work. Everything here runs
+at 4 bits with no device compiles.
+"""
+
+import random
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+from fabric_token_sdk_tpu.crypto import transfer_proof as tp
+from fabric_token_sdk_tpu.crypto import token_commit
+from fabric_token_sdk_tpu.harness.corpus import ProofCorpus, _seeded_draws
+from fabric_token_sdk_tpu.models import witness_pack
+from fabric_token_sdk_tpu.prover import DeviceRangeProver
+
+N_BITS = 4
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(N_BITS)
+
+
+def _prove(pp, value, bf, draws=None):
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    com = bn254.g1_add(bn254.g1_mul(cg[0], value), bn254.g1_mul(cg[1], bf))
+    proof = rp.range_prove(com, value, cg, bf, rpp.left_generators,
+                           rpp.right_generators, rpp.P, rpp.Q,
+                           rpp.number_of_rounds, rpp.bit_length, draws=draws)
+    return proof, com
+
+
+def _verify_ok(pp, proof, com) -> bool:
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    try:
+        rp.range_verify(proof, com, cg, rpp.left_generators,
+                        rpp.right_generators, rpp.P, rpp.Q,
+                        rpp.number_of_rounds, rpp.bit_length)
+        return True
+    except rp.ProofError:
+        return False
+
+
+# ---------------------------------------------------------- host edges
+
+
+@pytest.mark.parametrize("value", [0, (1 << N_BITS) - 1, 5])
+def test_host_edge_values_prove_and_verify(pp, value):
+    proof, com = _prove(pp, value, bn254.fr_rand())
+    assert _verify_ok(pp, proof, com)
+
+
+def test_host_out_of_range_witness_truncates_to_invalid_proof(pp):
+    # the host prover decomposes only the low n bits but commits the
+    # full value: the proof comes out syntactically fine and MUST fail
+    # verification (this is the forged-corpus mechanism)
+    proof, com = _prove(pp, 1 << N_BITS, bn254.fr_rand())
+    assert not _verify_ok(pp, proof, com)
+
+
+def test_host_draws_pin_proof_bytes(pp):
+    d = _seeded_draws(random.Random(5), N_BITS)
+    bf = 1234567
+    p1, c1 = _prove(pp, 9, bf, draws=d)
+    p2, c2 = _prove(pp, 9, bf, draws=d)
+    assert c1 == c2
+    assert p1.serialize() == p2.serialize()
+    # different draws -> different transcript
+    p3, _ = _prove(pp, 9, bf, draws=_seeded_draws(random.Random(6), N_BITS))
+    assert p3.serialize() != p1.serialize()
+
+
+# ------------------------------------------- device prove-time contract
+
+
+def test_device_prover_rejects_out_of_range_at_prove_time(pp):
+    prover = DeviceRangeProver(pp)
+    with pytest.raises(ValueError, match="out of range"):
+        prover.prove([1 << N_BITS], [bn254.fr_rand()])
+    with pytest.raises(ValueError, match="out of range"):
+        prover.prove([-1], [bn254.fr_rand()])
+    # lazy params: the contract fires before any table build
+    assert prover._params is None
+
+
+def test_device_prover_rejects_shape_mismatches(pp):
+    prover = DeviceRangeProver(pp)
+    with pytest.raises(ValueError, match="blinding factors"):
+        prover.prove([1, 2], [bn254.fr_rand()])
+    with pytest.raises(ValueError, match="draws"):
+        prover.prove([1], [bn254.fr_rand()],
+                     draws=[rp.RangeProverDraws.random(N_BITS)] * 2)
+    assert prover._params is None
+
+
+def test_witness_pack_roundtrip_validation():
+    d = rp.RangeProverDraws.random(N_BITS)
+    packed = witness_pack.pack_range_witnesses([3], [7], [d], N_BITS)
+    assert packed.shape == (1, witness_pack.witness_width(N_BITS))
+    padded = witness_pack.pad_witness_rows(packed, 4)
+    assert padded.shape[0] == 4 and (padded[1:] == 0).all()
+    with pytest.raises(ValueError, match="draws row"):
+        witness_pack.pack_range_witnesses(
+            [3], [7], [rp.RangeProverDraws.random(N_BITS * 2)], N_BITS)
+
+
+# -------------------------------------------------- type-and-sum seam
+
+
+def test_type_and_sum_draws_pin_proof_bytes(pp):
+    ped = pp.pedersen_generators
+    type_zr = bn254.hash_to_zr(b"USD")
+    type_bf = bn254.fr_rand()
+    ct = bn254.g1_add(bn254.g1_mul(ped[0], type_zr),
+                      bn254.g1_mul(ped[2], type_bf))
+    in_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+    out_bfs = [bn254.fr_rand(), bn254.fr_rand()]
+    inputs = [token_commit.commit_token("USD", 5, bf, ped) for bf in in_bfs]
+    outputs = [token_commit.commit_token("USD", 5, bf, ped) for bf in out_bfs]
+    d = tp.TypeAndSumDraws(
+        r_type=11, r_type_bf=22, r_in_values=[33, 44],
+        r_in_bfs=[55, 66], r_sum_bf=77)
+    args = (ped, inputs, outputs, ct, [5, 5], in_bfs, out_bfs,
+            type_zr, type_bf)
+    p1 = tp.type_and_sum_prove(*args, draws=d)
+    p2 = tp.type_and_sum_prove(*args, draws=d)
+    assert p1.serialize() == p2.serialize()
+    assert tp.type_and_sum_prove(*args).serialize() != p1.serialize()
+
+
+# ------------------------------------------------------- ProofCorpus
+
+
+def test_corpus_host_source_values_forgeries_and_provenance(pp):
+    corpus = ProofCorpus(pp, source="host", seed=23, forge_every=3)
+    entries = corpus.generate(7)
+    assert [e.forged for e in entries] == [
+        False, False, True, False, False, True, False]
+    assert entries[0].value == 0
+    assert entries[1].value == (1 << N_BITS) - 1
+    for e in entries:
+        if e.forged:
+            assert e.value >= (1 << N_BITS)
+        assert _verify_ok(pp, e.proof, e.commitment) == (not e.forged)
+    prov = corpus.provenance()
+    assert prov["source"] == "host" and prov["seed"] == 23
+    assert prov["forge_every"] == 3 and prov["bits"] == N_BITS
+    assert prov["edge_values"] == [0, (1 << N_BITS) - 1]
+
+
+def test_corpus_is_seed_deterministic(pp):
+    a = ProofCorpus(pp, source="host", seed=9).generate(3)
+    b = ProofCorpus(pp, source="host", seed=9).generate(3)
+    assert all(x.proof.serialize() == y.proof.serialize()
+               for x, y in zip(a, b))
+    c = ProofCorpus(pp, source="host", seed=10).generate(3)
+    assert a[2].proof.serialize() != c[2].proof.serialize()
+
+
+def test_corpus_arrival_schedule_and_source_validation(pp):
+    corpus = ProofCorpus(pp, source="host", seed=1)
+    sched = corpus.arrival_schedule(50, rate_hz=1000.0)
+    assert len(sched) == 50
+    assert sched == sorted(sched) and sched[0] >= 0.0
+    with pytest.raises(ValueError, match="source"):
+        ProofCorpus(pp, source="tpu")

@@ -1,0 +1,195 @@
+"""Device-prover parity bar: byte-identical to the host provers.
+
+The tentpole correctness contract: every proof the device prover
+synthesizes must be accepted BIT-IDENTICALLY by both verifier paths —
+``serialize()`` equals the host prover's output under the same
+``RangeProverDraws`` / ``TypeAndSumDraws``, the pure-host verifier
+accepts it, the TPU batch verifier accepts it, and seeded FORGED
+(out-of-range) witness rows produce the same bytes on both paths and
+are rejected by both verifiers.
+
+Runs at 16 bits on the CPU backend (tier-1; conftest isolates this
+module in its own process — it compiles the fused prove chunk program
+AND the batch-verifier passes). The 32-bit sweep is @slow.
+"""
+
+import random
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import bn254, rp, setup
+from fabric_token_sdk_tpu.crypto import transfer_proof as tp
+from fabric_token_sdk_tpu.crypto import token_commit
+from fabric_token_sdk_tpu.harness.corpus import _seeded_draws
+from fabric_token_sdk_tpu.models.range_verifier import BatchRangeVerifier
+from fabric_token_sdk_tpu.prover import (DeviceRangeProver,
+                                         DeviceTransferProver)
+
+N_BITS = 16
+# 4-row chunks: big enough to exercise padding + multi-row batching,
+# small enough that the fused chunk program compiles on the CPU backend
+# (the 32-row size class is known to crash jaxlib's XLA:CPU here).
+CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup.setup(N_BITS)
+
+
+def _host_prove(pp, value, bf, draws):
+    rpp = pp.range_proof_params
+    cg = pp.pedersen_generators[1:3]
+    com = bn254.g1_add(bn254.g1_mul(cg[0], value), bn254.g1_mul(cg[1], bf))
+    proof = rp.range_prove(com, value, cg, bf, rpp.left_generators,
+                           rpp.right_generators, rpp.P, rpp.Q,
+                           rpp.number_of_rounds, rpp.bit_length,
+                           draws=draws)
+    return proof, com
+
+
+def _host_accepts(pp, proof, com) -> bool:
+    rpp = pp.range_proof_params
+    try:
+        rp.range_verify(proof, com, pp.pedersen_generators[1:3],
+                        rpp.left_generators, rpp.right_generators,
+                        rpp.P, rpp.Q, rpp.number_of_rounds,
+                        rpp.bit_length)
+        return True
+    except rp.ProofError:
+        return False
+
+
+def test_device_range_proofs_bit_identical_and_verified_both_paths(pp):
+    rng = random.Random(41)
+    # edges + a mid value, then one FORGED out-of-range row
+    values = [0, (1 << N_BITS) - 1, rng.randrange(1 << N_BITS)]
+    forged_value = (1 << N_BITS) + 7
+    bfs = [rng.randrange(1, bn254.R) for _ in range(4)]
+    draws = [_seeded_draws(rng, N_BITS) for _ in range(4)]
+
+    prover = DeviceRangeProver(pp, chunk_rows=CHUNK)
+    dev_proofs, dev_coms = prover.prove(values, bfs[:3], draws=draws[:3])
+    forged_proofs, forged_coms = prover.prove(
+        [forged_value], bfs[3:], draws=draws[3:], forge=True)
+
+    all_proofs = dev_proofs + forged_proofs
+    all_coms = dev_coms + forged_coms
+    all_values = values + [forged_value]
+
+    # byte parity: device serialize() == host serialize(), same draws
+    for i, v in enumerate(all_values):
+        host_proof, host_com = _host_prove(pp, v, bfs[i], draws[i])
+        assert all_coms[i] == host_com, f"commitment mismatch row {i}"
+        assert all_proofs[i].serialize() == host_proof.serialize(), \
+            f"proof bytes diverge from host prover at row {i}"
+
+    # host verifier path: valid rows accept, the forged row rejects
+    verdicts = [_host_accepts(pp, p, c)
+                for p, c in zip(all_proofs, all_coms)]
+    assert verdicts == [True, True, True, False]
+
+    # TPU batch verifier path: same verdict vector, bit for bit
+    batch = BatchRangeVerifier(pp)
+    out = batch.verify(all_proofs, all_coms)
+    assert out.tolist() == [True, True, True, False]
+
+
+def test_type_and_sum_device_matches_host(pp):
+    ped = pp.pedersen_generators
+    rng = random.Random(43)
+    type_zr = bn254.hash_to_zr(b"USD")
+    statements, host_args, draws = [], [], []
+    for k in range(2):                    # B=2: batching parity too
+        type_bf = rng.randrange(1, bn254.R)
+        ct = bn254.g1_add(bn254.g1_mul(ped[0], type_zr),
+                          bn254.g1_mul(ped[2], type_bf))
+        in_bfs = [rng.randrange(1, bn254.R) for _ in range(2)]
+        out_bfs = [rng.randrange(1, bn254.R) for _ in range(2)]
+        vals = [10 + k, 20 + k]
+        inputs = [token_commit.commit_token("USD", v, bf, ped)
+                  for v, bf in zip(vals, in_bfs)]
+        outputs = [token_commit.commit_token("USD", v, bf, ped)
+                   for v, bf in zip(vals, out_bfs)]
+        d = tp.TypeAndSumDraws(
+            r_type=rng.randrange(1, bn254.R),
+            r_type_bf=rng.randrange(1, bn254.R),
+            r_in_values=[rng.randrange(1, bn254.R) for _ in range(2)],
+            r_in_bfs=[rng.randrange(1, bn254.R) for _ in range(2)],
+            r_sum_bf=rng.randrange(1, bn254.R))
+        statements.append({
+            "inputs": inputs, "outputs": outputs,
+            "commitment_to_type": ct, "in_values": vals,
+            "in_bfs": in_bfs, "out_bfs": out_bfs,
+            "type_zr": type_zr, "type_bf": type_bf})
+        host_args.append((ped, inputs, outputs, ct, vals, in_bfs,
+                          out_bfs, type_zr, type_bf))
+        draws.append(d)
+
+    dev = DeviceTransferProver(pp).prove_type_and_sum(statements,
+                                                      draws=draws)
+    for k in range(2):
+        host = tp.type_and_sum_prove(*host_args[k], draws=draws[k])
+        assert dev[k].serialize() == host.serialize(), \
+            f"type-and-sum bytes diverge from host at row {k}"
+        # host verifier accepts the device proof
+        tp.type_and_sum_verify(dev[k], ped, statements[k]["inputs"],
+                               statements[k]["outputs"])
+        # a tampered response must reject
+        bad = tp.TypeAndSumProof(
+            commitment_to_type=dev[k].commitment_to_type,
+            input_blinding_factors=dev[k].input_blinding_factors,
+            input_values=dev[k].input_values,
+            type_=(dev[k].type_ + 1) % bn254.R,
+            type_blinding_factor=dev[k].type_blinding_factor,
+            equality_of_sum=dev[k].equality_of_sum,
+            challenge=dev[k].challenge)
+        with pytest.raises(tp.ProofError):
+            tp.type_and_sum_verify(bad, ped, statements[k]["inputs"],
+                                   statements[k]["outputs"])
+
+
+@pytest.mark.slow
+def test_device_transfer_prove_matches_host_end_to_end(pp):
+    """Full composition (Σ + output range proofs): the serialized
+    TransferProof from the device twin equals the host's byte for byte,
+    and the host transfer_verify accepts it."""
+    ped = pp.pedersen_generators
+    rng = random.Random(47)
+    in_bfs = [rng.randrange(1, bn254.R) for _ in range(2)]
+    out_bfs = [rng.randrange(1, bn254.R) for _ in range(2)]
+    iw = [("USD", 30, in_bfs[0]), ("USD", 12, in_bfs[1])]
+    ow = [("USD", 25, out_bfs[0]), ("USD", 17, out_bfs[1])]
+    inputs = [token_commit.commit_token(t, v, bf, ped) for t, v, bf in iw]
+    outputs = [token_commit.commit_token(t, v, bf, ped) for t, v, bf in ow]
+    draws = tp.TransferDraws(
+        type_bf=rng.randrange(1, bn254.R),
+        ts=tp.TypeAndSumDraws(
+            r_type=rng.randrange(1, bn254.R),
+            r_type_bf=rng.randrange(1, bn254.R),
+            r_in_values=[rng.randrange(1, bn254.R) for _ in range(2)],
+            r_in_bfs=[rng.randrange(1, bn254.R) for _ in range(2)],
+            r_sum_bf=rng.randrange(1, bn254.R)),
+        ranges=[_seeded_draws(rng, N_BITS) for _ in range(2)])
+
+    dev_raw = DeviceTransferProver(pp, range_chunk_rows=CHUNK) \
+        .transfer_prove(iw, ow, inputs, outputs, draws=draws)
+    host_raw = tp.transfer_prove(iw, ow, inputs, outputs, pp, draws=draws)
+    assert dev_raw == host_raw, "serialized TransferProof diverges"
+    tp.transfer_verify(dev_raw, inputs, outputs, pp)
+
+
+@pytest.mark.slow
+def test_device_range_parity_32bit():
+    pp = setup.setup(32)
+    rng = random.Random(53)
+    values = [0, (1 << 32) - 1]
+    bfs = [rng.randrange(1, bn254.R) for _ in values]
+    draws = [_seeded_draws(rng, 32) for _ in values]
+    prover = DeviceRangeProver(pp, chunk_rows=2)
+    dev_proofs, dev_coms = prover.prove(values, bfs, draws=draws)
+    for i, v in enumerate(values):
+        host_proof, host_com = _host_prove(pp, v, bfs[i], draws[i])
+        assert dev_coms[i] == host_com
+        assert dev_proofs[i].serialize() == host_proof.serialize()
+        assert _host_accepts(pp, dev_proofs[i], dev_coms[i])
