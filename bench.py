@@ -448,6 +448,198 @@ def _bench_serve():
     }))
 
 
+def _bench_frontdoor():
+    """BENCH_MODE=frontdoor: columnar batch ingest vs legacy per-request
+    pickled frames through the real TCP front door.
+
+    Open-loop RpcClients (BENCH_FRONTDOOR_CLIENTS, default 200) hammer
+    one RpcServer in two phases of BENCH_FRONTDOOR_SECONDS each:
+    phase 1 all-legacy (one pickled SUBMIT per proof — the wire format
+    the columnar path replaces), phase 2 columnar
+    (BENCH_FRONTDOOR_ROWS-row SUBMIT_BATCH frames) with a legacy
+    minority mixed in so v1 interop is proven under load, not just in
+    the handshake test. The default backend is StubZK so the bench
+    measures the front door's ser/de wall, not the device;
+    BENCH_FRONTDOOR_VERIFIER=device serves the real corpus through
+    ZKVerifier instead, with the same spot parity gate. Reports
+    ingested proofs/s per phase, bytes/proof per wire format and
+    per-tenant p99, and asserts the per-client columnar speedup is
+    >= BENCH_FRONTDOOR_MIN_SPEEDUP (default 5) with zero
+    rpc_frame_errors_total on the clean run."""
+    import asyncio
+    import pickle
+    import threading
+
+    from fabric_token_sdk_tpu.obs import GLOBAL
+    from fabric_token_sdk_tpu.serve import (LANE_BULK, RpcClient,
+                                            RpcConfig, RpcServer,
+                                            ServeConfig, StubZK,
+                                            VerificationService)
+
+    clients = int(os.environ.get("BENCH_FRONTDOOR_CLIENTS", "200"))
+    secs = float(os.environ.get("BENCH_FRONTDOOR_SECONDS", "10"))
+    rows = int(os.environ.get("BENCH_FRONTDOOR_ROWS", "256"))
+    min_speedup = float(os.environ.get("BENCH_FRONTDOOR_MIN_SPEEDUP", "5"))
+    device = os.environ.get("BENCH_FRONTDOOR_VERIFIER", "stub") == "device"
+
+    if device:
+        _configure_jax_cache()
+        from fabric_token_sdk_tpu.core.zkatdlog.verifier import ZKVerifier
+
+        pp, proofs, coms = _load()
+        reps = (rows + len(proofs) - 1) // len(proofs)
+        row_p = (proofs * reps)[:rows]
+        row_c = (coms * reps)[:rows]
+        zk = ZKVerifier(pp, device=True)
+        oracle = [bool(x) for x in zk._range.verify(row_p, row_c)]
+    else:
+        row_p = [i % 5 != 0 for i in range(rows)]
+        row_c = [None] * rows
+        zk = StubZK()
+        oracle = list(row_p)
+
+    def _fam(name, **labels):
+        total = 0
+        for (fam, lab), val in GLOBAL.snapshot().items():
+            if fam != name or any(dict(lab).get(k) != v
+                                  for k, v in labels.items()):
+                continue
+            total += val["count"] if isinstance(val, dict) else val
+        return total
+
+    cfg = ServeConfig(
+        buckets=(16, 256, 1024), max_wait_s=0.005,
+        default_deadline_s=60.0,
+        queue_capacity=max(16384, 2 * rows * clients))
+    svc = VerificationService(zk, config=cfg)
+    loop = asyncio.new_event_loop()
+    loop_thread = threading.Thread(target=loop.run_forever,
+                                   name="frontdoor-loop", daemon=True)
+    loop_thread.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(300.0)
+
+    async def _boot():
+        await svc.start(prewarm=device)
+        server = RpcServer(svc, RpcConfig(conn_credits=4 * rows))
+        return server, await server.start()
+
+    server, addr = run(_boot())
+    errs0 = _fam("rpc_frame_errors_total")
+
+    # spot parity gate before the storm: served verdicts (batch AND
+    # legacy wire formats) must match the oracle for the same corpus
+    spot = RpcClient(addr, tms_id="spot", call_timeout_s=120.0)
+    try:
+        assert spot.submit_range_batch(row_p, row_c).tolist() == oracle, \
+            "frontdoor columnar verdicts diverge from the oracle"
+        assert spot.submit_range(row_p[:4], row_c[:4]).tolist() \
+            == oracle[:4], \
+            "frontdoor legacy verdicts diverge from the oracle"
+    finally:
+        spot.close()
+
+    def _storm(batch_flags, phase_secs):
+        """One phase of closed-loop clients; rows by wire format plus
+        per-tenant call latencies."""
+        counts = {"batch": 0, "legacy": 0}
+        lats: dict[str, tuple[bool, list]] = {}
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + phase_secs
+
+        def one(i, use_batch):
+            tms = f"tenant-{i:03d}"
+            cli = RpcClient(addr, tms_id=tms, call_timeout_s=120.0)
+            mine, done = [], 0
+            try:
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    if use_batch:
+                        out = cli.submit_range_batch(row_p, row_c)
+                        done += len(out)
+                    else:
+                        out = cli.submit_range(row_p[:1], row_c[:1])
+                        done += 1
+                    mine.append(time.perf_counter() - t0)
+                    assert bool(out[0]) == oracle[0]
+            finally:
+                cli.close()
+            with lock:
+                counts["batch" if use_batch else "legacy"] += done
+                lats[tms] = (use_batch, mine)
+
+        threads = [threading.Thread(target=one, args=(i, batch_flags[i]))
+                   for i in range(len(batch_flags))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return counts, lats, time.perf_counter() - t0
+
+    print(f"frontdoor bench: phase 1 — {clients} legacy clients, "
+          f"{secs:.0f}s", file=sys.stderr)
+    c1, _, wall1 = _storm([False] * clients, secs)
+    n_mix = max(1, clients // 8)
+    print(f"frontdoor bench: phase 2 — {clients - n_mix} columnar + "
+          f"{n_mix} legacy clients, {secs:.0f}s", file=sys.stderr)
+    flags = [i >= n_mix for i in range(clients)]
+    c2, lats2, wall2 = _storm(flags, secs)
+
+    async def _down():
+        await server.stop(drain=True)
+        await svc.stop(drain=True)
+
+    run(_down())
+    loop.call_soon_threadsafe(loop.stop)
+    loop_thread.join(timeout=10.0)
+    loop.close()
+
+    legacy_ps = c1["legacy"] / wall1
+    batch_ps = c2["batch"] / wall2
+    per_legacy = legacy_ps / clients
+    per_batch = batch_ps / (clients - n_mix)
+    speedup = per_batch / per_legacy if per_legacy else float("inf")
+
+    def _p99(vals):
+        s = sorted(vals) or [0.0]
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    p99_batch = max((_p99(v) for b, v in lats2.values() if b),
+                    default=0.0)
+    p99_legacy = max((_p99(v) for b, v in lats2.values() if not b),
+                     default=0.0)
+    col_bytes = _fam("rpc_batch_bytes_total", role="client")
+    col_rows = _fam("rpc_batch_rows_total", role="client") or 1
+    legacy_body = {"req_id": 1, "kind": "range", "lane": LANE_BULK,
+                   "tms_id": "tenant-000", "rows": 1,
+                   "deadline": time.time() + 60.0,
+                   "payload": (row_p[:1], row_c[:1])}
+    legacy_bpp = len(pickle.dumps(
+        legacy_body, protocol=pickle.HIGHEST_PROTOCOL)) + 12
+    errs = _fam("rpc_frame_errors_total") - errs0
+
+    backend = "device" if device else "stub"
+    print(json.dumps({
+        "metric": f"frontdoor_ingest_proofs_per_sec_{BIT_LENGTH}bit",
+        "value": round(batch_ps, 2),
+        "unit": (f"proofs/s ingested, {backend} backend "
+                 f"({clients - n_mix} columnar + {n_mix} legacy clients, "
+                 f"{rows} rows/frame; legacy-only phase {legacy_ps:.0f}/s; "
+                 f"per-client speedup x{speedup:.1f}; "
+                 f"{col_bytes / col_rows:.1f} vs {legacy_bpp:.0f} B/proof; "
+                 f"worst-tenant p99 batch {p99_batch * 1e3:.1f}ms "
+                 f"legacy {p99_legacy * 1e3:.1f}ms; "
+                 f"frame_errors {errs})"),
+    }))
+    assert errs == 0, f"{errs} rpc_frame_errors_total on a clean run"
+    assert speedup >= min_speedup, (
+        f"columnar ingest speedup x{speedup:.2f} below the "
+        f"x{min_speedup:.1f} bar (per-client {per_batch:.0f} vs "
+        f"{per_legacy:.0f} proofs/s)")
+
+
 def _bench_prove():
     """BENCH_MODE=prove — device proof SYNTHESIS throughput: seeded
     witnesses stream through ``prover.DeviceRangeProver`` in fused
@@ -1263,10 +1455,17 @@ def main():
     if "--regen-block" in sys.argv:
         _regen_block()
         return
+    mode = os.environ.get("BENCH_MODE", "")
+    if mode == "frontdoor":
+        # stub-backed by default: measures the front door's ser/de
+        # wall with no corpus or device compile (device mode loads
+        # both itself)
+        _bench_frontdoor()
+        return
+
     if not (BENCH_DIR / f"proofs_{BIT_LENGTH}.bin").exists():
         _regen()
 
-    mode = os.environ.get("BENCH_MODE", "")
     if mode == "config1":
         _bench_config1()
         return

@@ -154,6 +154,20 @@ class ProofCorpus:
             coms.append(com)
         return proofs, coms
 
+    def columnar_cells(self, entries: list[CorpusEntry]):
+        """``(proof_cells, com_cells, bits, flags)`` for one FMT_RANGE
+        SUBMIT_BATCH frame over ``entries`` — the bridge between a
+        generated corpus and the columnar front door (``flags`` bit 0
+        carries each row's ground-truth forged marker, so the server
+        side of a bench can assert verdict parity per row)."""
+        from ..serve.columnar import range_cells
+
+        proof_cells, com_cells = range_cells(
+            [e.proof for e in entries], [e.commitment for e in entries])
+        bits = [self.bit_length] * len(entries)
+        flags = [1 if e.forged else 0 for e in entries]
+        return proof_cells, com_cells, bits, flags
+
     # ----------------------------------------------------------- plumbing
     def provenance(self) -> dict:
         """Generation parameters for the BENCH report (config 5 replay

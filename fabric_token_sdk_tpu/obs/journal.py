@@ -65,6 +65,9 @@ EVENT_WAL_REPLAY = "wal_replay"
 #: Supervisor lifecycle: child failure detected / child (re)started.
 EVENT_CHILD_FAILURE = "child_failure"
 EVENT_CHILD_RESTART = "child_restart"
+#: One columnar SUBMIT_BATCH frame admitted as a single decision (the
+#: per-row counterpart is EVENT_REQUEST_ADMITTED).
+EVENT_BATCH_ADMITTED = "batch_admitted"
 
 EVENT_KINDS = (
     EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED, EVENT_BATCH_FORMED,
@@ -73,6 +76,7 @@ EVENT_KINDS = (
     EVENT_FALLBACK, EVENT_HEARTBEAT, EVENT_WATCHDOG_ABANDON,
     EVENT_INCIDENT, EVENT_REQUEST_SHUTDOWN, EVENT_WAL_RECOVERED,
     EVENT_WAL_REPLAY, EVENT_CHILD_FAILURE, EVENT_CHILD_RESTART,
+    EVENT_BATCH_ADMITTED,
 )
 
 _JOURNAL_FAMILIES = {

@@ -10,6 +10,9 @@ verdicts bit-identically to the unbatched path. See README "Serving".
 """
 
 from .admission import AdmissionController
+from .columnar import (FMT_OPAQUE, FMT_RANGE, ColumnarBatch, ColumnarError,
+                       decode_submit_batch, encode_submit_batch,
+                       materialize_rows)
 from .config import LANE_BULK, LANE_INTERACTIVE, LANES, ServeConfig
 from .prewarm import PrewarmManager
 from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
@@ -18,7 +21,7 @@ from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
                       STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
 from .rpc import FrameError, RpcConfig, RpcServer
-from .rpc_client import RpcClient
+from .rpc_client import BatchSubmitBuffer, RpcClient
 from .scheduler import GROUPS, BucketScheduler
 from .service import VerificationService
 from .sidecar import RpcSidecar, pick_free_port, sidecar_main
@@ -28,7 +31,12 @@ from .worker import StubZK, WorkerClient, WorkerUnavailable, worker_main
 __all__ = [
     "AdmissionController",
     "ACTION_KINDS",
+    "BatchSubmitBuffer",
     "BucketScheduler",
+    "ColumnarBatch",
+    "ColumnarError",
+    "FMT_OPAQUE",
+    "FMT_RANGE",
     "FrameError",
     "GROUPS",
     "KIND_ISSUE",
@@ -60,6 +68,9 @@ __all__ = [
     "WorkerClient",
     "WorkerUnavailable",
     "WriteAheadLog",
+    "decode_submit_batch",
+    "encode_submit_batch",
+    "materialize_rows",
     "pick_free_port",
     "sidecar_main",
     "worker_main",

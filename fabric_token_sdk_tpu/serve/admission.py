@@ -51,3 +51,30 @@ class AdmissionController:
             help="Admitted verification requests",
             kind=req.kind, lane=req.lane).add()
         return None
+
+    def admit_batch(self, kind: str, lane: str, rows: int,
+                    lane_depth: int, deadline: float) -> str | None:
+        """ONE admission decision for a whole columnar frame.
+
+        The frame admits or sheds atomically — queue_full when the lane
+        cannot absorb every row (partial admission would break the
+        one-WAL-append-per-frame durability contract), deadline when
+        even the frame's latest row cannot be served in time. Counters
+        advance by ``rows`` so shed/request rates stay row-denominated.
+        """
+        now = time.perf_counter()
+        if lane_depth + rows > self.config.queue_capacity:
+            _METRICS.counter(
+                "serve_shed_total",
+                help="Requests refused at admission, by reason",
+                reason="queue_full", lane=lane).add(rows)
+            return STATUS_SHED_QUEUE_FULL
+        if deadline - now < self.config.service_estimate_s:
+            _METRICS.counter("serve_shed_total", reason="deadline",
+                             lane=lane).add(rows)
+            return STATUS_SHED_DEADLINE
+        _METRICS.counter(
+            "serve_requests_total",
+            help="Admitted verification requests",
+            kind=kind, lane=lane).add(rows)
+        return None

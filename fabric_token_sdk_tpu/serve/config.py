@@ -63,6 +63,14 @@ class ServeConfig:
         continuous-batching frontend feeds every device instead of
         serializing on one dispatcher thread. 1 (the default) preserves
         the single-dispatcher behaviour exactly.
+    tenant_quantum: deficit-round-robin quantum — rows of service a
+        tenant's queue earns per DRR visit, so one hot tenant can hold
+        a (group, lane) queue for at most ``tenant_quantum * weight``
+        rows before the drain rotates to the next tenant. A single
+        tenant degenerates to exact FIFO (the historical behaviour).
+    tenant_weights: ((tms_id, weight), ...) pairs scaling the quantum
+        per tenant; unlisted tenants weigh 1.0. Tuple-of-pairs keeps
+        the dataclass frozen/hashable.
     """
 
     buckets: tuple = tuple(b for b in B_BUCKETS if b <= 1024)
@@ -75,6 +83,8 @@ class ServeConfig:
     lanes: tuple = LANES
     trace_every: int = 1
     n_lanes: int = 1
+    tenant_quantum: int = 8
+    tenant_weights: tuple = ()
 
     def __post_init__(self):
         if not self.buckets:
@@ -85,6 +95,14 @@ class ServeConfig:
             raise ValueError("min_batch exceeds max(buckets)")
         if self.n_lanes < 1:
             raise ValueError("ServeConfig.n_lanes must be >= 1")
+        if self.tenant_quantum < 1:
+            raise ValueError("ServeConfig.tenant_quantum must be >= 1")
+        for pair in self.tenant_weights:
+            tms_id, weight = pair
+            if not isinstance(tms_id, str) or weight <= 0:
+                raise ValueError(
+                    f"tenant_weights entries must be (tms_id, weight > 0) "
+                    f"pairs, got {pair!r}")
 
     @property
     def max_batch(self) -> int:

@@ -84,6 +84,7 @@ class VerifyRequest:
     span: object = None       # obs Span opened at admission (sampled)
     wal_id: int | None = None  # durable WAL id (when the service logs)
     terminal: bool = False    # set by _resolve: exactly-once completion
+    tenant: str = "default"   # tms_id: the DRR drain key in the scheduler
 
     @property
     def group(self) -> str:

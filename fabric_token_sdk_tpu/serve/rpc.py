@@ -28,6 +28,16 @@ Protocol:
       streaming batch submits; rows fan into
       ``VerificationService.submit_*`` and the per-row verdicts are
       demultiplexed back into one RESULT frame.
+  SUBMIT_BATCH(columnar payload)  -> RESULT{req_id,...}
+      the high-throughput ingest path: one CRC-framed frame carries N
+      proofs as contiguous uint32 limb planes + per-row metadata
+      columns (serve/columnar.py). The payload is NOT pickled — the
+      server decodes it into numpy views over the frame buffer (zero
+      per-row Python objects) and admits the whole frame through
+      ``VerificationService.submit_batch`` (one admission decision,
+      one WAL append, one journal event). Credits are spent in rows,
+      same as N legacy SUBMITs. Capability is advertised in WELCOME
+      (``v=2, batch=True``); v1 clients never see the type.
   CREDIT{grant}    credit-based flow control: each connection holds a
       row budget; SUBMIT rows consume it, the server replenishes from
       admission headroom (``queue_capacity`` minus the deepest lane),
@@ -56,6 +66,7 @@ from dataclasses import dataclass
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
 from ..obs.journal import JOURNAL
+from .columnar import ColumnarError, decode_submit_batch, materialize_rows
 from .config import LANE_BULK, LANES
 from .request import STATUS_OK
 
@@ -73,12 +84,20 @@ PING = 6
 PONG = 7
 GOAWAY = 8
 ERROR = 9
+SUBMIT_BATCH = 10
 
 FRAME_NAMES = {
     HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", RESULT: "result",
     CREDIT: "credit", PING: "ping", PONG: "pong", GOAWAY: "goaway",
-    ERROR: "error",
+    ERROR: "error", SUBMIT_BATCH: "submit_batch",
 }
+
+#: Frame types whose payload is raw bytes (CRC-checked, never pickled);
+#: everything else stays a pickled dict.
+RAW_PAYLOAD_TYPES = frozenset({SUBMIT_BATCH})
+
+#: Protocol version advertised in WELCOME: 2 adds SUBMIT_BATCH.
+RPC_VERSION = 2
 
 DEFAULT_MAX_FRAME = 32 * 1024 * 1024
 
@@ -100,7 +119,8 @@ _RPC_FAMILIES = {
         "RPC frame-level failures by kind: torn (EOF mid-frame), "
         "checksum, oversize, bad_magic, slow_frame (mid-frame stall "
         "past the frame deadline), decode, protocol, credit_violation, "
-        "midframe_close.",
+        "midframe_close, row_count (columnar batch whose byte count "
+        "disagrees with its declared shape).",
     "rpc_requests_total":
         "SUBMIT frames accepted into the service, by tenant tms id, "
         "kind and lane.",
@@ -121,6 +141,20 @@ _RPC_FAMILIES = {
         "Client-observed RPC round-trip wall seconds, by kind.",
     "rpc_hedges_total":
         "Hedged duplicate SUBMITs sent for the interactive lane.",
+    "rpc_batch_frames_total":
+        "Columnar SUBMIT_BATCH frames moved, by role and tenant tms id.",
+    "rpc_batch_rows_total":
+        "Proof rows carried by columnar SUBMIT_BATCH frames, by role "
+        "and tenant tms id.",
+    "rpc_batch_bytes_total":
+        "Payload bytes carried by columnar SUBMIT_BATCH frames, by "
+        "role and tenant tms id.",
+    "rpc_decode_seconds":
+        "Wall seconds decoding one frame payload, by format (columnar "
+        "numpy views vs pickle object graphs).",
+    "rpc_tenant_deficit":
+        "Deficit-round-robin credit currently held by a tenant's "
+        "admission queue (rows it may drain before rotating).",
 }
 
 
@@ -138,15 +172,23 @@ def _describe(provider) -> None:
 
 
 # --------------------------------------------------------------- codec
-def encode_frame(ftype: int, body: dict,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
-    """Serialize one frame: 12-byte header + pickled, CRC'd payload."""
-    payload = pickle.dumps(body, protocol=pickle.HIGHEST_PROTOCOL)
+def encode_raw_frame(ftype: int, payload: bytes,
+                     max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
+    """Serialize one frame around an already-encoded payload (the
+    columnar SUBMIT_BATCH path: bytes in, bytes out, no pickle)."""
     if len(payload) > max_frame_bytes:
         raise FrameError("oversize",
                          f"{len(payload)}B payload > {max_frame_bytes}B cap")
     crc = zlib.crc32(payload) & 0xFFFFFFFF
     return _HEADER.pack(MAGIC, ftype, 0, len(payload), crc) + payload
+
+
+def encode_frame(ftype: int, body: dict,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
+    """Serialize one frame: 12-byte header + pickled, CRC'd payload."""
+    return encode_raw_frame(
+        ftype, pickle.dumps(body, protocol=pickle.HIGHEST_PROTOCOL),
+        max_frame_bytes)
 
 
 def decode_header(header: bytes,
@@ -161,15 +203,33 @@ def decode_header(header: bytes,
     return ftype, length, crc
 
 
-def decode_payload(payload: bytes, crc: int):
-    """CRC-check then unpickle a frame payload."""
+def check_payload_crc(payload: bytes, crc: int) -> bytes:
+    """CRC-check a raw payload; returns it untouched on success."""
     if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
         raise FrameError("checksum",
                          f"crc mismatch over {len(payload)}B payload")
+    return payload
+
+
+def decode_payload(payload: bytes, crc: int):
+    """CRC-check then unpickle a frame payload."""
+    check_payload_crc(payload, crc)
+    t0 = time.perf_counter()
     try:
-        return pickle.loads(payload)
+        body = pickle.loads(payload)
     except Exception as exc:  # corrupt-but-crc-colliding, or bad pickle
         raise FrameError("decode", repr(exc)) from exc
+    _METRICS.histogram("rpc_decode_seconds",
+                       fmt="pickle").observe(time.perf_counter() - t0)
+    return body
+
+
+def _frame_body(ftype: int, payload: bytes, crc: int):
+    """Payload bytes -> frame body: raw (CRC only) for the columnar
+    types, unpickled dict for everything else."""
+    if ftype in RAW_PAYLOAD_TYPES:
+        return check_payload_crc(payload, crc)
+    return decode_payload(payload, crc)
 
 
 async def read_frame(reader: asyncio.StreamReader, *,
@@ -204,7 +264,7 @@ async def read_frame(reader: asyncio.StreamReader, *,
         raise FrameError(
             "slow_frame",
             f"payload stalled past {body_timeout_s}s deadline") from exc
-    return ftype, decode_payload(payload, crc)
+    return ftype, _frame_body(ftype, payload, crc)
 
 
 # ----------------------------------------------------- sync codec (client)
@@ -212,6 +272,12 @@ def send_frame_sock(sock, ftype: int, body: dict,
                     max_frame_bytes: int = DEFAULT_MAX_FRAME) -> None:
     """Blocking frame send; the socket's own timeout bounds it."""
     sock.sendall(encode_frame(ftype, body, max_frame_bytes))
+
+
+def send_raw_frame_sock(sock, ftype: int, payload: bytes,
+                        max_frame_bytes: int = DEFAULT_MAX_FRAME) -> None:
+    """Blocking raw-payload frame send (columnar SUBMIT_BATCH)."""
+    sock.sendall(encode_raw_frame(ftype, payload, max_frame_bytes))
 
 
 def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
@@ -222,22 +288,26 @@ def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
     ``FrameError("slow_frame")`` when the deadline passes mid-buffer.
     The socket must carry a finite ``settimeout`` so each recv ticks.
     """
-    buf = bytearray()
-    while len(buf) < n:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         if deadline is not None and time.monotonic() >= deadline:
             raise FrameError("slow_frame",
-                             f"{len(buf)}/{n}B before deadline")
+                             f"{got}/{n}B before deadline")
         try:
-            chunk = sock.recv(n - len(buf))  # io-deadline: settimeout tick
+            # recv_into the preallocated buffer: no per-chunk bytes
+            # objects, which matters at columnar batch-frame sizes
+            k = sock.recv_into(view[got:])  # io-deadline: settimeout tick
         except TimeoutError:
-            if not buf and deadline is None:
+            if not got and deadline is None:
                 raise  # idle tick between frames: caller's checkpoint
             continue
-        if not chunk:
-            if not buf:
+        if not k:
+            if not got:
                 return b""
-            raise FrameError("torn", f"EOF after {len(buf)}/{n}B")
-        buf += chunk
+            raise FrameError("torn", f"EOF after {got}/{n}B")
+        got += k
     return bytes(buf)
 
 
@@ -261,7 +331,7 @@ def recv_frame_sock(sock, *, max_frame_bytes: int = DEFAULT_MAX_FRAME,
     payload = recv_exact_sock(sock, length, deadline=deadline)
     if len(payload) != length:
         raise FrameError("torn", "EOF mid-payload")
-    return ftype, decode_payload(payload, crc)
+    return ftype, _frame_body(ftype, payload, crc)
 
 
 # -------------------------------------------------------------- server
@@ -462,6 +532,11 @@ class RpcServer:
                 "t_srv": time.time(),
                 "credits": conn.credits,
                 "max_frame": cfg.max_frame_bytes,
+                # version negotiation: v2 peers may send columnar
+                # SUBMIT_BATCH frames; v1 clients ignore both keys and
+                # keep speaking per-request SUBMITs unchanged
+                "v": RPC_VERSION,
+                "batch": True,
             })
             if self._draining and not conn.goaway_sent:
                 conn.goaway_sent = True
@@ -512,8 +587,93 @@ class RpcServer:
                 conn.goaway_sent = True  # client-initiated drain
             elif ftype == SUBMIT:
                 self._accept_submit(conn, body)
+            elif ftype == SUBMIT_BATCH:
+                try:
+                    batch = self._decode_batch(conn, body)
+                except FrameError as exc:
+                    # same contract as a poisoned pickled frame: count,
+                    # journal, drop THIS connection, server stays up
+                    self._frame_error(exc.kind)
+                    JOURNAL.record("rpc_frame_error", kind=exc.kind,
+                                   tms_id=conn.tms_id, detail=str(exc))
+                    return
+                self._accept_submit_batch(conn, batch)
             else:
                 self._frame_error("protocol")
+
+    def _decode_batch(self, conn: _Conn, payload: bytes):
+        """Raw columnar payload -> numpy-view batch, timed + counted.
+
+        Decode allocates O(1): every column is a view over the frame
+        buffer. Malformed payloads surface as ``FrameError`` with the
+        codec's kind (``row_count`` / ``decode``)."""
+        t0 = time.perf_counter()
+        try:
+            batch = decode_submit_batch(payload)
+        except ColumnarError as exc:
+            raise FrameError(exc.kind, str(exc)) from exc
+        self.provider.histogram(
+            "rpc_decode_seconds",
+            fmt="columnar").observe(time.perf_counter() - t0)
+        self.provider.counter("rpc_batch_frames_total", role="server",
+                              tms=conn.tms_id).add()
+        self.provider.counter("rpc_batch_rows_total", role="server",
+                              tms=conn.tms_id).add(batch.n_rows)
+        self.provider.counter("rpc_batch_bytes_total", role="server",
+                              tms=conn.tms_id).add(batch.nbytes)
+        return batch
+
+    def _accept_submit_batch(self, conn: _Conn, batch) -> None:
+        """Credit accounting in rows — one columnar frame spends exactly
+        what its row count would cost as N legacy SUBMITs, so the
+        backpressure semantics are unchanged."""
+        rows = batch.n_rows
+        if rows > conn.credits:
+            self._frame_error("credit_violation")
+        conn.credits = max(0, conn.credits - rows)
+        self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
+        task = asyncio.ensure_future(self._serve_submit_batch(conn, batch))
+        conn.inflight.add(task)
+        task.add_done_callback(conn.inflight.discard)
+
+    async def _serve_submit_batch(self, conn: _Conn, batch) -> None:
+        reply: dict = {"req_id": batch.req_id_base, "status": RPC_OK}
+        deadline_s = batch.deadline - time.time()
+        if deadline_s <= 0:
+            self.provider.counter("rpc_deadline_expired_total").add()
+            reply["status"] = RPC_EXPIRED
+            reply["error"] = (
+                f"deadline passed {-deadline_s * 1000:.1f}ms before decode")
+        elif self._draining or conn.goaway_sent:
+            reply["status"] = RPC_GOAWAY
+            reply["error"] = "server draining"
+        if reply["status"] == RPC_OK:
+            # ONE rpc_requests_total bump per frame — the whole point
+            self.provider.counter("rpc_requests_total", tms=conn.tms_id,
+                                  kind="range", lane=batch.lane).add()
+            try:
+                with self.tracer.span("rpc.serve_batch", rows=batch.n_rows,
+                                      fmt=batch.fmt_name, lane=batch.lane):
+                    proofs, coms = materialize_rows(batch)
+                    offs = batch.deadline_offsets_s
+                    results = await self.service.submit_batch(
+                        "range", list(zip(proofs, coms)),
+                        deadline_s=deadline_s,
+                        deadline_offsets_s=offs if offs.any() else None,
+                        lane=batch.lane, tenant=conn.tms_id)
+                reply["statuses"] = [r.status for r in results]
+                reply["verdicts"] = [r.accepted for r in results]
+                reply["served_by"] = sorted(
+                    {r.served_by for r in results if r.served_by})
+            except Exception as exc:  # service-level failure -> typed error
+                reply["status"] = RPC_ERROR
+                reply["error"] = str(exc)
+                reply["error_type"] = type(exc).__name__
+        try:
+            await conn.send(RESULT, reply)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return  # peer gone; its redial will resubmit
+        await self._replenish(conn)
 
     def _accept_submit(self, conn: _Conn, body: dict) -> None:
         rows = int(body.get("rows", 1))

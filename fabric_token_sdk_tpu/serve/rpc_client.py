@@ -32,10 +32,20 @@ Mechanics:
     interactive-lane calls that wait longer than the hedge threshold
     send a duplicate SUBMIT under a fresh req_id; first reply wins
     (verdicts are deterministic, so duplicates are parity-safe).
+  - **Columnar batch submit**: against a v2 server (WELCOME advertises
+    ``batch: true``) ``submit_range_batch`` ships N rows as ONE
+    SUBMIT_BATCH frame — contiguous limb planes, no per-row pickling —
+    answered by one RESULT. ``prefer_batch=True`` routes the
+    ``_range.verify`` duck-type through it automatically, and
+    :class:`BatchSubmitBuffer` coalesces single-row submits into
+    frames under row/byte/delay flush triggers. Credits account in
+    rows either way, so backpressure is format-blind; a v1 server
+    silently keeps the legacy per-request path (wire-compatible).
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import itertools
 import socket
 import threading
@@ -46,10 +56,13 @@ import numpy as np
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
 from ..resilience import RetryPolicy
+from .columnar import (FMT_OPAQUE, FMT_RANGE, encode_submit_batch,
+                       opaque_cells, range_cells)
 from .config import LANE_BULK, LANE_INTERACTIVE
 from .rpc import (CREDIT, DEFAULT_MAX_FRAME, FRAME_NAMES, GOAWAY, HELLO,
-                  PING, PONG, RESULT, RPC_OK, SUBMIT, WELCOME, FrameError,
-                  _describe, recv_frame_sock, send_frame_sock)
+                  PING, PONG, RESULT, RPC_OK, RPC_VERSION, SUBMIT,
+                  SUBMIT_BATCH, WELCOME, FrameError, _describe,
+                  recv_frame_sock, send_frame_sock, send_raw_frame_sock)
 from .worker import _REMOTE_TRANSIENT_NAMES, WorkerUnavailable
 
 
@@ -94,6 +107,7 @@ class RpcClient:
                  seed: int = 0,
                  max_frame_bytes: int = DEFAULT_MAX_FRAME,
                  name: str = "rpc-client",
+                 prefer_batch: bool = False,
                  provider=None, tracer=None):
         self.address = (str(address[0]), int(address[1]))
         self.pp = pp
@@ -106,6 +120,12 @@ class RpcClient:
         self.hedge_after_s = hedge_after_s
         self.max_frame_bytes = max_frame_bytes
         self.name = name
+        #: Route ``submit_range`` through the columnar SUBMIT_BATCH path
+        #: whenever the server advertises it (v1 servers keep legacy).
+        self.prefer_batch = prefer_batch
+        #: WELCOME capabilities of the current connection.
+        self.server_version = 1
+        self.server_batch = False
         self.provider = provider or _METRICS
         self.tracer = tracer or _TRACER
         _describe(self.provider)
@@ -147,7 +167,8 @@ class RpcClient:
             sock.settimeout(self.tick_s)
             t0 = time.time()
             send_frame_sock(sock, HELLO,
-                            {"tms_id": self.tms_id, "t": t0, "v": 1},
+                            {"tms_id": self.tms_id, "t": t0,
+                             "v": RPC_VERSION},
                             self.max_frame_bytes)
             deadline = time.monotonic() + self.connect_timeout_s
             while True:
@@ -167,6 +188,10 @@ class RpcClient:
             raise
         welcome = frame[1]
         t1 = time.time()
+        # capability negotiation: a v1 server omits both keys and the
+        # client keeps the legacy per-request SUBMIT path
+        self.server_version = int(welcome.get("v", 1))
+        self.server_batch = bool(welcome.get("batch", False))
         self.rtt_s = max(0.0, t1 - t0)
         self.clock_offset_s = welcome.get("t_srv", t1) - (
             t0 + self.rtt_s / 2.0)
@@ -326,6 +351,27 @@ class RpcClient:
             raise WorkerUnavailable(f"rpc send failed: {exc!r}") from exc
         self._count_frame("sent", SUBMIT)
 
+    def _send_batch(self, payload: bytes, rows: int) -> None:
+        with self._cv:
+            sock = self._sock
+            dead = self._dead
+        if sock is None or dead:
+            raise WorkerUnavailable("rpc connection lost before send")
+        try:
+            with self._send_lock:
+                send_raw_frame_sock(sock, SUBMIT_BATCH, payload,
+                                    self.max_frame_bytes)
+        except (OSError, ConnectionError, FrameError) as exc:
+            self._conn_lost(self._gen, repr(exc))
+            raise WorkerUnavailable(f"rpc send failed: {exc!r}") from exc
+        self._count_frame("sent", SUBMIT_BATCH)
+        self.provider.counter("rpc_batch_frames_total", role="client",
+                              tms=self.tms_id).add()
+        self.provider.counter("rpc_batch_rows_total", role="client",
+                              tms=self.tms_id).add(rows)
+        self.provider.counter("rpc_batch_bytes_total", role="client",
+                              tms=self.tms_id).add(len(payload))
+
     def _call(self, kind: str, payload, rows: int, *,
               lane: str = LANE_BULK, deadline_s: float | None = None):
         budget = deadline_s if deadline_s is not None else self.call_timeout_s
@@ -407,11 +453,82 @@ class RpcClient:
                 f"sidecar shed rows: {sorted(set(t_st) | set(i_st))}")
         return (np.asarray(t_v, dtype=bool), np.asarray(i_v, dtype=bool))
 
+    # ------------------------------------------------------ batch submit
+    def submit_range_batch(self, proofs, coms, *, lane: str = LANE_BULK,
+                           deadline_s: float | None = None,
+                           bits=None, flags=None, deadline_off_us=None,
+                           fmt: int | None = None):
+        """Ship N rows as ONE columnar SUBMIT_BATCH frame.
+
+        ``fmt`` defaults to :data:`FMT_RANGE` when the proofs carry a
+        ``serialize`` method (real RangeProof objects) and
+        :data:`FMT_OPAQUE` otherwise (stub truth values). Against a v1
+        server the call transparently degrades to the legacy pickled
+        SUBMIT — same verdict vector, N-row frame cost.
+        """
+        proofs = list(proofs)
+        coms = list(coms)
+        n = len(proofs)
+        budget = (deadline_s if deadline_s is not None
+                  else self.call_timeout_s)
+        t_start = time.perf_counter()
+        with self.tracer.span("rpc.call", kind="range_batch", rows=n,
+                              lane=lane):
+            try:
+                return self._call_batch_once(
+                    proofs, coms, n, lane, budget, bits, flags,
+                    deadline_off_us, fmt)
+            finally:
+                self.provider.histogram(
+                    "rpc_call_seconds", kind="range_batch").observe(
+                        time.perf_counter() - t_start)
+
+    def _call_batch_once(self, proofs, coms, n, lane, budget, bits,
+                         flags, deadline_off_us, fmt):
+        self._ensure_conn()
+        if not self.server_batch:
+            return self._call_once("range", (proofs, coms), n, lane,
+                                   budget)
+        if fmt is None:
+            fmt = (FMT_RANGE if n and hasattr(proofs[0], "serialize")
+                   else FMT_OPAQUE)
+        if fmt == FMT_RANGE:
+            proof_cells, com_cells = range_cells(proofs, coms)
+        else:
+            proof_cells, com_cells = opaque_cells(proofs), None
+        deadline_mono = time.monotonic() + budget
+        # one frame debits n row credits — backpressure is format-blind
+        self._acquire_credits(n, deadline_mono)
+        slot = _Slot()
+        req_id = next(self._req_ids)
+        payload = encode_submit_batch(
+            fmt=fmt, lane=lane, req_id_base=req_id,
+            deadline=self._wire_deadline(budget),
+            proof_cells=proof_cells, com_cells=com_cells, bits=bits,
+            flags=flags, deadline_off_us=deadline_off_us)
+        with self._cv:
+            self._pending[req_id] = slot
+        try:
+            self._send_batch(payload, n)
+            remaining = deadline_mono - time.monotonic()
+            if not slot.event.wait(timeout=max(0.0, remaining)):
+                raise WorkerUnavailable(
+                    f"rpc range_batch call timed out after {budget:.3f}s")
+        finally:
+            with self._cv:
+                self._pending.pop(req_id, None)
+        return self._classify("range", slot.reply)
+
     # ------------------------------------------------------- zk duck-type
     def submit_range(self, proofs, coms, *, lane: str = LANE_BULK,
                      deadline_s: float | None = None):
         proofs = list(proofs)
         coms = list(coms)
+        if self.prefer_batch:
+            self._ensure_conn()
+            if self.server_batch:
+                return self.submit_range_batch(proofs, coms, lane=lane,
+                                               deadline_s=deadline_s)
         return self._call("range", (proofs, coms), len(proofs),
                           lane=lane, deadline_s=deadline_s)
 
@@ -496,3 +613,115 @@ class RpcClient:
         """``WorkerClient.stop`` duck-type alias."""
         del timeout_s
         self.close()
+
+
+class BatchSubmitBuffer:
+    """Client-side coalescing buffer: single-row submits accumulate and
+    leave as ONE columnar SUBMIT_BATCH frame.
+
+    ``add(proof, com)`` returns a ``concurrent.futures.Future`` that
+    resolves to the row's bool verdict. A flush fires when any trigger
+    trips: ``max_rows`` rows buffered, ``max_bytes`` of estimated
+    payload, or ``max_delay_s`` since the oldest buffered row (a timer,
+    so a trickle of single rows still ships promptly). Flushes run on a
+    small private pool so ``add`` never blocks on the wire; row order
+    within a frame is arrival order.
+
+    This is how corpus replay and bench traffic ride batch frames
+    without restructuring their per-proof loops.
+    """
+
+    def __init__(self, client: RpcClient, *, max_rows: int = 256,
+                 max_bytes: int = 1 << 20, max_delay_s: float = 0.005,
+                 lane: str = LANE_BULK, deadline_s: float | None = None):
+        self.client = client
+        self.max_rows = max_rows
+        self.max_bytes = max_bytes
+        self.max_delay_s = max_delay_s
+        self.lane = lane
+        self.deadline_s = deadline_s
+        self._lock = threading.Lock()
+        self._rows: list[tuple] = []
+        self._bytes = 0
+        self._timer: threading.Timer | None = None
+        self._closed = False
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="batch-flush")
+
+    @staticmethod
+    def _row_cost(proof) -> int:
+        """Payload-size estimate for the byte trigger: serialized
+        proofs dominate the frame; metadata columns add 16B/row."""
+        if isinstance(proof, (bytes, bytearray)):
+            return 16 + len(proof)
+        return 16 + (256 if hasattr(proof, "serialize") else 4)
+
+    def add(self, proof, com=None, *, bits: int = 0,
+            forge_expected: bool = False) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("BatchSubmitBuffer is closed")
+            self._rows.append((proof, com, bits, forge_expected, fut))
+            self._bytes += self._row_cost(proof)
+            if self._timer is None:
+                t = threading.Timer(self.max_delay_s, self._flush_due)
+                t.daemon = True
+                self._timer = t
+                t.start()
+            rows = (self._take()
+                    if len(self._rows) >= self.max_rows
+                    or self._bytes >= self.max_bytes else None)
+        if rows:
+            self._pool.submit(self._flush_rows, rows)
+        return fut
+
+    def _take(self) -> list[tuple]:
+        """Detach the buffered rows (caller holds the lock)."""
+        rows, self._rows = self._rows, []
+        self._bytes = 0
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return rows
+
+    def _flush_due(self) -> None:
+        with self._lock:
+            rows = self._take()
+        if rows:
+            self._flush_rows(rows)
+
+    def _flush_rows(self, rows: list[tuple]) -> None:
+        proofs = [r[0] for r in rows]
+        coms = [r[1] for r in rows]
+        bits = [int(r[2]) for r in rows]
+        flags = [1 if r[3] else 0 for r in rows]
+        futures = [r[4] for r in rows]
+        try:
+            verdicts = self.client.submit_range_batch(
+                proofs, coms, lane=self.lane, deadline_s=self.deadline_s,
+                bits=bits, flags=flags)
+        except Exception as exc:  # noqa: BLE001 — fan the failure out
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        for fut, verdict in zip(futures, verdicts):
+            if not fut.done():
+                fut.set_result(bool(verdict))
+
+    def flush(self) -> None:
+        """Ship whatever is buffered now (synchronously)."""
+        with self._lock:
+            rows = self._take()
+        if rows:
+            self._flush_rows(rows)
+
+    def close(self) -> None:
+        """Final flush, then reject further adds."""
+        with self._lock:
+            self._closed = True
+            rows = self._take()
+        if rows:
+            self._flush_rows(rows)
+        self._pool.shutdown(wait=True)

@@ -1,9 +1,18 @@
 """Deadline-aware pow-2 bucket scheduler (continuous batch assembly).
 
-The scheduling unit is a (group, lane) FIFO: range rows and block
+The scheduling unit is a (group, lane) queue: range rows and block
 actions never mix into one device call (they take different backend
 paths), and within a group the interactive lane drains before bulk so
 adversarial/bulk backlog cannot starve latency-sensitive traffic.
+
+Within one (group, lane) queue, tenants drain by DEFICIT ROUND-ROBIN
+(:class:`_TenantDrrQueue`) instead of a global FIFO: each ``tms_id``
+owns a FIFO sub-queue and earns ``tenant_quantum * weight`` rows of
+service per rotation, so one hot tenant can no longer starve the rest
+(SURVEY §3.2 — many TMS instances share one validator). A single
+tenant degenerates to exact FIFO, preserving every historical ordering
+guarantee. Exposed as ``serve_tenant_drains_total{tms_id}`` and the
+``rpc_tenant_deficit`` gauge.
 
 Dispatch policy per group — evaluated continuously by the service loop:
 
@@ -40,13 +49,123 @@ from .request import KIND_RANGE, VerifyRequest
 GROUPS = ("action", KIND_RANGE)
 
 
+class _TenantDrrQueue:
+    """Deficit-round-robin queue over per-tenant FIFOs.
+
+    Deque-compatible for everything the scheduler and service do with a
+    queue — ``append`` / ``extend`` / ``clear`` / ``len`` / iteration /
+    ``q[0]`` / ``popleft`` — but ``popleft`` serves tenants by DRR:
+    every rotation to the front of the ring grants a tenant
+    ``tenant_quantum * weight`` rows of deficit; rows are served while
+    the deficit lasts, then the drain rotates. A tenant whose sub-queue
+    empties retires (deficit resets — the classic DRR rule that keeps
+    idle tenants from banking service).
+
+    Iteration and ``q[0]`` present rows in global arrival order
+    (``enqueue_t``, then ``req_id``), so deadline horizons and the
+    expiry sweep see the true oldest row regardless of drain order,
+    and a single tenant is byte-for-byte the old FIFO.
+    """
+
+    def __init__(self, config: ServeConfig):
+        self._quantum = float(config.tenant_quantum)
+        self._weights = dict(config.tenant_weights)
+        self._qs: dict[str, deque] = {}
+        self._ring: deque = deque()          # tenant rotation order
+        self._deficit: dict[str, float] = {}
+        self._granted: set = set()           # granted this front residence
+        self._len = 0
+
+    # --------------------------------------------------- deque duck-type
+    def append(self, req) -> None:
+        tenant = getattr(req, "tenant", "default") or "default"
+        q = self._qs.get(tenant)
+        if q is None:
+            q = self._qs[tenant] = deque()
+            self._ring.append(tenant)
+            self._deficit[tenant] = 0.0
+        q.append(req)
+        self._len += 1
+
+    def extend(self, reqs) -> None:
+        for req in reqs:
+            self.append(req)
+
+    def clear(self) -> None:
+        self._qs.clear()
+        self._ring.clear()
+        self._deficit.clear()
+        self._granted.clear()
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self):
+        rows = [r for q in self._qs.values() for r in q]
+        rows.sort(key=lambda r: (r.enqueue_t, r.req_id))
+        return iter(rows)
+
+    def __getitem__(self, idx: int):
+        if idx != 0:
+            raise IndexError("only the head (q[0]) is addressable")
+        heads = [q[0] for q in self._qs.values() if q]
+        if not heads:
+            raise IndexError("head of empty queue")
+        return min(heads, key=lambda r: (r.enqueue_t, r.req_id))
+
+    # ------------------------------------------------------------- DRR
+    def _retire(self, tenant: str) -> None:
+        self._ring.remove(tenant)
+        self._qs.pop(tenant, None)
+        self._deficit.pop(tenant, None)
+        self._granted.discard(tenant)
+
+    def popleft(self):
+        if self._len == 0:
+            raise IndexError("pop from empty queue")
+        while True:
+            tenant = self._ring[0]
+            q = self._qs.get(tenant)
+            if not q:
+                self._retire(tenant)
+                continue
+            if self._deficit[tenant] >= 1.0:
+                self._deficit[tenant] -= 1.0
+                self._len -= 1
+                req = q.popleft()
+                if not q:
+                    self._retire(tenant)
+                else:
+                    _METRICS.gauge(
+                        "rpc_tenant_deficit",
+                        help="Deficit-round-robin rows a tenant may still "
+                             "drain before rotating",
+                        tms_id=tenant).set(self._deficit[tenant])
+                _METRICS.counter(
+                    "serve_tenant_drains_total",
+                    help="Rows drained from the admission queues, by "
+                         "tenant tms id (the DRR fairness ledger)",
+                    tms_id=tenant).add()
+                return req
+            if tenant in self._granted:
+                # quantum exhausted this residence: rotate, keep residue
+                self._granted.discard(tenant)
+                self._ring.rotate(-1)
+                continue
+            self._granted.add(tenant)
+            self._deficit[tenant] += (
+                self._quantum * self._weights.get(tenant, 1.0))
+
+
 class BucketScheduler:
-    """Per-(group, lane) queues + the batch assembly decision."""
+    """Per-(group, lane) DRR tenant queues + the batch assembly decision."""
 
     def __init__(self, config: ServeConfig):
         self.config = config
-        self._queues: dict[tuple, deque] = {
-            (g, lane): deque() for g in GROUPS for lane in config.lanes}
+        self._queues: dict[tuple, _TenantDrrQueue] = {
+            (g, lane): _TenantDrrQueue(config)
+            for g in GROUPS for lane in config.lanes}
         # device-lane assignment state: last-emission stamp per dispatch
         # lane index (pick_lane round-robins over the idle ones)
         self._lane_stamp: dict[int, int] = {}

@@ -1,7 +1,10 @@
 """Async verification frontend: continuous batching over the ZK backends.
 
 ``VerificationService`` accepts individual verification requests
-(``submit_range`` / ``submit_transfer`` / ``submit_issue``), assembles
+(``submit_range`` / ``submit_transfer`` / ``submit_issue``) and whole
+columnar frames (``submit_batch`` — ONE admission decision, ONE journal
+event, and ONE WAL append for N rows; the front-door fast path for
+SUBMIT_BATCH frames), assembles
 them into pow-2-bucketed batches under the ``ServeConfig`` policy, runs
 each batch through the SAME entry points the unbatched path uses
 (``BatchRangeVerifier.verify`` for range rows, ``ZKVerifier.verify_block``
@@ -59,11 +62,11 @@ import numpy as np
 
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
-from ..obs.journal import (EVENT_BATCH_FORMED, EVENT_DISPATCH_END,
-                           EVENT_DISPATCH_START, EVENT_FALLBACK,
-                           EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED,
-                           EVENT_REQUEST_SHUTDOWN, EVENT_WAL_REPLAY,
-                           JOURNAL)
+from ..obs.journal import (EVENT_BATCH_ADMITTED, EVENT_BATCH_FORMED,
+                           EVENT_DISPATCH_END, EVENT_DISPATCH_START,
+                           EVENT_FALLBACK, EVENT_REQUEST_ADMITTED,
+                           EVENT_REQUEST_SHED, EVENT_REQUEST_SHUTDOWN,
+                           EVENT_WAL_REPLAY, JOURNAL)
 from ..obs.profiling import PROFILER
 from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
     ResilienceConfig
@@ -75,6 +78,7 @@ from .request import (KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
                       STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
 from .scheduler import BucketScheduler
+from .wal import RECORD_ADMIT_BATCH
 
 #: Family metadata for every serve_* instrument this module touches,
 #: hoisted so the HELP line cannot depend on which call site registers a
@@ -154,6 +158,10 @@ class VerificationService:
         self.wal = wal
         #: (wal_id, VerifyResult) pairs replayed at the last ``start()``.
         self.replayed: list[tuple[int, VerifyResult]] = []
+        # batch WAL countdown: wal_id -> rows not yet terminal. A frame
+        # admitted via submit_batch shares one wal_id across its rows;
+        # append_resolve fires exactly once, when the LAST row resolves.
+        self._wal_batch_open: dict[int, int] = {}
         self.config = config or ServeConfig()
         self.resilience = resilience
         self.slo = slo
@@ -258,15 +266,25 @@ class VerificationService:
             # wall deadline is long past, and expiring a recovered
             # request unexamined would defeat the replay
             deadline_s = max(e.deadline_s, self.config.default_deadline_s)
-            req = VerifyRequest(kind=e.kind, payload=e.payload,
-                                lane=e.lane, deadline=now + deadline_s,
-                                enqueue_t=now, future=loop.create_future(),
-                                wal_id=e.wal_id)
+            # a batch record expands back into per-row requests sharing
+            # the frame's wal_id; the countdown keeps resolution at one
+            # RECORD_RESOLVE per frame, mirroring the admit side
+            row_payloads = (list(e.payload)
+                            if e.record == RECORD_ADMIT_BATCH
+                            else [e.payload])
+            if e.record == RECORD_ADMIT_BATCH:
+                self._wal_batch_open[e.wal_id] = len(row_payloads)
             JOURNAL.record(EVENT_WAL_REPLAY, req_kind=e.kind, lane=e.lane,
-                           wal_id=e.wal_id)
-            _METRICS.counter("wal_replayed_total").add()
-            self.scheduler.push(req)
-            reqs.append(req)
+                           wal_id=e.wal_id, rows=len(row_payloads))
+            _METRICS.counter("wal_replayed_total").add(len(row_payloads))
+            for payload in row_payloads:
+                req = VerifyRequest(kind=e.kind, payload=payload,
+                                    lane=e.lane, deadline=now + deadline_s,
+                                    enqueue_t=now,
+                                    future=loop.create_future(),
+                                    wal_id=e.wal_id)
+                self.scheduler.push(req)
+                reqs.append(req)
         self._wake.set()
         results = await asyncio.gather(*(r.future for r in reqs))
         self.replayed = [(r.wal_id, res) for r, res in zip(reqs, results)]
@@ -396,6 +414,70 @@ class VerificationService:
         self.scheduler.push(req)
         self._wake.set()
         return await req.future
+
+    async def submit_batch(self, kind, payloads, *, deadline_s=None,
+                           deadline_offsets_s=None, lane: str = LANE_BULK,
+                           tenant: str = "default") -> list[VerifyResult]:
+        """Admit one columnar frame of ``len(payloads)`` rows at once.
+
+        The front-door fast path for SUBMIT_BATCH frames: the whole
+        frame admits or sheds with ONE admission decision, ONE journal
+        event (:data:`EVENT_BATCH_ADMITTED`), and ONE WAL append
+        (``append_admit_batch``), then its rows fan into the normal
+        bucket scheduler — same batch assembly, same device call,
+        bit-identical verdicts to N individual submits.
+
+        ``deadline_s`` is the base budget (config default when None);
+        ``deadline_offsets_s`` optionally adds a per-row offset (the
+        frame's ``deadline_off_us`` column). ``tenant`` is the DRR
+        drain key. Returns one :class:`VerifyResult` per row, in row
+        order.
+        """
+        if not self._running:
+            raise RuntimeError("VerificationService is not started")
+        n = len(payloads)
+        if n == 0:
+            return []
+        now = time.perf_counter()
+        base = (self.config.default_deadline_s
+                if deadline_s is None else deadline_s)
+        if deadline_offsets_s is not None:
+            row_deadline_s = [base + float(deadline_offsets_s[i])
+                              for i in range(n)]
+        else:
+            row_deadline_s = [base] * n
+        # triage on the frame's LATEST row: if even that one cannot be
+        # served in time, the whole frame is a deterministic miss
+        shed = self.admission.admit_batch(
+            kind, lane, n, self.scheduler.lane_depth(lane),
+            now + max(row_deadline_s))
+        if shed is not None:
+            JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
+                           rows=n, tenant=tenant, status=shed)
+            if self.slo is not None:
+                for _ in range(n):
+                    self.slo.record(False)
+            return [VerifyResult(status=shed) for _ in range(n)]
+        JOURNAL.record(EVENT_BATCH_ADMITTED, req_kind=kind, lane=lane,
+                       rows=n, tenant=tenant,
+                       depth=self.scheduler.lane_depth(lane))
+        wal_id = None
+        if self.wal is not None:
+            # durability point for the WHOLE frame: one flushed line
+            wal_id = self.wal.append_admit_batch(
+                kind=kind, lane=lane, deadline_s=base, payloads=payloads)
+            self._wal_batch_open[wal_id] = n
+        loop = asyncio.get_running_loop()
+        reqs = []
+        for i, payload in enumerate(payloads):
+            req = VerifyRequest(kind=kind, payload=payload, lane=lane,
+                                deadline=now + row_deadline_s[i],
+                                enqueue_t=now, future=loop.create_future(),
+                                wal_id=wal_id, tenant=tenant)
+            self.scheduler.push(req)
+            reqs.append(req)
+        self._wake.set()
+        return list(await asyncio.gather(*(r.future for r in reqs)))
 
     # ------------------------------------------------------ dispatch loop
     async def _dispatch_loop(self) -> None:
@@ -698,9 +780,19 @@ class VerificationService:
             ok = result.status == STATUS_OK
             self.slo.record(ok, result.total_s if ok else None)
         if self.wal is not None and req.wal_id is not None:
-            self.wal.append_resolve(req.wal_id, status=result.status,
-                                    accepted=result.accepted,
-                                    served_by=result.served_by)
+            open_rows = self._wal_batch_open.get(req.wal_id)
+            if open_rows is None:
+                self.wal.append_resolve(req.wal_id, status=result.status,
+                                        accepted=result.accepted,
+                                        served_by=result.served_by)
+            elif open_rows <= 1:
+                # last row of a batch frame: the single resolve record
+                del self._wal_batch_open[req.wal_id]
+                self.wal.append_resolve(req.wal_id, status=result.status,
+                                        accepted=result.accepted,
+                                        served_by=result.served_by)
+            else:
+                self._wal_batch_open[req.wal_id] = open_rows - 1
         self._finish_request_span(req, result)
         if req.future is not None and not req.future.done():
             req.future.set_result(result)

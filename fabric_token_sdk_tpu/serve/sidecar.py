@@ -57,6 +57,8 @@ def sidecar_main(factory, host: str, port: int, *,
                  default_deadline_s: float = 30.0,
                  resilience=None,
                  rpc: RpcConfig | None = None,
+                 tenant_quantum: int = 8,
+                 tenant_weights: tuple = (),
                  beat_interval_s: float = 0.25) -> None:
     """Child entry point (spawn context: every arg must pickle).
 
@@ -85,7 +87,9 @@ def sidecar_main(factory, host: str, port: int, *,
     zk = factory()
     config = ServeConfig(buckets=tuple(buckets), max_wait_s=max_wait_s,
                          default_deadline_s=default_deadline_s,
-                         prewarm_block=include_block)
+                         prewarm_block=include_block,
+                         tenant_quantum=tenant_quantum,
+                         tenant_weights=tuple(tenant_weights))
     wal = None
     if wal_dir is not None:
         wal = WriteAheadLog(wal_dir)
@@ -133,6 +137,7 @@ class RpcSidecar:
                  include_block: bool = False, max_wait_s: float = 0.005,
                  default_deadline_s: float = 30.0, resilience=None,
                  rpc: RpcConfig | None = None,
+                 tenant_quantum: int = 8, tenant_weights: tuple = (),
                  name: str = "rpc-sidecar", mp_context: str = "spawn"):
         self.factory = factory
         self.host = host
@@ -147,6 +152,8 @@ class RpcSidecar:
         self.default_deadline_s = default_deadline_s
         self.resilience = resilience
         self.rpc = rpc
+        self.tenant_quantum = tenant_quantum
+        self.tenant_weights = tuple(tenant_weights)
         self.name = name
         self._ctx = mp.get_context(mp_context)
         self._proc = None
@@ -168,6 +175,8 @@ class RpcSidecar:
                 "default_deadline_s": self.default_deadline_s,
                 "resilience": self.resilience,
                 "rpc": self.rpc,
+                "tenant_quantum": self.tenant_quantum,
+                "tenant_weights": self.tenant_weights,
             },
             name=self.name, daemon=True)
         proc.start()

@@ -7,7 +7,11 @@ verdict. This module closes that hole with the classic WAL contract —
 
   - ``append_admit`` records every admitted request (kind, lane,
     deadline, full payload) as one flushed JSON line **before** it
-    enters the scheduler;
+    enters the scheduler; ``append_admit_batch`` is the columnar
+    front-door counterpart — ONE record covers every row of an
+    admitted SUBMIT_BATCH frame, so frame ingest costs one WAL append
+    instead of N (the perf contract ``perf_profile.py --mode ingest``
+    asserts);
   - ``append_resolve`` records the terminal verdict (status, accepted,
     served_by) when the request completes — exactly once, enforced
     here (a duplicate resolve is counted and dropped, never
@@ -53,8 +57,9 @@ from ..obs.journal import EVENT_WAL_RECOVERED, JOURNAL
 
 _WAL_FAMILIES = {
     "wal_appends_total":
-        "WAL records appended, by record type (admit / resolve / "
-        "resolve_duplicate — duplicates are dropped, not written).",
+        "WAL records appended, by record type (admit / admit_batch / "
+        "resolve / resolve_duplicate — duplicates are dropped, not "
+        "written).",
     "wal_bytes_written_total":
         "Bytes appended to WAL segment files, records plus newlines.",
     "wal_segments_total":
@@ -76,6 +81,12 @@ _WAL_FAMILIES = {
 #: Record types (the ``t`` field of every JSON line).
 RECORD_ADMIT = "admit"
 RECORD_RESOLVE = "resolve"
+#: One columnar frame admitted as a single record: ``payload`` pickles
+#: the TUPLE of row payloads and ``rows`` carries its length, so the
+#: durability cost of a 256-row frame is one line, not 256. Resolution
+#: is still one RECORD_RESOLVE per batch id (the service counts rows
+#: down and resolves once the last row terminates).
+RECORD_ADMIT_BATCH = "admit_batch"
 
 _SEGMENT_PREFIX = "wal-"
 _SEGMENT_SUFFIX = ".jsonl"
@@ -97,13 +108,20 @@ class WalConfig:
 
 @dataclass
 class WalEntry:
-    """One recovered admitted-but-unresolved request."""
+    """One recovered admitted-but-unresolved request (or frame).
+
+    ``record == RECORD_ADMIT_BATCH`` marks a columnar frame: ``payload``
+    is the tuple of row payloads and ``rows`` its length; the replayer
+    expands it back into per-row requests under the shared wal_id.
+    """
 
     wal_id: int
     kind: str
     lane: str
     deadline_s: float
     payload: tuple
+    rows: int = 1
+    record: str = RECORD_ADMIT
 
 
 def _encode_payload(payload) -> str:
@@ -212,6 +230,27 @@ class WriteAheadLog:
         self._gauge_open()
         return wal_id
 
+    def append_admit_batch(self, kind: str, lane: str, deadline_s: float,
+                           payloads: list | tuple) -> int:
+        """Log one admitted columnar frame as ONE flushed record.
+
+        ``payloads`` is the frame's row payloads in row order; the whole
+        tuple pickles into a single ``payload`` field so a 256-row frame
+        costs one append (+ one resolve when the last row terminates)
+        instead of 512 records. Returns the shared WAL id.
+        """
+        if not self._recovered:
+            self.recover()
+        wal_id = self._next_id
+        self._next_id += 1
+        self._append({"t": RECORD_ADMIT_BATCH, "id": wal_id, "kind": kind,
+                      "lane": lane, "deadline_s": round(deadline_s, 6),
+                      "rows": len(payloads), "ts": round(time.time(), 6),
+                      "payload": _encode_payload(tuple(payloads))})
+        self._open_ids.add(wal_id)
+        self._gauge_open()
+        return wal_id
+
     def append_resolve(self, wal_id: int, status: str,
                        accepted: bool | None = None,
                        served_by: str = "") -> bool:
@@ -282,7 +321,7 @@ class WriteAheadLog:
                     continue
                 rid = int(record.get("id", 0))
                 max_id = max(max_id, rid)
-                if record.get("t") == RECORD_ADMIT:
+                if record.get("t") in (RECORD_ADMIT, RECORD_ADMIT_BATCH):
                     admits[rid] = record
                 elif record.get("t") == RECORD_RESOLVE:
                     resolved.add(rid)
@@ -320,7 +359,9 @@ class WriteAheadLog:
                 continue
             entries.append(WalEntry(
                 wal_id=int(rec["id"]), kind=rec["kind"], lane=rec["lane"],
-                deadline_s=float(rec["deadline_s"]), payload=payload))
+                deadline_s=float(rec["deadline_s"]), payload=payload,
+                rows=int(rec.get("rows", 1)),
+                record=rec.get("t", RECORD_ADMIT)))
         if paths:
             # compaction: the incomplete set is the only state worth
             # keeping — rewrite it into a fresh segment, drop history
@@ -332,11 +373,14 @@ class WriteAheadLog:
                 self._segment_seq = len(paths)
             self._open_segment()
             for entry in entries:
-                self._append({"t": RECORD_ADMIT, "id": entry.wal_id,
-                              "kind": entry.kind, "lane": entry.lane,
-                              "deadline_s": round(entry.deadline_s, 6),
-                              "ts": round(time.time(), 6),
-                              "payload": _encode_payload(entry.payload)})
+                rec = {"t": entry.record, "id": entry.wal_id,
+                       "kind": entry.kind, "lane": entry.lane,
+                       "deadline_s": round(entry.deadline_s, 6),
+                       "ts": round(time.time(), 6),
+                       "payload": _encode_payload(entry.payload)}
+                if entry.record == RECORD_ADMIT_BATCH:
+                    rec["rows"] = entry.rows
+                self._append(rec)
             for path in paths:
                 try:
                     os.remove(path)

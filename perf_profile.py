@@ -47,6 +47,12 @@ Modes (--mode):
            per chunk (asserted via the same dispatch hook); prints the
            XLA cost analysis of the prove chunk program and device
            proofs/s vs the host prover's measured wall-clock.
+  ingest   columnar front-door audit (crypto-free, StubZK): decodes a
+           >=256-row SUBMIT_BATCH payload into numpy views and asserts
+           ZERO pickle calls, then drives the real TCP RpcServer and
+           asserts one N-row frame costs exactly ONE admission decision
+           + ONE WAL append (+ ONE resolve); reports decode ns/row for
+           the columnar layout vs the legacy per-row pickled bodies.
 
 Output: human-readable table on stderr, one JSON document on stdout.
 --trace <path> additionally writes the span tree as Chrome trace-event
@@ -570,10 +576,181 @@ def _mode_mesh(args, tracer, records) -> dict:
     return doc
 
 
+def _mode_ingest(args, tracer, records) -> dict:
+    """Columnar front-door ingest audit (round 12). Crypto-free.
+
+    Three artifacts:
+      1. Decode cost per row: one >=256-row columnar SUBMIT_BATCH
+         payload decoded into numpy views over the frame buffer vs the
+         legacy per-row pickled SUBMIT bodies — with a pickle.loads
+         counter proving the columnar decode performs ZERO pickle calls
+         (and hence zero per-row Python object graphs).
+      2. The single-decision contract, asserted on the production
+         service behind the real TCP server: one N-row frame costs
+         exactly ONE admission decision and ONE WAL admit append
+         (plus ONE resolve append once every row completes), however
+         many rows the frame carries.
+      3. Ingested proofs/s through the live front door (RpcServer +
+         RpcClient riding columnar frames, StubZK backend).
+    """
+    import asyncio
+    import pickle
+    import tempfile
+    import threading
+
+    from fabric_token_sdk_tpu.serve import (LANE_BULK, RpcClient,
+                                            RpcServer, ServeConfig,
+                                            StubZK, VerificationService)
+    from fabric_token_sdk_tpu.serve.columnar import (FMT_OPAQUE,
+                                                     decode_submit_batch,
+                                                     encode_submit_batch,
+                                                     materialize_rows,
+                                                     opaque_cells)
+    from fabric_token_sdk_tpu.serve.wal import WriteAheadLog
+
+    n = max(256, args.batch)
+    truth = [i % 7 != 0 for i in range(n)]
+    payload = encode_submit_batch(
+        fmt=FMT_OPAQUE, lane=LANE_BULK, req_id_base=1,
+        deadline=time.time() + 60.0, proof_cells=opaque_cells(truth))
+
+    pickle_calls = {"n": 0}
+    real_loads = pickle.loads
+
+    def counting_loads(*a, **kw):
+        pickle_calls["n"] += 1
+        return real_loads(*a, **kw)
+
+    iters = max(20, args.reps)
+    pickle.loads = counting_loads
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            batch = decode_submit_batch(payload)
+        col_s = (time.perf_counter() - t0) / iters
+    finally:
+        pickle.loads = real_loads
+    assert pickle_calls["n"] == 0, \
+        "columnar decode touched pickle — the zero-copy contract broke"
+    proofs, _ = materialize_rows(batch)
+    assert proofs == truth
+
+    # the layout this replaces: one pickled dict per row
+    legacy_rows = [pickle.dumps(
+        {"req_id": i, "kind": "range", "lane": LANE_BULK, "rows": 1,
+         "deadline_s": 60.0, "payload": ([truth[i]], [None])},
+        protocol=pickle.HIGHEST_PROTOCOL) for i in range(n)]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for body in legacy_rows:
+            real_loads(body)
+    pkl_s = (time.perf_counter() - t0) / iters
+
+    col_ns_row = 1e9 * col_s / n
+    pkl_ns_row = 1e9 * pkl_s / n
+    print(f"decode {n} rows: columnar {col_ns_row:.0f} ns/row "
+          f"({n / col_s:,.0f} rows/s) vs pickled {pkl_ns_row:.0f} ns/row "
+          f"({n / pkl_s:,.0f} rows/s) — x{pkl_s / col_s:.1f}",
+          file=sys.stderr)
+    print(f"wire cost: {len(payload) / n:.1f} B/row columnar vs "
+          f"{sum(map(len, legacy_rows)) / n:.1f} B/row pickled",
+          file=sys.stderr)
+
+    # ---- the live front door: one frame = one decision + one append
+    frames = max(2, args.reps)
+    counts = {"admit_calls": 0, "admit_rows": 0, "wal_admits": 0,
+              "wal_resolves": 0}
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=loop.run_forever,
+                                  name="ingest-loop", daemon=True)
+        thread.start()
+
+        def run(coro):
+            return asyncio.run_coroutine_threadsafe(coro, loop) \
+                .result(60.0)
+
+        wal = WriteAheadLog(wal_dir)
+        cfg = ServeConfig(buckets=(max(256, n),), max_wait_s=0.002,
+                          queue_capacity=4 * n)
+        svc = VerificationService(StubZK(), cfg, wal=wal)
+
+        async def _boot():
+            await svc.start(prewarm=False)
+            server = RpcServer(svc)
+            return server, await server.start()
+
+        server, addr = run(_boot())
+
+        real_admit = svc.admission.admit_batch
+        real_append = wal.append_admit_batch
+        real_resolve = wal.append_resolve
+
+        def admit_batch(kind, lane, rows, lane_depth, deadline):
+            counts["admit_calls"] += 1
+            counts["admit_rows"] += rows
+            return real_admit(kind, lane, rows, lane_depth, deadline)
+
+        def append_admit_batch(**kw):
+            counts["wal_admits"] += 1
+            return real_append(**kw)
+
+        def append_resolve(*a, **kw):
+            counts["wal_resolves"] += 1
+            return real_resolve(*a, **kw)
+
+        svc.admission.admit_batch = admit_batch
+        wal.append_admit_batch = append_admit_batch
+        wal.append_resolve = append_resolve
+        try:
+            cli = RpcClient(addr, tms_id="ingest", call_timeout_s=60.0)
+            try:
+                t0 = time.perf_counter()
+                for _ in range(frames):
+                    out = cli.submit_range_batch(truth, [None] * n)
+                    assert out.tolist() == truth
+                wall = time.perf_counter() - t0
+            finally:
+                cli.close()
+        finally:
+            async def _down():
+                await server.stop(drain=True)
+                await svc.stop(drain=True)
+            run(_down())
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=5.0)
+            loop.close()
+
+    assert counts["admit_calls"] == frames, counts
+    assert counts["admit_rows"] == frames * n, counts
+    assert counts["wal_admits"] == frames, counts
+    assert counts["wal_resolves"] == frames, counts
+    print(f"{frames} frames x {n} rows through the TCP front door: "
+          f"{counts['admit_calls']} admission decisions, "
+          f"{counts['wal_admits']} WAL admit appends, "
+          f"{counts['wal_resolves']} WAL resolves "
+          f"({frames * n / wall:,.0f} proofs/s ingested)", file=sys.stderr)
+
+    return {"rows_per_frame": n, "frames": frames,
+            "wall_s": round(wall, 4),
+            "ingested_proofs_per_sec": round(frames * n / wall, 2),
+            "decode": {
+                "columnar_ns_per_row": round(col_ns_row, 1),
+                "pickled_ns_per_row": round(pkl_ns_row, 1),
+                "pickled_over_columnar": round(pkl_s / col_s, 2),
+                "pickle_calls_in_columnar_decode": pickle_calls["n"],
+                "columnar_bytes_per_row": round(len(payload) / n, 1),
+                "pickled_bytes_per_row":
+                    round(sum(map(len, legacy_rows)) / n, 1)},
+            "contract": dict(counts)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("range", "block", "barrier", "fold",
-                                       "pipeline", "mesh", "prove"),
+                                       "pipeline", "mesh", "prove",
+                                       "ingest"),
                     default="range")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=3)
@@ -600,7 +777,7 @@ def main() -> None:
     mode = {"range": _mode_range, "block": _mode_block,
             "barrier": _mode_barrier, "fold": _mode_fold,
             "pipeline": _mode_pipeline, "mesh": _mode_mesh,
-            "prove": _mode_prove}[args.mode]
+            "prove": _mode_prove, "ingest": _mode_ingest}[args.mode]
     doc = mode(args, TRACER, RECORDS)
     doc["mode"] = args.mode
     doc["batch"] = args.batch

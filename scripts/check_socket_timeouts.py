@@ -14,9 +14,12 @@ modules (``serve/``, ``resilience/``, ``obs/telemetry.py``,
      primitives are cancellable; their deadline is the enclosing task's
      ``wait_for`` or supervisor), and dict-style lookups (``.get``
      with arguments is fine by construction).
-  2. **Read waits** — calls to ``.recv`` / ``.recv_bytes`` /
-     ``.accept`` / ``.readexactly`` / ``.readuntil`` with no deadline
-     source. A deadline source is either an enclosing
+  2. **Read waits** — calls to ``.recv`` / ``.recv_into`` /
+     ``.recv_bytes`` / ``.accept`` / ``.readexactly`` /
+     ``.readuntil`` / ``.readinto`` with no deadline
+     source (``recv_into``/``readinto`` cover the zero-copy batch
+     frame read path — filling a preallocated buffer blocks exactly
+     like ``recv``). A deadline source is either an enclosing
      ``wait_for(...)`` call in the same expression, or an explicit
      waiver comment ``# io-deadline: <why>`` on the call line or the
      line above — the waiver documents which OUTER mechanism bounds
@@ -46,8 +49,8 @@ SCOPE = [
 ]
 
 SYNC_WAITS = {"poll", "wait", "join", "get"}
-READ_WAITS = {"recv", "recv_bytes", "recv_bytes_into", "accept",
-              "readexactly", "readuntil"}
+READ_WAITS = {"recv", "recv_into", "recv_bytes", "recv_bytes_into",
+              "accept", "readexactly", "readuntil", "readinto"}
 WAIVER = "# io-deadline:"
 
 

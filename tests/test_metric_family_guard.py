@@ -66,6 +66,7 @@ STABLE_FAMILIES = (
     "serve_requests_total",
     "serve_results_total",
     "serve_shed_total",
+    "serve_tenant_drains_total",
     "serve_wait_seconds",
     # serve/ per-device dispatch lanes (multi-chip continuous batching)
     "lane_busy_seconds",
@@ -78,18 +79,23 @@ STABLE_FAMILIES = (
     "mesh_devices",
     "mesh_pad_rows_total",
     # serve/ network front door (RPC sidecar)
+    "rpc_batch_bytes_total",
+    "rpc_batch_frames_total",
+    "rpc_batch_rows_total",
     "rpc_call_seconds",
     "rpc_connections_active",
     "rpc_connections_total",
     "rpc_credit_waits_total",
     "rpc_credits",
     "rpc_deadline_expired_total",
+    "rpc_decode_seconds",
     "rpc_frame_errors_total",
     "rpc_frames_total",
     "rpc_goaways_total",
     "rpc_hedges_total",
     "rpc_redials_total",
     "rpc_requests_total",
+    "rpc_tenant_deficit",
     # serve/ pipe worker single-flight contention
     "serve_worker_lock_wait_seconds",
     # serve/ write-ahead log

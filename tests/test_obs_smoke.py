@@ -467,13 +467,19 @@ EXPECTED_RPC_FAMILIES = (
     "rpc_deadline_expired_total",
     "rpc_call_seconds",
     "rpc_hedges_total",
+    # columnar batch ingest (PR: zero-copy front door)
+    "rpc_batch_frames_total",
+    "rpc_batch_rows_total",
+    "rpc_batch_bytes_total",
+    "rpc_decode_seconds",
+    "rpc_tenant_deficit",
 )
 
 
 def test_rpc_families_export():
     """One server lifetime lights every rpc_* family: a round-trip, a
-    hedged interactive call, a poisoned frame, an expired deadline, a
-    credit stall, and a draining GOAWAY stop."""
+    columnar batch frame, a hedged interactive call, a poisoned frame,
+    an expired deadline, a credit stall, and a draining GOAWAY stop."""
     import asyncio
     import socket
     import threading
@@ -506,6 +512,11 @@ def test_rpc_families_export():
                     hedge_after_s=0.0)
     try:
         assert cli.submit_range([True], [None]).tolist() == [True]
+        # one columnar SUBMIT_BATCH frame: two rows share one frame,
+        # one admission, one DRR drain burst (lights the batch + tenant
+        # families on both roles)
+        assert cli.submit_range_batch(
+            [True, False], [None, None]).tolist() == [True, False]
         cli.submit_range([True], [None], lane=LANE_INTERACTIVE)  # hedges
 
         try:  # 5 rows > 2-credit grant: counted stall, then shed
@@ -544,6 +555,11 @@ def test_rpc_families_export():
     assert "# TYPE rpc_connections_active gauge" in text
     assert "# TYPE rpc_call_seconds histogram" in text
     assert "# HELP rpc_frame_errors_total" in text
+    # batch decode is timed per format, and the DRR drain ledger counts
+    # every row by tenant tms id
+    assert 'fmt="columnar"' in text
+    assert "serve_tenant_drains_total" in text
+    assert "# TYPE rpc_decode_seconds histogram" in text
 
 
 # prover/ device proof synthesis families (PR: tpu-side prover) — stable

@@ -53,7 +53,7 @@ def test_linter_sees_the_scope():
     files = mod._scope_files()
     names = {f.name for f in files}
     assert {"rpc.py", "rpc_client.py", "worker.py", "supervisor.py",
-            "telemetry.py", "aggregate.py"} <= names
+            "telemetry.py", "aggregate.py", "columnar.py"} <= names
     assert len(files) > 8
 
 
@@ -76,5 +76,31 @@ def test_timeouts_and_waivers_satisfy_the_lint():
             await ev.wait()
             await wait_for(reader.readexactly(12), 5.0)
             data = conn.recv(4096)  # io-deadline: settimeout tick
+    """)
+    assert ok == []
+
+
+def test_detects_unbounded_zero_copy_reads():
+    """The columnar batch read path fills preallocated buffers with
+    recv_into/readinto — those block exactly like recv and must be
+    surfaced by the lint, not slip past it as 'has an argument'."""
+    mod = _load()
+    assert {"recv_into", "readinto"} <= mod.READ_WAITS
+    bad = _offenders_in(mod, """
+        def f(sock, view, raw):
+            sock.recv_into(view)
+            raw.readinto(view)
+    """)
+    assert {name for _, name, _ in bad} == {"recv_into", "readinto"}
+
+
+def test_waiver_bounds_the_batch_decode_read():
+    """The server's zero-copy frame read (serve/rpc.py recv_exact_sock)
+    rides a settimeout tick; the same waiver idiom must satisfy the
+    lint for recv_into as it does for recv."""
+    mod = _load()
+    ok = _offenders_in(mod, """
+        def f(sock, view):
+            k = sock.recv_into(view)  # io-deadline: settimeout tick
     """)
     assert ok == []
