@@ -639,6 +639,184 @@ def _bench_frontdoor():
         f"x{min_speedup:.1f} bar (per-client {per_batch:.0f} vs "
         f"{per_legacy:.0f} proofs/s)")
 
+    # ---- phase 3: noisy neighbor through the per-tenant SLO plane ----
+    # One hot tenant offered ~10x the victims' load against a THROTTLED
+    # stub backend (the phase measures the SLO plane, not the verifier):
+    # the hot tenant's queue_full sheds burn its own error budget, its
+    # fast-burn trips, and the TenantShedPolicy isolates it with
+    # shed_tenant_slo while nine victim tenants keep being served. Run
+    # twice — shed ON vs FTS_NO_TENANT_SHED=1 — and assert the victims'
+    # p99 does not regress when the hot tenant trips its shed.
+    from fabric_token_sdk_tpu.obs import TenantSloMonitor, TenantSloPolicy
+    from fabric_token_sdk_tpu.serve import WorkerUnavailable
+
+    noisy_secs = float(os.environ.get("BENCH_NOISY_SECONDS", "6"))
+    n_victims, hot_conns = 9, 6
+    h_rows, v_rows = 1024, 16
+    h_p, h_c = [True] * h_rows, [None] * h_rows
+
+    class _ThrottledRange:
+        def verify(self, proofs, coms):
+            time.sleep(len(proofs) * 50e-6)     # ~20k rows/s capacity
+            return [bool(p) for p in proofs]
+
+    class _ThrottledZK:
+        pp = None
+
+        def __init__(self):
+            self._range = _ThrottledRange()
+
+        def verify_block(self, transfers, issues):
+            return ([bool(t[0]) for t in transfers],
+                    [bool(i[0]) for i in issues])
+
+        def prewarm_shapes(self, buckets, include_block=False):
+            del include_block
+            return {int(b): 0.0 for b in buckets}
+
+    def _noisy_arm(shed_on):
+        prev = os.environ.pop("FTS_NO_TENANT_SHED", None)
+        if not shed_on:
+            os.environ["FTS_NO_TENANT_SHED"] = "1"
+        try:
+            monitor = TenantSloMonitor(TenantSloPolicy(
+                windows=(1.0, 5.0), min_volume=64, eval_interval_s=0.05,
+                max_tenants=64))
+            ncfg = ServeConfig(buckets=(16, 256, 1024), max_wait_s=0.002,
+                               default_deadline_s=60.0,
+                               queue_capacity=4096, max_tenants=64)
+            nsvc = VerificationService(_ThrottledZK(), config=ncfg,
+                                       tenant_slo=monitor)
+        finally:
+            if prev is not None:
+                os.environ["FTS_NO_TENANT_SHED"] = prev
+            else:
+                os.environ.pop("FTS_NO_TENANT_SHED", None)
+        nloop = asyncio.new_event_loop()
+        nthread = threading.Thread(target=nloop.run_forever,
+                                   name="noisy-loop", daemon=True)
+        nthread.start()
+
+        def nrun(coro):
+            return asyncio.run_coroutine_threadsafe(
+                coro, nloop).result(120.0)
+
+        async def _nboot():
+            await nsvc.start(prewarm=False)
+            s = RpcServer(nsvc, RpcConfig(conn_credits=8 * h_rows))
+            return s, await s.start()
+
+        nserver, naddr = nrun(_nboot())
+        stop_at = time.perf_counter() + noisy_secs
+        lock = threading.Lock()
+        v_lats: list[float] = []
+        stats = {"victim_errs": 0, "parity": 0}
+
+        def victim(idx):
+            cli = RpcClient(naddr, tms_id=f"victim-{idx}",
+                            call_timeout_s=60.0)
+            mine, errs, bad = [], 0, 0
+            try:
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        out = cli.submit_range_batch(h_p[:v_rows],
+                                                     h_c[:v_rows])
+                        mine.append(time.perf_counter() - t0)
+                        if not all(bool(x) for x in out):
+                            bad += 1
+                    except WorkerUnavailable:
+                        errs += 1          # shed rows raise client-side
+                    time.sleep(0.02)
+            finally:
+                cli.close()
+            with lock:
+                v_lats.extend(mine)
+                stats["victim_errs"] += errs
+                stats["parity"] += bad
+
+        def hot(_i):
+            cli = RpcClient(naddr, tms_id="hot", call_timeout_s=60.0)
+            bad = 0
+            try:
+                while time.perf_counter() < stop_at:
+                    try:
+                        out = cli.submit_range_batch(h_p, h_c)
+                        if not all(bool(x) for x in out):
+                            bad += 1
+                    except WorkerUnavailable:
+                        pass               # shed: the point of the phase
+            finally:
+                cli.close()
+            with lock:
+                stats["parity"] += bad
+
+        threads = [threading.Thread(target=victim, args=(i,))
+                   for i in range(n_victims)]
+        threads += [threading.Thread(target=hot, args=(i,))
+                    for i in range(hot_conns)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        summ = nsvc.tenant_status()
+
+        async def _ndown():
+            await nserver.stop(drain=True)
+            await nsvc.stop(drain=False, timeout_s=30.0)
+
+        nrun(_ndown())
+        nloop.call_soon_threadsafe(nloop.stop)
+        nthread.join(timeout=10.0)
+        nloop.close()
+        hot_row = summ["tenants"].get("hot", {})
+        return {
+            "victim_p99_s": _p99(v_lats),
+            "victim_calls": len(v_lats),
+            "victim_errors": stats["victim_errs"],
+            "parity_errors": stats["parity"],
+            "hot_trips": hot_row.get("trips", 0),
+            "hot_sheds": hot_row.get("sheds", 0),
+            "fairness": summ.get("fairness", {}),
+        }
+
+    print(f"frontdoor bench: phase 3 — noisy neighbor, shed ON "
+          f"({hot_conns} hot conns vs {n_victims} victims, "
+          f"{noisy_secs:.0f}s/arm)", file=sys.stderr)
+    arm_on = _noisy_arm(shed_on=True)
+    print("frontdoor bench: phase 3 — noisy neighbor, shed OFF "
+          "(FTS_NO_TENANT_SHED=1)", file=sys.stderr)
+    arm_off = _noisy_arm(shed_on=False)
+
+    noisy_errs = _fam("rpc_frame_errors_total") - errs0 - errs
+    p99_on, p99_off = arm_on["victim_p99_s"], arm_off["victim_p99_s"]
+    print(json.dumps({
+        "metric": f"frontdoor_noisy_victim_p99_ms_{BIT_LENGTH}bit",
+        "value": round(p99_on * 1e3, 2),
+        "unit": (f"ms victim p99 with tenant shed ON vs "
+                 f"{p99_off * 1e3:.1f}ms OFF; hot trips "
+                 f"{arm_on['hot_trips']} sheds {arm_on['hot_sheds']} "
+                 f"(OFF arm trips {arm_off['hot_trips']} sheds "
+                 f"{arm_off['hot_sheds']}); victim calls "
+                 f"{arm_on['victim_calls']}/{arm_off['victim_calls']} "
+                 f"errs {arm_on['victim_errors']}/"
+                 f"{arm_off['victim_errors']}; fairness "
+                 f"{arm_on['fairness']} vs {arm_off['fairness']})"),
+    }))
+    assert arm_on["parity_errors"] == 0 and arm_off["parity_errors"] == 0, \
+        "noisy-neighbor phase saw verdict-parity errors"
+    assert noisy_errs == 0, \
+        f"{noisy_errs} rpc_frame_errors_total in the noisy phase"
+    assert arm_on["hot_trips"] >= 1 and arm_on["hot_sheds"] > 0, (
+        f"hot tenant never tripped its SLO shed (trips "
+        f"{arm_on['hot_trips']}, sheds {arm_on['hot_sheds']})")
+    assert arm_off["hot_sheds"] == 0, (
+        f"FTS_NO_TENANT_SHED=1 arm still shed {arm_off['hot_sheds']} "
+        "rows by tenant policy")
+    assert p99_on <= p99_off * 1.5 + 0.05, (
+        f"victim p99 regressed with the tenant shed on: "
+        f"{p99_on * 1e3:.1f}ms vs {p99_off * 1e3:.1f}ms off")
+
 
 def _bench_prove():
     """BENCH_MODE=prove — device proof SYNTHESIS throughput: seeded

@@ -27,7 +27,8 @@ from .tracing import Span, Tracer, TRACER
 from .pipeline import BatchRecord, PhaseTimer, PipelineRecorder, RECORDS
 from .export import spans_to_chrome_trace, write_chrome_trace
 from .report import bench_snapshot, write_bench_report
-from .slo import SloMonitor, SloPolicy
+from .slo import (SloMonitor, SloPolicy, TenantSloMonitor, TenantSloPolicy,
+                  jain_index)
 from .profiling import DeviceProfiler, PROFILER
 from .telemetry import TelemetryConfig, TelemetryServer, serve_telemetry
 from .journal import (EVENT_KINDS, JOURNAL, Journal,
@@ -45,7 +46,8 @@ __all__ = [
     "BatchRecord", "PhaseTimer", "PipelineRecorder", "RECORDS",
     "spans_to_chrome_trace", "write_chrome_trace",
     "bench_snapshot", "write_bench_report",
-    "SloMonitor", "SloPolicy",
+    "SloMonitor", "SloPolicy", "TenantSloMonitor", "TenantSloPolicy",
+    "jain_index",
     "DeviceProfiler", "PROFILER",
     "TelemetryConfig", "TelemetryServer", "serve_telemetry",
     "Journal", "JOURNAL", "EVENT_KINDS", "configure_journal_from_env",

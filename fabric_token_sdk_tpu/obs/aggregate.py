@@ -49,6 +49,9 @@ _FLEET_FAMILIES = {
         "Federation merge conflicts, by kind (help, type, label, parse).",
     "fleet_node_age_seconds":
         "Age of each node's spool exposition at the last collect.",
+    "fleet_tenants":
+        "Distinct tenant tms ids across the fleet's merged exposition "
+        "(every tms_id label value in the most recent collect).",
 }
 
 _HELP_LINE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
@@ -283,6 +286,13 @@ class FleetAggregator:
         _, pre = merge_expositions(docs)
         self.provider.gauge("fleet_nodes").set(float(len(docs)))
         self.provider.gauge("fleet_samples").set(float(pre.samples))
+        # fleet-wide tenant cardinality: how many distinct tms_id label
+        # values survive federation (children's slo_tenant_* /
+        # serve_tenant_* / rpc_tenant_* series, node labels and all)
+        tenants = {v for f in pre.families.values()
+                   for _, labels, _ in f["samples"]
+                   for k, v in labels if k == "tms_id"}
+        self.provider.gauge("fleet_tenants").set(float(len(tenants)))
         for kind, n in pre.conflicts.items():
             self.provider.counter("fleet_merge_conflicts_total",
                                   kind=kind).add(n)

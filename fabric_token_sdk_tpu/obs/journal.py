@@ -68,6 +68,13 @@ EVENT_CHILD_RESTART = "child_restart"
 #: One columnar SUBMIT_BATCH frame admitted as a single decision (the
 #: per-row counterpart is EVENT_REQUEST_ADMITTED).
 EVENT_BATCH_ADMITTED = "batch_admitted"
+#: A single tenant's error-budget burn tripped the fast-burn rule (the
+#: tenant-scoped counterpart of EVENT_SLO_BURN); attrs name the tms_id
+#: so an incident snapshot identifies the offending tenant directly.
+EVENT_TENANT_FAST_BURN = "tenant_fast_burn"
+#: New work from a fast-burning tenant was shed by the TenantShedPolicy
+#: (terminal status ``shed_tenant_slo``) while other tenants proceed.
+EVENT_TENANT_SHED = "tenant_shed"
 
 EVENT_KINDS = (
     EVENT_REQUEST_ADMITTED, EVENT_REQUEST_SHED, EVENT_BATCH_FORMED,
@@ -76,7 +83,7 @@ EVENT_KINDS = (
     EVENT_FALLBACK, EVENT_HEARTBEAT, EVENT_WATCHDOG_ABANDON,
     EVENT_INCIDENT, EVENT_REQUEST_SHUTDOWN, EVENT_WAL_RECOVERED,
     EVENT_WAL_REPLAY, EVENT_CHILD_FAILURE, EVENT_CHILD_RESTART,
-    EVENT_BATCH_ADMITTED,
+    EVENT_BATCH_ADMITTED, EVENT_TENANT_FAST_BURN, EVENT_TENANT_SHED,
 )
 
 _JOURNAL_FAMILIES = {

@@ -197,6 +197,32 @@ class MetricsProvider:
                 self._histograms[key] = Histogram()
             return self._histograms[key]
 
+    def remove_series(self, name: str | None = None, **labels) -> int:
+        """Delete registered series whose labels include every given
+        ``labels`` pair (and whose family is ``name``, when given).
+        Returns the number of series removed.
+
+        This is the eviction half of bounded-cardinality labelling: a
+        per-tenant gauge registered for a departed ``tms_id`` would
+        otherwise live in the registry (and every exposition) forever.
+        Family HELP text is kept — the family still exists, it just has
+        fewer series."""
+        want = tuple(sorted(labels.items()))
+
+        def _match(key: tuple) -> bool:
+            fam, lbls = key
+            if name is not None and fam != name:
+                return False
+            return all(pair in lbls for pair in want)
+
+        removed = 0
+        with self._lock:
+            for reg in (self._counters, self._gauges, self._histograms):
+                for key in [k for k in reg if _match(k)]:
+                    del reg[key]
+                    removed += 1
+        return removed
+
     def reset(self) -> None:
         """Drop every registered instrument. Shared-registry children see
         the reset too (they alias the same dicts). Test-fixture hook so

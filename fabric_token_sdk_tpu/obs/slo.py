@@ -14,6 +14,13 @@ Everything is clock-injectable and lock-protected: ``record`` is called
 from the serve dispatcher loop while gauges are scraped from the
 telemetry server's request threads.
 
+:class:`TenantSloMonitor` runs the same multi-window machinery per
+``tms_id`` under a bounded-cardinality tenant table (LRU eviction above
+``max_tenants``; an evicted tenant's metric series are removed from the
+registry so departed tenants cannot leak gauges forever), and adds
+Jain's fairness index across tenants so one gauge answers "is the front
+door fair right now".
+
 Exported families (stable names, see ROADMAP):
   slo_availability_ratio{window}    rolling success fraction
   slo_p99_seconds{window}           rolling p99 of successful latencies
@@ -21,16 +28,22 @@ Exported families (stable names, see ROADMAP):
   slo_window_requests{window}       sample count behind the two above
   slo_fast_burn_active              1 while the fast-burn condition holds
   slo_fast_burn_trips_total         edge-triggered trip count
+  slo_tenant_availability{tms_id}   short-window success fraction
+  slo_tenant_p99_seconds{tms_id}    short-window p99 of ok latencies
+  slo_tenant_burn_rate{tms_id,window}
+  slo_tenant_budget_remaining{tms_id}
+  slo_tenant_evictions_total        LRU evictions from the tenant table
+  slo_fairness_index{basis}         Jain's index (throughput | p99)
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 
-from .journal import EVENT_SLO_BURN, JOURNAL
+from .journal import (EVENT_SLO_BURN, EVENT_TENANT_FAST_BURN, JOURNAL)
 from .metrics import GLOBAL, MetricsProvider
 
 #: Bound on retained (timestamp, ok, latency) events. At the ROADMAP
@@ -55,6 +68,70 @@ _SLO_FAMILIES = {
     "slo_fast_burn_trips_total":
         "Edge-triggered count of fast-burn episodes.",
 }
+
+#: Per-tenant families. Every ``tms_id``-labelled series is bounded by
+#: the TenantSloMonitor's ``max_tenants`` LRU table (eviction removes
+#: the tenant's series from the registry), so the exposition cannot
+#: grow without bound under a million-client front door.
+_TENANT_SLO_FAMILIES = {
+    "slo_tenant_availability":
+        "Short-window success fraction per tenant tms id.",
+    "slo_tenant_p99_seconds":
+        "Short-window p99 latency of a tenant's successful requests.",
+    "slo_tenant_burn_rate":
+        "Per-tenant error-budget burn rate per window; 1.0 spends the "
+        "tenant's budget exactly on schedule.",
+    "slo_tenant_budget_remaining":
+        "Fraction of a tenant's cumulative error budget left "
+        "(1 untouched, 0 exhausted), clamped to [0, 1].",
+    "slo_tenant_evictions_total":
+        "Tenants LRU-evicted from the bounded per-tenant SLO table.",
+    "slo_fairness_index":
+        "Jain's fairness index across tenants (1.0 perfectly fair), "
+        "by basis: short-window served throughput or p99 latency.",
+}
+
+#: Per-tenant retained events: smaller than the global cap — the table
+#: holds up to ``max_tenants`` of these deques.
+_TENANT_EVENT_KEEP = 8192
+
+
+def _window_stats(events, now: float, window: float,
+                  availability_target: float) -> dict:
+    """Multi-window SLI arithmetic over ``(ts, ok, latency)`` events —
+    shared by the global monitor and the per-tenant monitor so both
+    compute burn exactly the same way. Caller holds its own lock."""
+    cutoff = now - window
+    n = ok_n = 0
+    lat: list[float] = []
+    for t, ok, latency in events:
+        if t < cutoff:
+            continue
+        n += 1
+        if ok:
+            ok_n += 1
+            if latency is not None:
+                lat.append(latency)
+    availability = ok_n / n if n else 1.0
+    budget = 1.0 - availability_target
+    burn = ((1.0 - availability) / budget) if budget > 0 else 0.0
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+    return {"window": f"{int(window)}s", "requests": n, "ok": ok_n,
+            "availability": availability, "burn": burn, "p99": p99}
+
+
+def jain_index(values) -> float:
+    """Jain's fairness index J = (Σx)² / (n·Σx²) over per-tenant
+    allocations: 1.0 is perfectly fair, 1/n is one tenant taking
+    everything (zeros count — a starved tenant lowers the index).
+    Empty or all-zero input reads 1.0 (nothing is being served
+    unfairly)."""
+    xs = [float(v) for v in values]
+    if not xs:
+        return 1.0
+    sq = sum(x * x for x in xs)
+    return (sum(xs) ** 2) / (len(xs) * sq) if sq > 0 else 1.0
 
 
 @dataclass(frozen=True)
@@ -119,24 +196,8 @@ class SloMonitor:
 
     def _window_stats(self, now: float, window: float) -> dict:
         """Caller holds the lock."""
-        cutoff = now - window
-        n = ok_n = 0
-        lat: list[float] = []
-        for t, ok, latency in self._events:
-            if t < cutoff:
-                continue
-            n += 1
-            if ok:
-                ok_n += 1
-                if latency is not None:
-                    lat.append(latency)
-        availability = ok_n / n if n else 1.0
-        budget = 1.0 - self.policy.availability_target
-        burn = ((1.0 - availability) / budget) if budget > 0 else 0.0
-        lat.sort()
-        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
-        return {"window": f"{int(window)}s", "requests": n,
-                "availability": availability, "burn": burn, "p99": p99}
+        return _window_stats(self._events, now, window,
+                             self.policy.availability_target)
 
     def _publish(self, stats: list[dict]) -> None:
         for st in stats:
@@ -201,4 +262,260 @@ class SloMonitor:
                 "burn_rate": round(st["burn"], 3),
                 "p99_s": round(st["p99"], 6),
             } for st in stats},
+        }
+
+
+@dataclass(frozen=True)
+class TenantSloPolicy(SloPolicy):
+    """Per-tenant SLO policy: the global targets/windows plus the
+    bounded-cardinality knobs.
+
+    max_tenants: LRU bound on the tenant table; recording a request for
+        a new tenant past the bound evicts the least-recently-active
+        tenant AND removes its ``slo_tenant_*`` series from the metrics
+        registry (counted in ``slo_tenant_evictions_total``).
+    eval_interval_s: minimum spacing between full evaluation passes
+        (window stats, gauge publishes, trip/recovery checks, fairness
+        indices). 0.0 evaluates on every record — exact, right for
+        tests and moderate rates; the front-door bench runs at 100k+
+        rows/s where a per-record O(tenants * window) pass would
+        dominate, so it sets a small positive cadence instead.
+    """
+    max_tenants: int = 256
+    eval_interval_s: float = 0.0
+
+
+class _TenantState:
+    """One tenant's rolling window + cumulative budget ledger."""
+
+    __slots__ = ("events", "ok_total", "total", "sheds", "trips",
+                 "fast_burn_active", "stats")
+
+    def __init__(self):
+        self.events: deque = deque(maxlen=_TENANT_EVENT_KEEP)
+        self.ok_total = 0
+        self.total = 0
+        self.sheds = 0
+        self.trips = 0
+        self.fast_burn_active = False
+        self.stats: list[dict] = []    # last eval's per-window stats
+
+
+class TenantSloMonitor:
+    """Per-``tms_id`` multi-window SLI tracker with LRU-bounded
+    cardinality, edge-triggered per-tenant fast-burn, and fleet
+    fairness indices.
+
+    ``record(tenant, ok, latency_s)`` is the write path (called from
+    the serve event loop for every terminal result). Evaluation —
+    window stats, gauge publishes, trip/recovery edges, Jain fairness
+    — runs as a full pass over the table at most every
+    ``eval_interval_s`` seconds, so an idle tenant's recovery is still
+    detected while any traffic flows.
+
+    Hooks fire edge-triggered with the tms_id: ``on_fast_burn(t)`` /
+    ``on_recover(t)`` on burn transitions, ``on_evict(t)`` when the
+    LRU table evicts (the serve layer uses it to drop that tenant's
+    ``serve_tenant_*`` series too). ``shedding(t)`` is the query the
+    TenantShedPolicy consults at admission.
+    """
+
+    def __init__(self, policy: TenantSloPolicy | None = None,
+                 provider: MetricsProvider | None = None,
+                 clock=time.monotonic, on_fast_burn=None, on_recover=None,
+                 on_evict=None):
+        self.policy = policy or TenantSloPolicy()
+        self.provider = provider or GLOBAL
+        self.clock = clock
+        self.on_fast_burn = on_fast_burn
+        self.on_recover = on_recover
+        self.on_evict = on_evict
+        self.evictions = 0
+        self._tenants: OrderedDict[str, _TenantState] = OrderedDict()
+        self._last_eval: float | None = None
+        self._lock = threading.Lock()
+        for fam, help_text in _TENANT_SLO_FAMILIES.items():
+            self.provider.describe(fam, help_text)
+
+    # ----------------------------------------------------------- updates
+    def record(self, tenant: str, ok: bool,
+               latency_s: float | None = None) -> None:
+        tenant = tenant or "default"
+        now = self.clock()
+        evicted: list[str] = []
+        with self._lock:
+            state = self._tenants.get(tenant)
+            if state is None:
+                state = self._tenants[tenant] = _TenantState()
+            else:
+                self._tenants.move_to_end(tenant)
+            state.events.append((now, bool(ok), latency_s))
+            state.total += 1
+            if ok:
+                state.ok_total += 1
+            while len(self._tenants) > self.policy.max_tenants:
+                gone, _ = self._tenants.popitem(last=False)
+                self.evictions += 1
+                evicted.append(gone)
+        for gone in evicted:
+            self.provider.counter("slo_tenant_evictions_total").add()
+            for fam in ("slo_tenant_availability", "slo_tenant_p99_seconds",
+                        "slo_tenant_burn_rate",
+                        "slo_tenant_budget_remaining"):
+                self.provider.remove_series(fam, tms_id=gone)
+            if self.on_evict is not None:
+                self.on_evict(gone)
+        self._maybe_eval(now)
+
+    def note_shed(self, tenant: str, rows: int = 1) -> None:
+        """Account a policy shed against the tenant WITHOUT recording a
+        window event: a ``shed_tenant_slo`` verdict is the policy
+        acting, not the service failing — feeding it back into the
+        tenant's own error window would make the shed self-sustaining
+        (the tenant could never recover while being shed)."""
+        with self._lock:
+            state = self._tenants.get(tenant or "default")
+            if state is not None:
+                state.sheds += rows
+
+    def _maybe_eval(self, now: float) -> None:
+        with self._lock:
+            due = (self._last_eval is None
+                   or now - self._last_eval >= self.policy.eval_interval_s)
+            if not due:
+                return
+            self._last_eval = now
+        self._eval(now)
+
+    def _eval(self, now: float) -> None:
+        """One full pass: stats + trip/recovery edges under the lock,
+        then gauge publishes, journal events, incidents, and hooks
+        outside it (an incident snapshot pulls status sources that may
+        re-enter ``summary()``)."""
+        pol = self.policy
+        horizon = now - max(pol.windows)
+        trips: list[tuple[str, list[dict]]] = []
+        recoveries: list[str] = []
+        published: list[tuple[str, list[dict]]] = []
+        throughput: list[float] = []
+        p99s: list[float] = []
+        with self._lock:
+            for tenant, state in self._tenants.items():
+                ev = state.events
+                while ev and ev[0][0] < horizon:
+                    ev.popleft()
+                stats = [_window_stats(ev, now, w, pol.availability_target)
+                         for w in pol.windows]
+                state.stats = stats
+                published.append((tenant, stats))
+                throughput.append(stats[0]["ok"])
+                if stats[0]["p99"] > 0:
+                    p99s.append(stats[0]["p99"])
+                volume_ok = all(st["requests"] >= pol.min_volume
+                                for st in stats)
+                burning = volume_ok and all(st["burn"] >= pol.fast_burn
+                                            for st in stats)
+                recovered = all(st["burn"] <= pol.recover_burn
+                                for st in stats)
+                if burning and not state.fast_burn_active:
+                    state.fast_burn_active = True
+                    state.trips += 1
+                    trips.append((tenant, stats))
+                elif state.fast_burn_active and recovered:
+                    state.fast_burn_active = False
+                    recoveries.append(tenant)
+        budget = 1.0 - pol.availability_target
+        for tenant, stats in published:
+            st0 = stats[0]
+            # tenant-bounded: series below are LRU-evicted above
+            # TenantSloPolicy.max_tenants (remove_series on eviction)
+            self.provider.gauge("slo_tenant_availability",
+                                tms_id=tenant).set(st0["availability"])
+            self.provider.gauge("slo_tenant_p99_seconds",
+                                tms_id=tenant).set(st0["p99"])
+            for st in stats:
+                self.provider.gauge("slo_tenant_burn_rate", tms_id=tenant,
+                                    window=st["window"]).set(st["burn"])
+            self.provider.gauge(
+                "slo_tenant_budget_remaining",
+                tms_id=tenant).set(self._budget_remaining(tenant, budget))
+        self.provider.gauge("slo_fairness_index", basis="throughput").set(
+            jain_index(throughput))
+        # fairness over LATENCY uses inverse p99 so "bigger = better
+        # served" on both bases: equal p99s read 1.0 either way, but a
+        # tenant starved into 10x the latency drags the index down
+        self.provider.gauge("slo_fairness_index", basis="p99").set(
+            jain_index([1.0 / p for p in p99s]))
+        for tenant, stats in trips:
+            JOURNAL.record(EVENT_TENANT_FAST_BURN, phase="trip",
+                           tms_id=tenant,
+                           burn=[round(st["burn"], 3) for st in stats])
+            JOURNAL.incident(
+                "tenant_fast_burn",
+                reason="tenant {} burn rate >= {:.1f} on all windows: "
+                       "{}".format(tenant, pol.fast_burn,
+                                   [round(st["burn"], 2) for st in stats]))
+            if self.on_fast_burn is not None:
+                self.on_fast_burn(tenant)
+        for tenant in recoveries:
+            JOURNAL.record(EVENT_TENANT_FAST_BURN, phase="recover",
+                           tms_id=tenant)
+            if self.on_recover is not None:
+                self.on_recover(tenant)
+
+    def _budget_remaining(self, tenant: str, budget: float) -> float:
+        state = self._tenants.get(tenant)
+        if state is None or state.total == 0 or budget <= 0:
+            return 1.0
+        spent = (1.0 - state.ok_total / state.total) / budget
+        return max(0.0, min(1.0, 1.0 - spent))
+
+    # ----------------------------------------------------------- reading
+    def shedding(self, tenant: str) -> bool:
+        """True while the tenant's fast-burn episode is active (the
+        TenantShedPolicy's admission query)."""
+        with self._lock:
+            state = self._tenants.get(tenant or "default")
+            return state.fast_burn_active if state is not None else False
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return list(self._tenants)
+
+    def summary(self) -> dict:
+        """Point-in-time per-tenant table for /tenantz, /statusz, and
+        incident snapshots. Read-only: no trips, no gauge writes."""
+        now = self.clock()
+        pol = self.policy
+        budget = 1.0 - pol.availability_target
+        tenants: dict[str, dict] = {}
+        with self._lock:
+            for tenant, state in self._tenants.items():
+                stats = [_window_stats(state.events, now, w,
+                                       pol.availability_target)
+                         for w in pol.windows]
+                tenants[tenant] = {
+                    "requests": state.total,
+                    "availability": round(stats[0]["availability"], 6),
+                    "p99_s": round(stats[0]["p99"], 6),
+                    "burn_rate": {st["window"]: round(st["burn"], 3)
+                                  for st in stats},
+                    "budget_remaining": round(
+                        self._budget_remaining(tenant, budget), 6),
+                    "sheds": state.sheds,
+                    "trips": state.trips,
+                    "fast_burn_active": state.fast_burn_active,
+                }
+            evictions = self.evictions
+        return {
+            "max_tenants": pol.max_tenants,
+            "tenants": tenants,
+            "evictions": evictions,
+            "fairness": {
+                "throughput": round(jain_index(
+                    [t["requests"] for t in tenants.values()]), 6),
+                "p99": round(jain_index(
+                    [1.0 / t["p99_s"] for t in tenants.values()
+                     if t["p99_s"] > 0]), 6),
+            },
         }

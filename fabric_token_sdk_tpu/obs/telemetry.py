@@ -14,6 +14,8 @@ services (auditor/logging) and the Prometheus exposition conventions:
              (serve frontend running, prewarm complete) -> 503
   /statusz   JSON snapshot from registered status sources (queue depths,
              prewarm, breaker, pipeline records, SLO, profiler)
+  /tenantz   JSON per-tenant SLO table (burn, budget, deficit, drains,
+             sheds, in-flight) from the serve frontend's TenantSloMonitor
   /tracez    Chrome-trace JSON of the tracer's completed span buffer
 
 Scrapes observe themselves: ``telemetry_scrapes_total{endpoint}`` is
@@ -47,8 +49,8 @@ _TELEMETRY_FAMILIES = {
         "Telemetry endpoint render latency.",
 }
 
-_ENDPOINTS = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez",
-              "/fleetz")
+_ENDPOINTS = ("/metrics", "/healthz", "/readyz", "/statusz", "/tenantz",
+              "/tracez", "/fleetz")
 
 
 @dataclass(frozen=True)
@@ -231,6 +233,17 @@ class TelemetryServer:
                     status[name] = {"error": repr(exc)}
             return (200, "application/json",
                     json.dumps(status, default=str).encode())
+        if path == "/tenantz":
+            src = self._status.get("tenants")
+            if src is None:
+                doc = {"enabled": False}
+            else:
+                try:
+                    doc = src()
+                except Exception as exc:
+                    doc = {"enabled": True, "error": repr(exc)}
+            return (200, "application/json",
+                    json.dumps(doc, default=str).encode())
         if path == "/fleetz":
             if self._federator is None:
                 doc: dict = {"enabled": False}
@@ -297,6 +310,11 @@ def serve_telemetry(service, config: TelemetryConfig | None = None,
     slo = getattr(service, "slo", None)
     if slo is not None:
         server.add_status_source("slo", slo.summary)
+    # the per-tenant table backs BOTH /tenantz and the "tenants" key of
+    # /statusz (and, via the copy below, incident snapshots)
+    if getattr(service, "tenant_slo", None) is not None \
+            and hasattr(service, "tenant_status"):
+        server.add_status_source("tenants", service.tenant_status)
     if supervisor is not None and hasattr(supervisor, "status"):
         server.add_status_source("supervisor", supervisor.status)
     wal = getattr(service, "wal", None)

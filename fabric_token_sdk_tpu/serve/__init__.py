@@ -9,7 +9,7 @@ bucket shape at startup, and the dispatcher demultiplexes per-request
 verdicts bit-identically to the unbatched path. See README "Serving".
 """
 
-from .admission import AdmissionController
+from .admission import AdmissionController, TenantShedPolicy
 from .columnar import (FMT_OPAQUE, FMT_RANGE, ColumnarBatch, ColumnarError,
                        decode_submit_batch, encode_submit_batch,
                        materialize_rows)
@@ -19,7 +19,8 @@ from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       SERVED_BY_DEVICE, SERVED_BY_HOST,
                       STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
                       STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
-                      STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
+                      STATUS_SHED_TENANT_SLO, STATUS_SHUTDOWN,
+                      VerifyRequest, VerifyResult)
 from .rpc import FrameError, RpcConfig, RpcServer
 from .rpc_client import BatchSubmitBuffer, RpcClient
 from .scheduler import GROUPS, BucketScheduler
@@ -58,8 +59,10 @@ __all__ = [
     "STATUS_OK",
     "STATUS_SHED_DEADLINE",
     "STATUS_SHED_QUEUE_FULL",
+    "STATUS_SHED_TENANT_SLO",
     "STATUS_SHUTDOWN",
     "StubZK",
+    "TenantShedPolicy",
     "VerificationService",
     "VerifyRequest",
     "VerifyResult",

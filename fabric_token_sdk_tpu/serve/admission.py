@@ -8,7 +8,11 @@ gauges, never as unbounded memory growth or hung callers:
     (``serve_shed_total{reason="queue_full"}``);
   - a request whose remaining deadline is already below the service
     estimate is shed on arrival (``reason="deadline"``) rather than
-    queued to miss deterministically.
+    queued to miss deterministically;
+  - a request from a tenant whose per-tenant fast-burn has tripped is
+    shed with the distinct ``shed_tenant_slo`` status
+    (``reason="tenant_slo"``) while every other tenant is untouched —
+    the SLO-aware isolation arm of the noisy-neighbor story.
 
 Admission never blocks: the verdict is immediate and the caller's future
 resolves with a terminal status.
@@ -16,19 +20,69 @@ resolves with a terminal status.
 
 from __future__ import annotations
 
+import os
 import time
 
 from ..obs import GLOBAL as _METRICS
+from ..obs.journal import EVENT_TENANT_SHED, JOURNAL
 from .config import ServeConfig
 from .request import (STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
-                      VerifyRequest)
+                      STATUS_SHED_TENANT_SLO, VerifyRequest)
+
+
+class TenantShedPolicy:
+    """SLO-aware per-tenant shed: isolate a tenant in fast-burn.
+
+    Consults a ``TenantSloMonitor``'s edge-triggered fast-burn state at
+    admission time: while a tenant's burn rate is >= ``fast_burn`` on
+    all windows (min-volume gated, same rule as the global monitor),
+    NEW work from that tenant sheds with ``shed_tenant_slo``; it
+    un-sheds automatically when the tenant's windows recover. Decisions
+    are journaled (``tenant_shed`` events) and counted
+    (``serve_tenant_sheds_total{tms_id}``).
+
+    Sheds are reported back to the monitor via ``note_shed`` — NOT as
+    window errors — so the policy cannot sustain the very burn that
+    tripped it. ``FTS_NO_TENANT_SHED=1`` (read once, at construction)
+    disables the policy: the monitor still observes and trips, but
+    admission ignores it — the bench's control arm.
+    """
+
+    def __init__(self, monitor, enabled: bool | None = None):
+        self.monitor = monitor
+        if enabled is None:
+            enabled = os.environ.get("FTS_NO_TENANT_SHED", "") != "1"
+        self.enabled = enabled
+
+    def should_shed(self, tenant: str) -> bool:
+        return (self.enabled and self.monitor is not None
+                and self.monitor.shedding(tenant))
+
+    def shed(self, tenant: str, lane: str, rows: int = 1) -> str:
+        """Account one shed decision; returns the terminal status."""
+        # tenant-bounded: serve_tenant_sheds_total rides the
+        # TenantSloMonitor LRU table — its series are removed by the
+        # service's on_evict hook above TenantSloPolicy.max_tenants
+        _METRICS.counter(
+            "serve_tenant_sheds_total",
+            help="Rows shed by the per-tenant SLO policy, by tms id",
+            tms_id=tenant).add(rows)
+        _METRICS.counter("serve_shed_total", reason="tenant_slo",
+                         lane=lane).add(rows)
+        if self.monitor is not None:
+            self.monitor.note_shed(tenant, rows)
+        JOURNAL.record(EVENT_TENANT_SHED, tms_id=tenant, lane=lane,
+                       rows=rows)
+        return STATUS_SHED_TENANT_SLO
 
 
 class AdmissionController:
-    """Stateless policy over the scheduler's queue depths."""
+    """Stateless policy over the scheduler's queue depths (plus the
+    optional stateful per-tenant SLO shed)."""
 
-    def __init__(self, config: ServeConfig):
+    def __init__(self, config: ServeConfig, tenant_shed=None):
         self.config = config
+        self.tenant_shed = tenant_shed
 
     def admit(self, req: VerifyRequest, lane_depth: int) -> str | None:
         """None admits; otherwise the terminal shed status.
@@ -36,6 +90,9 @@ class AdmissionController:
         ``lane_depth`` is the current depth of the request's lane queue.
         """
         now = time.perf_counter()
+        if (self.tenant_shed is not None
+                and self.tenant_shed.should_shed(req.tenant)):
+            return self.tenant_shed.shed(req.tenant, req.lane)
         if lane_depth >= self.config.queue_capacity:
             _METRICS.counter(
                 "serve_shed_total",
@@ -53,7 +110,8 @@ class AdmissionController:
         return None
 
     def admit_batch(self, kind: str, lane: str, rows: int,
-                    lane_depth: int, deadline: float) -> str | None:
+                    lane_depth: int, deadline: float,
+                    tenant: str = "default") -> str | None:
         """ONE admission decision for a whole columnar frame.
 
         The frame admits or sheds atomically — queue_full when the lane
@@ -61,8 +119,13 @@ class AdmissionController:
         one-WAL-append-per-frame durability contract), deadline when
         even the frame's latest row cannot be served in time. Counters
         advance by ``rows`` so shed/request rates stay row-denominated.
+        A frame is single-tenant, so the per-tenant SLO shed also
+        applies whole-frame.
         """
         now = time.perf_counter()
+        if (self.tenant_shed is not None
+                and self.tenant_shed.should_shed(tenant)):
+            return self.tenant_shed.shed(tenant, lane, rows)
         if lane_depth + rows > self.config.queue_capacity:
             _METRICS.counter(
                 "serve_shed_total",

@@ -71,6 +71,13 @@ class ServeConfig:
     tenant_weights: ((tms_id, weight), ...) pairs scaling the quantum
         per tenant; unlisted tenants weigh 1.0. Tuple-of-pairs keeps
         the dataclass frozen/hashable.
+    max_tenants: bound on per-tenant metric cardinality in the serve
+        layer: the scheduler remembers at most this many departed
+        tenants' ``rpc_tenant_deficit`` / ``serve_tenant_drains_total``
+        series before LRU-evicting the oldest from the registry. The
+        TenantSloMonitor has its own equally-named bound
+        (TenantSloPolicy.max_tenants); deployments should keep them
+        equal so the two tables evict in step.
     """
 
     buckets: tuple = tuple(b for b in B_BUCKETS if b <= 1024)
@@ -85,6 +92,7 @@ class ServeConfig:
     n_lanes: int = 1
     tenant_quantum: int = 8
     tenant_weights: tuple = ()
+    max_tenants: int = 256
 
     def __post_init__(self):
         if not self.buckets:
@@ -97,6 +105,8 @@ class ServeConfig:
             raise ValueError("ServeConfig.n_lanes must be >= 1")
         if self.tenant_quantum < 1:
             raise ValueError("ServeConfig.tenant_quantum must be >= 1")
+        if self.max_tenants < 1:
+            raise ValueError("ServeConfig.max_tenants must be >= 1")
         for pair in self.tenant_weights:
             tms_id, weight = pair
             if not isinstance(tms_id, str) or weight <= 0:

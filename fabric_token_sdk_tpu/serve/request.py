@@ -25,6 +25,9 @@ STATUS_OK = "ok"
 STATUS_SHED_QUEUE_FULL = "shed_queue_full"
 #: Admission refused: remaining deadline below the service estimate.
 STATUS_SHED_DEADLINE = "shed_deadline"
+#: Admission refused: the request's tenant is in an active fast-burn
+#: episode and the per-tenant SLO shed policy is isolating it.
+STATUS_SHED_TENANT_SLO = "shed_tenant_slo"
 #: Deadline expired while queued (never dispatched) or during service;
 #: ``accepted`` carries the verdict when service did complete.
 STATUS_DEADLINE_MISS = "deadline_miss"

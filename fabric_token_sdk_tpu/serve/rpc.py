@@ -708,7 +708,8 @@ class RpcServer:
             self.provider.counter("rpc_requests_total", tms=tms_id,
                                   kind=kind, lane=lane).add()
             try:
-                await self._verify_into(reply, kind, lane, deadline_s, body)
+                await self._verify_into(reply, kind, lane, deadline_s, body,
+                                        tenant=tms_id)
             except Exception as exc:  # service-level failure -> typed error
                 reply["status"] = RPC_ERROR
                 reply["error"] = str(exc)
@@ -720,13 +721,15 @@ class RpcServer:
         await self._replenish(conn)
 
     async def _verify_into(self, reply: dict, kind: str, lane: str,
-                           deadline_s: float | None, body: dict) -> None:
+                           deadline_s: float | None, body: dict,
+                           tenant: str = "default") -> None:
         svc = self.service
         with self.tracer.span("rpc.serve", kind=kind, lane=lane):
             if kind == "range":
                 proofs, coms = body["payload"]
                 results = await asyncio.gather(*[
-                    svc.submit_range(p, c, deadline_s=deadline_s, lane=lane)
+                    svc.submit_range(p, c, deadline_s=deadline_s, lane=lane,
+                                     tenant=tenant)
                     for p, c in zip(proofs, coms)])
                 reply["statuses"] = [r.status for r in results]
                 reply["verdicts"] = [r.accepted for r in results]
@@ -737,11 +740,12 @@ class RpcServer:
                 t_res, i_res = await asyncio.gather(
                     asyncio.gather(*[
                         svc.submit_transfer(pr, ins, outs,
-                                            deadline_s=deadline_s, lane=lane)
+                                            deadline_s=deadline_s, lane=lane,
+                                            tenant=tenant)
                         for pr, ins, outs in transfers]),
                     asyncio.gather(*[
                         svc.submit_issue(pr, outs, deadline_s=deadline_s,
-                                         lane=lane)
+                                         lane=lane, tenant=tenant)
                         for pr, outs in issues]))
                 reply["statuses"] = ([r.status for r in t_res],
                                      [r.status for r in i_res])

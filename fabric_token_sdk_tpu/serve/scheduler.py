@@ -38,7 +38,7 @@ never sees the scheduler).
 from __future__ import annotations
 
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 from ..obs import GLOBAL as _METRICS
 from .config import ServeConfig
@@ -70,10 +70,12 @@ class _TenantDrrQueue:
     def __init__(self, config: ServeConfig):
         self._quantum = float(config.tenant_quantum)
         self._weights = dict(config.tenant_weights)
+        self._max_tenants = config.max_tenants
         self._qs: dict[str, deque] = {}
         self._ring: deque = deque()          # tenant rotation order
         self._deficit: dict[str, float] = {}
         self._granted: set = set()           # granted this front residence
+        self._seen: OrderedDict[str, None] = OrderedDict()  # drain LRU
         self._len = 0
 
     # --------------------------------------------------- deque duck-type
@@ -120,6 +122,23 @@ class _TenantDrrQueue:
         self._qs.pop(tenant, None)
         self._deficit.pop(tenant, None)
         self._granted.discard(tenant)
+        # a retired tenant has nothing left to drain: its deficit gauge
+        # would otherwise read a stale residue forever (the cardinality
+        # leak this fixes) — drop the series; it re-registers on the
+        # tenant's next drain
+        _METRICS.remove_series("rpc_tenant_deficit", tms_id=tenant)
+
+    def _note_drain(self, tenant: str) -> None:
+        """LRU ledger of tenants with live ``serve_tenant_drains_total``
+        series, bounded by ``ServeConfig.max_tenants``: past the bound
+        the least-recently-drained tenant's series is evicted from the
+        registry (a Prometheus counter reset if it ever returns)."""
+        self._seen[tenant] = None
+        self._seen.move_to_end(tenant)
+        while len(self._seen) > self._max_tenants:
+            gone, _ = self._seen.popitem(last=False)
+            _METRICS.remove_series("serve_tenant_drains_total", tms_id=gone)
+            _METRICS.remove_series("rpc_tenant_deficit", tms_id=gone)
 
     def popleft(self):
         if self._len == 0:
@@ -137,16 +156,21 @@ class _TenantDrrQueue:
                 if not q:
                     self._retire(tenant)
                 else:
+                    # tenant-bounded: removed on _retire and LRU-evicted
+                    # past ServeConfig.max_tenants in _note_drain
                     _METRICS.gauge(
                         "rpc_tenant_deficit",
                         help="Deficit-round-robin rows a tenant may still "
                              "drain before rotating",
                         tms_id=tenant).set(self._deficit[tenant])
+                # tenant-bounded: LRU-evicted past ServeConfig.max_tenants
+                # in _note_drain
                 _METRICS.counter(
                     "serve_tenant_drains_total",
                     help="Rows drained from the admission queues, by "
                          "tenant tms id (the DRR fairness ledger)",
                     tms_id=tenant).add()
+                self._note_drain(tenant)
                 return req
             if tenant in self._granted:
                 # quantum exhausted this residence: rotate, keep residue
@@ -196,6 +220,17 @@ class BucketScheduler:
 
     def depth(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+    def tenant_status(self) -> dict:
+        """Per-tenant queue view for /tenantz: rows currently queued and
+        DRR deficit residue, summed over every (group, lane) queue."""
+        out: dict[str, dict] = {}
+        for q in self._queues.values():
+            for tenant, sub in q._qs.items():
+                row = out.setdefault(tenant, {"queued": 0, "deficit": 0.0})
+                row["queued"] += len(sub)
+                row["deficit"] += q._deficit.get(tenant, 0.0)
+        return out
 
     def _gauge(self, lane: str) -> None:
         _METRICS.gauge(

@@ -70,13 +70,14 @@ from ..obs.journal import (EVENT_BATCH_ADMITTED, EVENT_BATCH_FORMED,
 from ..obs.profiling import PROFILER
 from ..resilience import DispatchWatchdog, HostFallbackVerifier, \
     ResilienceConfig
-from .admission import AdmissionController
+from .admission import AdmissionController, TenantShedPolicy
 from .config import LANE_BULK, ServeConfig
 from .prewarm import PrewarmManager
 from .request import (KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       SERVED_BY_DEVICE, SERVED_BY_HOST,
                       STATUS_DEADLINE_MISS, STATUS_ERROR, STATUS_OK,
-                      STATUS_SHUTDOWN, VerifyRequest, VerifyResult)
+                      STATUS_SHED_TENANT_SLO, STATUS_SHUTDOWN,
+                      VerifyRequest, VerifyResult)
 from .scheduler import BucketScheduler
 from .wal import RECORD_ADMIT_BATCH
 
@@ -93,6 +94,19 @@ _SERVE_FAMILIES = {
     "serve_results_total": "Completed requests by terminal status",
     "resil_fallback_batches_total":
         "Batches served by the host fallback path, by group",
+}
+
+#: Per-tenant serve families (the ``tms_id``-labelled latency pipeline).
+#: Only recorded while a :class:`TenantSloMonitor` is attached — its
+#: ``max_tenants`` LRU table is the cardinality bound, and its eviction
+#: hook removes these series alongside the ``slo_tenant_*`` gauges.
+_TENANT_SERVE_FAMILIES = {
+    "serve_tenant_queue_seconds":
+        "Enqueue -> dispatch wait per request, by tenant tms id",
+    "serve_tenant_e2e_seconds":
+        "Enqueue -> terminal verdict wall per request, by tenant tms id",
+    "serve_tenant_sheds_total":
+        "Rows shed by the per-tenant SLO policy, by tms id",
 }
 
 #: Per-device dispatch-lane families (ServeConfig.n_lanes > 1 feeds all
@@ -153,7 +167,7 @@ class VerificationService:
     def __init__(self, zk, config: ServeConfig | None = None,
                  resilience: ResilienceConfig | None = None,
                  fallback=None, slo=None, wal=None,
-                 lane_verifiers: list | None = None):
+                 lane_verifiers: list | None = None, tenant_slo=None):
         self.zk = zk
         self.wal = wal
         #: (wal_id, VerifyResult) pairs replayed at the last ``start()``.
@@ -165,10 +179,20 @@ class VerificationService:
         self.config = config or ServeConfig()
         self.resilience = resilience
         self.slo = slo
+        # per-tenant SLO plane: a TenantSloMonitor attaches the tenant-
+        # labelled latency pipeline AND arms the SLO-aware shed policy
+        # (FTS_NO_TENANT_SHED=1 keeps the monitor observing but disables
+        # the shed — the bench's control arm)
+        self.tenant_slo = tenant_slo
+        if tenant_slo is not None and tenant_slo.on_evict is None:
+            tenant_slo.on_evict = self._evict_tenant_series
         self.scheduler = BucketScheduler(self.config)
-        self.admission = AdmissionController(self.config)
-        for fam, help_text in {**_SERVE_FAMILIES,
-                               **_LANE_FAMILIES}.items():
+        self.admission = AdmissionController(
+            self.config,
+            tenant_shed=(TenantShedPolicy(tenant_slo)
+                         if tenant_slo is not None else None))
+        for fam, help_text in {**_SERVE_FAMILIES, **_LANE_FAMILIES,
+                               **_TENANT_SERVE_FAMILIES}.items():
             _METRICS.describe(fam, help_text)
         # device dispatch lanes: lane i serves lane_verifiers[i] (a
         # per-device / per-mesh-shard verifier) or the shared zk when the
@@ -356,27 +380,58 @@ class VerificationService:
             q.clear()
         return out
 
+    def _record_shed_slo(self, tenant: str, status: str,
+                         rows: int) -> None:
+        """SLO accounting for an admission shed. Capacity/deadline sheds
+        are genuine failures and feed both the global and the tenant
+        windows. A ``shed_tenant_slo`` verdict is the tenant policy
+        ACTING, not the service failing: feeding it back into either
+        window would sustain the burn that tripped it (the tenant could
+        never recover) — the TenantShedPolicy already accounted it via
+        ``note_shed``."""
+        if status == STATUS_SHED_TENANT_SLO:
+            return
+        for _ in range(rows):
+            if self.slo is not None:
+                self.slo.record(False)
+            if self.tenant_slo is not None:
+                self.tenant_slo.record(tenant, False)
+
+    def _evict_tenant_series(self, tenant: str) -> None:
+        """TenantSloMonitor eviction hook: when the bounded tenant table
+        drops a tms_id, its serve-layer series go with it — the other
+        half of the per-tenant cardinality bound. The scheduler's DRR
+        ledger series are included so a departed tenant disappears from
+        the exposition in one step (they re-register, as a counter
+        reset, if the tenant returns)."""
+        for fam in (*_TENANT_SERVE_FAMILIES, "serve_tenant_drains_total",
+                    "rpc_tenant_deficit"):
+            _METRICS.remove_series(fam, tms_id=tenant)
+
     # ------------------------------------------------------------- submit
     async def submit_range(self, proof, commitment, *, deadline_s=None,
-                           lane: str = LANE_BULK) -> VerifyResult:
+                           lane: str = LANE_BULK,
+                           tenant: str = "default") -> VerifyResult:
         """Verify one range proof against its commitment."""
         return await self._submit(KIND_RANGE, (proof, commitment),
-                                  deadline_s, lane)
+                                  deadline_s, lane, tenant)
 
     async def submit_transfer(self, proof_raw, inputs, outputs, *,
-                              deadline_s=None,
-                              lane: str = LANE_BULK) -> VerifyResult:
+                              deadline_s=None, lane: str = LANE_BULK,
+                              tenant: str = "default") -> VerifyResult:
         """Verify one transfer action (serialized proof + token vectors)."""
         return await self._submit(KIND_TRANSFER, (proof_raw, inputs, outputs),
-                                  deadline_s, lane)
+                                  deadline_s, lane, tenant)
 
     async def submit_issue(self, proof_raw, outputs, *, deadline_s=None,
-                           lane: str = LANE_BULK) -> VerifyResult:
+                           lane: str = LANE_BULK,
+                           tenant: str = "default") -> VerifyResult:
         """Verify one issue action (serialized proof + output tokens)."""
         return await self._submit(KIND_ISSUE, (proof_raw, outputs),
-                                  deadline_s, lane)
+                                  deadline_s, lane, tenant)
 
-    async def _submit(self, kind, payload, deadline_s, lane) -> VerifyResult:
+    async def _submit(self, kind, payload, deadline_s, lane,
+                      tenant: str = "default") -> VerifyResult:
         if not self._running:
             raise RuntimeError("VerificationService is not started")
         now = time.perf_counter()
@@ -384,19 +439,19 @@ class VerificationService:
                       if deadline_s is None else deadline_s)
         req = VerifyRequest(kind=kind, payload=payload, lane=lane,
                             deadline=now + deadline_s, enqueue_t=now,
-                            future=asyncio.get_running_loop().create_future())
+                            future=asyncio.get_running_loop().create_future(),
+                            tenant=tenant)
         if self.config.trace_every \
                 and req.req_id % self.config.trace_every == 0:
             req.span = _TRACER.start_span(
                 "serve.request", kind=kind, lane=lane, req_id=req.req_id,
-                deadline_s=round(deadline_s, 6))
+                deadline_s=round(deadline_s, 6), tenant=tenant)
         shed = self.admission.admit(req, self.scheduler.lane_depth(lane))
         if shed is not None:
             result = VerifyResult(status=shed)
             JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
-                           req_id=req.req_id, status=shed)
-            if self.slo is not None:
-                self.slo.record(False)
+                           req_id=req.req_id, status=shed, tenant=tenant)
+            self._record_shed_slo(tenant, shed, rows=1)
             self._finish_request_span(req, result)
             return result
         JOURNAL.record(EVENT_REQUEST_ADMITTED, req_kind=kind, lane=lane,
@@ -450,13 +505,11 @@ class VerificationService:
         # served in time, the whole frame is a deterministic miss
         shed = self.admission.admit_batch(
             kind, lane, n, self.scheduler.lane_depth(lane),
-            now + max(row_deadline_s))
+            now + max(row_deadline_s), tenant=tenant)
         if shed is not None:
             JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
                            rows=n, tenant=tenant, status=shed)
-            if self.slo is not None:
-                for _ in range(n):
-                    self.slo.record(False)
+            self._record_shed_slo(tenant, shed, rows=n)
             return [VerifyResult(status=shed) for _ in range(n)]
         JOURNAL.record(EVENT_BATCH_ADMITTED, req_kind=kind, lane=lane,
                        rows=n, tenant=tenant,
@@ -729,10 +782,17 @@ class VerificationService:
             _METRICS.histogram(
                 "serve_wait_seconds",
                 lane=req.lane).observe(dispatch_t - req.enqueue_t)
+            if self.tenant_slo is not None:
+                # tenant-bounded: only recorded while a TenantSloMonitor
+                # is attached; its max_tenants LRU eviction removes these
+                # series via _evict_tenant_series
+                _METRICS.histogram(
+                    "serve_tenant_queue_seconds",
+                    tms_id=req.tenant).observe(dispatch_t - req.enqueue_t)
             if req.span is not None:
                 _TRACER.record_span("serve.queue_wait", req.enqueue_t,
                                     dispatch_t, parent=req.span,
-                                    lane=req.lane)
+                                    lane=req.lane, tenant=req.tenant)
             self._resolve(req, VerifyResult(
                 status=status, accepted=bool(acc),
                 wait_s=dispatch_t - req.enqueue_t,
@@ -776,9 +836,17 @@ class VerificationService:
             JOURNAL.record(EVENT_REQUEST_SHUTDOWN, req_kind=req.kind,
                            lane=req.lane, req_id=req.req_id,
                            error=result.error)
+        ok = result.status == STATUS_OK
         if self.slo is not None:
-            ok = result.status == STATUS_OK
             self.slo.record(ok, result.total_s if ok else None)
+        if self.tenant_slo is not None:
+            self.tenant_slo.record(req.tenant, ok,
+                                   result.total_s if ok else None)
+            # tenant-bounded: recorded only with a TenantSloMonitor
+            # attached; evicted via _evict_tenant_series
+            _METRICS.histogram(
+                "serve_tenant_e2e_seconds",
+                tms_id=req.tenant).observe(result.total_s)
         if self.wal is not None and req.wal_id is not None:
             open_rows = self._wal_batch_open.get(req.wal_id)
             if open_rows is None:
@@ -834,4 +902,29 @@ class VerificationService:
             }
         if self.slo is not None:
             out["slo"] = self.slo.summary()
+        if self.tenant_slo is not None:
+            out["tenants"] = self.tenant_status()
+        return out
+
+    def tenant_status(self) -> dict:
+        """Per-tenant operator table for /tenantz: the TenantSloMonitor
+        summary (burn, budget, sheds, trips) joined with the scheduler's
+        live queue view (queued rows, DRR deficit) and in-flight rows.
+        ``{"enabled": False}`` without a monitor."""
+        if self.tenant_slo is None:
+            return {"enabled": False}
+        out = self.tenant_slo.summary()
+        out["enabled"] = True
+        out["shed_policy_enabled"] = (
+            self.admission.tenant_shed is not None
+            and self.admission.tenant_shed.enabled)
+        queued = self.scheduler.tenant_status()
+        inflight: dict[str, int] = {}
+        for req in self._inflight:
+            inflight[req.tenant] = inflight.get(req.tenant, 0) + 1
+        for tenant, row in out["tenants"].items():
+            sched = queued.get(tenant, {})
+            row["queued"] = sched.get("queued", 0)
+            row["deficit"] = round(sched.get("deficit", 0.0), 3)
+            row["inflight"] = inflight.get(tenant, 0)
         return out

@@ -144,6 +144,36 @@ def test_three_node_spool_federation(tmp_path):
     assert doc["last_collect"]["conflicts"] == {}
 
 
+def test_tenant_slo_families_federate_with_fleet_tenant_count(tmp_path):
+    """Per-tenant SLO families cross the federation untouched (only the
+    ``node`` dimension is added), and the aggregator publishes
+    ``fleet_tenants`` — distinct tms_ids across the merged document."""
+    spool = tmp_path / "spool"
+    for n, tenants in (("n0", ("alice", "bob")), ("n1", ("bob", "carol"))):
+        p = MetricsProvider()
+        p.describe("slo_tenant_burn_rate",
+                   "Per-tenant error budget burn rate.")
+        p.describe("slo_fairness_index", "Jain fairness index.")
+        for t in tenants:
+            p.gauge("slo_tenant_burn_rate", tms_id=t, window="60s").set(1.0)
+        p.gauge("slo_fairness_index", basis="throughput").set(1.0)
+        SpoolPublisher(spool, n, provider=p).publish()
+
+    parent = MetricsProvider()
+    agg = FleetAggregator(spool, provider=parent)
+    text = agg.collect()
+    types = validate_prometheus(text)
+
+    assert types["slo_tenant_burn_rate"] == "gauge"
+    assert types["slo_fairness_index"] == "gauge"
+    # family names unchanged; node label joined onto the tenant series
+    assert ('slo_tenant_burn_rate{tms_id="alice",window="60s",node="n0"} '
+            '1.0') in text
+    # alice, bob, carol — bob counted once despite living on both nodes
+    assert "fleet_tenants 3.0" in text
+    assert types["fleet_tenants"] == "gauge"
+
+
 def test_federated_metrics_and_fleetz_over_http(tmp_path):
     from fabric_token_sdk_tpu.obs import TelemetryConfig, TelemetryServer
 

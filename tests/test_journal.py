@@ -48,6 +48,43 @@ def test_event_kind_inventory_is_unique():
     assert len(set(EVENT_KINDS)) == len(EVENT_KINDS)
 
 
+def test_tenant_event_kinds_registered_and_recorded():
+    """The per-tenant SLO plane's journal vocabulary is part of the
+    EVENT_KINDS inventory, and a tripped tenant leaves a named trail:
+    who burned, who was shed, and the recovery edge."""
+    from fabric_token_sdk_tpu.obs.journal import (EVENT_TENANT_FAST_BURN,
+                                                  EVENT_TENANT_SHED)
+
+    assert EVENT_TENANT_FAST_BURN in EVENT_KINDS
+    assert EVENT_TENANT_SHED in EVENT_KINDS
+
+    from fabric_token_sdk_tpu.obs import TenantSloMonitor, TenantSloPolicy
+    from fabric_token_sdk_tpu.obs.metrics import MetricsProvider
+
+    clk = {"t": 1000.0}
+    monitor = TenantSloMonitor(
+        policy=TenantSloPolicy(min_volume=4),
+        provider=MetricsProvider(), clock=lambda: clk["t"])
+    before = len(JOURNAL.tail())
+    for _ in range(8):
+        monitor.record("hot", False)
+        clk["t"] += 0.01
+    clk["t"] += 400.0                       # age the failures out
+    monitor.record("hot", True, 0.01)       # recovery edge
+    events = [e for e in JOURNAL.tail()[before:]
+              if e["kind"] == EVENT_TENANT_FAST_BURN]
+    phases = [(e["phase"], e.get("tms_id")) for e in events]
+    assert ("trip", "hot") in phases and ("recover", "hot") in phases
+
+    # a shed decision is journaled with the offending tenant named
+    from fabric_token_sdk_tpu.serve import TenantShedPolicy
+
+    TenantShedPolicy(monitor, enabled=True).shed("hot", "bulk", rows=3)
+    last = [e for e in JOURNAL.tail()
+            if e["kind"] == EVENT_TENANT_SHED][-1]
+    assert last["tms_id"] == "hot" and last["rows"] == 3
+
+
 def test_spill_writes_parseable_jsonl(tmp_path):
     j = Journal(capacity=8)
     j.configure(tmp_path)

@@ -68,6 +68,10 @@ STABLE_FAMILIES = (
     "serve_shed_total",
     "serve_tenant_drains_total",
     "serve_wait_seconds",
+    # serve/ per-tenant SLO plane (tenant-labelled latency + shedding)
+    "serve_tenant_e2e_seconds",
+    "serve_tenant_queue_seconds",
+    "serve_tenant_sheds_total",
     # serve/ per-device dispatch lanes (multi-chip continuous batching)
     "lane_busy_seconds",
     "lane_dispatch_total",
@@ -132,6 +136,13 @@ STABLE_FAMILIES = (
     "slo_fast_burn_trips_total",
     "slo_p99_seconds",
     "slo_window_requests",
+    # obs/ per-tenant SLO monitor + fleet fairness
+    "slo_fairness_index",
+    "slo_tenant_availability",
+    "slo_tenant_budget_remaining",
+    "slo_tenant_burn_rate",
+    "slo_tenant_evictions_total",
+    "slo_tenant_p99_seconds",
     # obs/ device profiling
     "profile_bucket_bytes",
     "profile_bucket_flops",
@@ -152,6 +163,7 @@ STABLE_FAMILIES = (
     "fleet_node_age_seconds",
     "fleet_nodes",
     "fleet_samples",
+    "fleet_tenants",
     # prover/ device proof synthesis + harness corpus
     "prover_chunks_total",
     "prover_corpus_proofs_total",
@@ -194,6 +206,40 @@ def test_dynamic_metric_families_still_constructed():
 
 def test_no_duplicate_family_entries():
     assert len(set(STABLE_FAMILIES)) == len(STABLE_FAMILIES)
+
+
+def test_tenant_labelled_registrations_carry_bounded_tag():
+    """Every instrument registration labelled by ``tms_id`` is an
+    unbounded-cardinality hazard: one series per client id, forever,
+    unless something evicts it. The convention is a ``# tenant-bounded:``
+    comment at the registration site naming the eviction path (LRU
+    bound + remove_series). This guard fails on any new ``tms_id=``
+    registration without the tag — add the eviction wiring AND the
+    comment, not just the metric."""
+    import ast
+
+    instruments = {"counter", "gauge", "histogram"}
+    offenders = []
+    files = [_PKG / "bench.py"]
+    files += sorted((_PKG / "fabric_token_sdk_tpu").rglob("*.py"))
+    for path in files:
+        src = path.read_text()
+        lines = src.splitlines()
+        for node in ast.walk(ast.parse(src)):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in instruments):
+                continue
+            if not any(kw.arg == "tms_id" for kw in node.keywords):
+                continue
+            # the tag must sit on (or within ten lines above) the call
+            window = "\n".join(lines[max(0, node.lineno - 11):node.lineno])
+            if "# tenant-bounded:" not in window:
+                offenders.append(
+                    f"{path.relative_to(_PKG)}:{node.lineno}")
+    assert not offenders, (
+        "tms_id-labelled metric registrations without a '# tenant-"
+        f"bounded:' eviction note: {offenders}")
 
 
 @pytest.mark.parametrize("prefix", ["ttx_", "tcc_", "zk_", "sigma_",

@@ -274,6 +274,74 @@ def test_live_telemetry_slo_profile_families_export():
         r'telemetry_scrapes_total\{endpoint="/metrics"\} 2\.0', text)
 
 
+# per-tenant SLO plane families (PR: per-tenant SLO) — stable interface;
+# behaviour is covered crypto-free in tests/test_slo.py and
+# tests/test_tenant_slo.py
+EXPECTED_TENANT_SLO_FAMILIES = (
+    "slo_tenant_availability",
+    "slo_tenant_p99_seconds",
+    "slo_tenant_burn_rate",
+    "slo_tenant_budget_remaining",
+    "slo_tenant_evictions_total",
+    "slo_fairness_index",
+    "serve_tenant_queue_seconds",
+    "serve_tenant_e2e_seconds",
+    "serve_tenant_sheds_total",
+)
+
+
+def test_tenant_slo_families_export():
+    """One tripped tenant, one served tenant and one LRU eviction light
+    every per-tenant SLO family in a single exposition."""
+    import asyncio
+
+    import numpy as np
+
+    from fabric_token_sdk_tpu.obs import TenantSloMonitor, TenantSloPolicy
+    from fabric_token_sdk_tpu.serve import ServeConfig, VerificationService
+
+    class _FakeRange:
+        def verify(self, proofs, commitments):
+            return np.ones(len(proofs), dtype=bool)
+
+    class _FakeZK:
+        _range = _FakeRange()
+
+    GLOBAL.reset()
+    clk = {"t": 1000.0}
+    monitor = TenantSloMonitor(
+        policy=TenantSloPolicy(min_volume=4, max_tenants=2),
+        clock=lambda: clk["t"])
+    monitor.record("filler", True, 0.01)     # LRU fodder: evicted below
+    for _ in range(8):                       # trip "hot": 100% failures
+        monitor.record("hot", False)
+        clk["t"] += 0.01
+    assert monitor.shedding("hot")
+    svc = VerificationService(
+        _FakeZK(), config=ServeConfig(buckets=(4,), max_wait_s=0.001),
+        tenant_slo=monitor)
+
+    async def run():
+        await svc.start(prewarm=False)
+        shed = await svc.submit_range(object(), object(), tenant="hot")
+        ok = await svc.submit_range(object(), object(), tenant="victim")
+        await svc.stop()
+        return shed, ok
+
+    shed, ok = asyncio.run(run())
+    assert shed.status == "shed_tenant_slo" and ok.ok
+    # the victim's arrival made three tenants: "filler" was LRU-evicted
+    assert monitor.evictions >= 1 and "filler" not in monitor.tenants()
+
+    text = GLOBAL.prometheus_text()
+    for fam in EXPECTED_TENANT_SLO_FAMILIES:
+        assert fam in text, f"tenant slo family silent: {fam}"
+    assert "# TYPE slo_tenant_burn_rate gauge" in text
+    assert "# TYPE serve_tenant_e2e_seconds histogram" in text
+    assert re.search(r'slo_fairness_index\{basis="throughput"\}', text)
+    assert re.search(r'serve_tenant_sheds_total\{[^}]*tms_id="hot"', text)
+
+
 # flight recorder / heartbeat / fleet federation families (PR:
 # observability) — stable interface; behaviour is covered crypto-free in
 # tests/test_journal.py, test_heartbeat.py and test_aggregate.py

@@ -262,6 +262,63 @@ def test_drr_single_tenant_is_exact_fifo_and_head_is_oldest():
     assert [r.payload[0] for r in q] == ["e0", "l0"]
 
 
+def test_drr_retire_drops_deficit_gauge_series():
+    """Regression: ``rpc_tenant_deficit{tms_id}`` used to live in the
+    registry forever once a tenant departed. Retiring (sub-queue
+    drained) must remove the gauge series; the drains counter stays
+    (cumulative ledger) until the max_tenants LRU evicts it."""
+    from fabric_token_sdk_tpu.obs import GLOBAL
+
+    cfg = ServeConfig(buckets=(16,), tenant_quantum=2)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    for i in range(4):
+        sched.push(_tenant_req("drr-gone", f"g{i}", now, i))
+        sched.push(_tenant_req("drr-stays", f"s{i}", now, 4 + i))
+    q = _range_queue(sched)
+    drained = []
+    while len(q) > 2:                      # leave drr-stays backlogged
+        drained.append(q.popleft())
+    assert {r.tenant for r in drained} >= {"drr-gone"}
+
+    def _series(name, tenant):
+        return [(n, lbl) for (n, lbl) in GLOBAL.snapshot()
+                if n == name and ("tms_id", tenant) in lbl]
+
+    # drr-gone fully drained -> retired -> its deficit gauge is gone
+    assert not _series("rpc_tenant_deficit", "drr-gone"), \
+        "retired tenant's deficit gauge leaked"
+    # a still-backlogged tenant keeps its gauge and both keep drains
+    assert _series("rpc_tenant_deficit", "drr-stays")
+    assert _series("serve_tenant_drains_total", "drr-gone")
+    assert _series("serve_tenant_drains_total", "drr-stays")
+
+
+def test_drr_drain_lru_evicts_departed_tenant_counters():
+    """Past ``ServeConfig.max_tenants`` distinct drained tenants, the
+    least-recently-drained tms_id's counter/gauge series are evicted
+    from the registry — per-tenant cardinality is bounded."""
+    from fabric_token_sdk_tpu.obs import GLOBAL
+
+    cfg = ServeConfig(buckets=(16,), max_tenants=2)
+    sched = BucketScheduler(cfg)
+    now = time.perf_counter()
+    for i, tenant in enumerate(("lru-a", "lru-b", "lru-c")):
+        sched.push(_tenant_req(tenant, f"p{i}", now, i))
+    q = _range_queue(sched)
+    for _ in range(3):
+        q.popleft()
+
+    def _series(name, tenant):
+        return [(n, lbl) for (n, lbl) in GLOBAL.snapshot()
+                if n == name and ("tms_id", tenant) in lbl]
+
+    assert not _series("serve_tenant_drains_total", "lru-a"), \
+        "LRU-evicted tenant's drains counter leaked"
+    assert _series("serve_tenant_drains_total", "lru-b")
+    assert _series("serve_tenant_drains_total", "lru-c")
+
+
 def test_drr_expiry_sweep_keeps_tenant_structure():
     cfg = ServeConfig(buckets=(8,), max_wait_s=30.0, min_batch=8,
                       tenant_quantum=2)

@@ -7,7 +7,9 @@ no device, no wall-clock sleeps, no global-registry leakage.
 import jax.numpy as jnp
 
 from fabric_token_sdk_tpu.obs import (DeviceProfiler, MetricsProvider,
-                                      SloMonitor, SloPolicy)
+                                      SloMonitor, SloPolicy,
+                                      TenantSloMonitor, TenantSloPolicy,
+                                      jain_index)
 
 
 class _Clock:
@@ -135,6 +137,141 @@ def test_summary_shape():
     w = doc["windows"]["60s"]
     assert w["requests"] == 3 and 0 < w["availability"] < 1
     assert w["p99_s"] == 0.02
+
+
+# ------------------------------------------------------ TenantSloMonitor
+def _tenant_monitor(policy=None, **kw):
+    clock = _Clock()
+    provider = MetricsProvider()
+    mon = TenantSloMonitor(policy=policy or TenantSloPolicy(),
+                           provider=provider, clock=clock, **kw)
+    return mon, clock, provider
+
+
+def test_tenant_windows_are_independent():
+    mon, clock, provider = _tenant_monitor(
+        policy=TenantSloPolicy(windows=(60.0, 300.0), min_volume=8))
+    for i in range(100):
+        mon.record("good", True, latency_s=0.01)
+        mon.record("bad", i % 2 == 0, latency_s=0.01)
+        clock.advance(0.1)
+    assert _gauge(provider, "slo_tenant_availability", tms_id="good") == 1.0
+    assert _gauge(provider, "slo_tenant_availability", tms_id="bad") == 0.5
+    # bad's burn: (1 - 0.5) / 0.001 = 500x budget; good burns nothing
+    assert abs(_gauge(provider, "slo_tenant_burn_rate", tms_id="bad",
+                      window="60s") - 500.0) < 1e-6
+    assert _gauge(provider, "slo_tenant_burn_rate", tms_id="good",
+                  window="60s") == 0.0
+    assert _gauge(provider, "slo_tenant_budget_remaining",
+                  tms_id="good") == 1.0
+    assert _gauge(provider, "slo_tenant_budget_remaining",
+                  tms_id="bad") == 0.0
+    assert mon.shedding("bad") and not mon.shedding("good")
+
+
+def test_tenant_fast_burn_trips_edge_triggered_and_recovers():
+    trips, recoveries = [], []
+    mon, clock, provider = _tenant_monitor(
+        policy=TenantSloPolicy(min_volume=10, fast_burn=14.4),
+        on_fast_burn=trips.append, on_recover=recoveries.append)
+    for _ in range(20):
+        mon.record("hot", False)
+        mon.record("victim", True, latency_s=0.01)
+        clock.advance(0.01)
+    assert trips == ["hot"], "hook fires once per episode, with the tms_id"
+    assert mon.shedding("hot") and not mon.shedding("victim")
+    summ = mon.summary()
+    assert summ["tenants"]["hot"]["fast_burn_active"]
+    assert summ["tenants"]["hot"]["trips"] == 1
+
+    # recovery: hot's failures age out of both windows
+    clock.advance(400.0)
+    mon.record("hot", True, latency_s=0.01)
+    assert recoveries == ["hot"]
+    assert not mon.shedding("hot")
+
+
+def test_tenant_min_volume_gates_the_trip():
+    mon, clock, _ = _tenant_monitor(
+        policy=TenantSloPolicy(min_volume=32))
+    for _ in range(31):
+        mon.record("blip", False)
+        clock.advance(0.01)
+    assert not mon.shedding("blip"), "a 31-request blip must not shed"
+    mon.record("blip", False)
+    assert mon.shedding("blip")
+
+
+def test_tenant_lru_eviction_bounds_cardinality_and_series():
+    evicted = []
+    mon, clock, provider = _tenant_monitor(
+        policy=TenantSloPolicy(max_tenants=3), on_evict=evicted.append)
+    for t in ("a", "b", "c"):
+        mon.record(t, True, latency_s=0.01)
+        clock.advance(0.01)
+    mon.record("a", True, latency_s=0.01)   # refresh a: b is now LRU
+    mon.record("d", True, latency_s=0.01)   # evicts b
+    assert evicted == ["b"]
+    assert mon.tenants() == ["c", "a", "d"]
+    assert mon.evictions == 1
+    # every slo_tenant_* series for the evicted tms_id is gone
+    leaked = [(n, lbl) for (n, lbl) in provider.snapshot()
+              if n.startswith("slo_tenant_") and ("tms_id", "b") in lbl]
+    assert not leaked, f"evicted tenant left series behind: {leaked}"
+    assert _gauge(provider, "slo_tenant_availability", tms_id="a") == 1.0
+    counters = [v for (n, _), v in provider.snapshot().items()
+                if n == "slo_tenant_evictions_total"]
+    assert counters == [1.0]
+
+
+def test_note_shed_counts_without_feeding_the_window():
+    mon, clock, _ = _tenant_monitor(
+        policy=TenantSloPolicy(min_volume=4))
+    for _ in range(8):
+        mon.record("t", True, latency_s=0.01)
+        clock.advance(0.01)
+    mon.note_shed("t", rows=100)
+    summ = mon.summary()["tenants"]["t"]
+    assert summ["sheds"] == 100
+    assert summ["requests"] == 8, "sheds must not count as window events"
+    assert not mon.shedding("t"), "sheds must not burn the tenant's budget"
+
+
+def test_fairness_indices_published():
+    mon, clock, provider = _tenant_monitor()
+    # equal service: J = 1.0 on both bases
+    for t in ("a", "b", "c", "d"):
+        for _ in range(10):
+            mon.record(t, True, latency_s=0.01)
+    assert _gauge(provider, "slo_fairness_index", basis="throughput") == 1.0
+    assert _gauge(provider, "slo_fairness_index", basis="p99") == 1.0
+    # starve d into 100x the latency: the p99 basis must drop
+    for _ in range(10):
+        mon.record("d", True, latency_s=1.0)
+    assert _gauge(provider, "slo_fairness_index", basis="p99") < 0.9
+    doc = mon.summary()
+    assert 0.0 < doc["fairness"]["p99"] < 1.0
+    assert doc["fairness"]["throughput"] < 1.0  # d now served 2x the rest
+
+
+def test_jain_index_extremes():
+    assert jain_index([]) == 1.0
+    assert jain_index([5.0]) == 1.0
+    assert jain_index([3.0, 3.0, 3.0]) == 1.0
+    # one tenant takes everything: J -> 1/n
+    assert abs(jain_index([100.0, 0.0, 0.0, 0.0]) - 0.25) < 1e-9
+
+
+def test_eval_interval_batches_evaluation():
+    mon, clock, provider = _tenant_monitor(
+        policy=TenantSloPolicy(min_volume=1, eval_interval_s=10.0))
+    mon.record("t", False)          # first record: eval runs immediately
+    for _ in range(5):
+        mon.record("t", True, latency_s=0.01)  # within the interval
+    assert _gauge(provider, "slo_tenant_availability", tms_id="t") == 0.0
+    clock.advance(11.0)
+    mon.record("t", True, latency_s=0.01)      # interval elapsed: re-eval
+    assert _gauge(provider, "slo_tenant_availability", tms_id="t") == 6 / 7
 
 
 # -------------------------------------------------------- DeviceProfiler

@@ -253,6 +253,67 @@ def test_tracez_exports_chrome_trace_json():
     assert {"outer", "inner"} <= names
 
 
+def test_tenantz_reports_per_tenant_table():
+    from fabric_token_sdk_tpu.obs import TenantSloMonitor, TenantSloPolicy
+    monitor = TenantSloMonitor(policy=TenantSloPolicy(min_volume=4),
+                               provider=MetricsProvider())
+    svc = VerificationService(
+        _TruthZK(), config=ServeConfig(buckets=(8,), max_wait_s=0.005),
+        tenant_slo=monitor)
+
+    async def body(svc):
+        server = serve_telemetry(svc, TelemetryConfig(port=0))
+        loop = asyncio.get_running_loop()
+        try:
+            await asyncio.gather(*[
+                svc.submit_range(True, object(), deadline_s=30.0, tenant=t)
+                for t in ("alpha", "beta") for _ in range(4)])
+            tenantz = await loop.run_in_executor(
+                None, _get, server.url + "/tenantz")
+            statusz = await loop.run_in_executor(
+                None, _get, server.url + "/statusz")
+        finally:
+            server.stop()
+        return tenantz, statusz
+
+    (code, ctype, text), (s_code, _, s_text) = _run_service(svc, body=body)
+    assert code == 200 and ctype.startswith("application/json")
+    doc = json.loads(text)
+    assert doc["enabled"] is True
+    assert doc["shed_policy_enabled"] is True
+    for t in ("alpha", "beta"):
+        row = doc["tenants"][t]
+        assert row["requests"] == 4
+        assert row["availability"] == 1.0
+        assert row["sheds"] == 0 and row["fast_burn_active"] is False
+        assert row["budget_remaining"] == 1.0
+        assert set(row["burn_rate"]) == {"60s", "300s"}
+        # joined with the live scheduler/in-flight view, drained by now
+        assert row["queued"] == 0 and row["inflight"] == 0
+    assert set(doc["fairness"]) == {"throughput", "p99"}
+    # the same table rides along as the "tenants" key of /statusz
+    assert s_code == 200
+    assert json.loads(s_text)["tenants"]["tenants"]["alpha"]["requests"] == 4
+
+
+def test_tenantz_disabled_without_monitor():
+    svc = VerificationService(
+        _TruthZK(), config=ServeConfig(buckets=(8,), max_wait_s=0.005))
+
+    async def body(svc):
+        server = serve_telemetry(svc, TelemetryConfig(port=0))
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                None, _get, server.url + "/tenantz")
+        finally:
+            server.stop()
+
+    code, ctype, text = _run_service(svc, body=body)
+    assert code == 200 and ctype.startswith("application/json")
+    assert json.loads(text) == {"enabled": False}
+
+
 # ----------------------------------------------------- trace propagation
 def test_serve_request_trace_is_a_connected_chain():
     """Acceptance: a sampled request's exported trace shows admission ->

@@ -1,0 +1,170 @@
+"""SLO-aware per-tenant shedding through the serve frontend
+(serve/admission.TenantShedPolicy + obs/slo.TenantSloMonitor wiring).
+
+A StubZK-backed VerificationService with a fake-clocked TenantSloMonitor:
+when the hot tenant's fast-burn trips, NEW work from that tenant sheds
+with the distinct ``shed_tenant_slo`` status while other tenants are
+served untouched; when the hot tenant's windows recover it un-sheds.
+No device, no wall-clock sleeps.
+"""
+
+import asyncio
+
+from fabric_token_sdk_tpu.obs import (GLOBAL, MetricsProvider,
+                                      TenantSloMonitor, TenantSloPolicy)
+from fabric_token_sdk_tpu.serve import (STATUS_OK, STATUS_SHED_TENANT_SLO,
+                                        ServeConfig, StubZK,
+                                        TenantShedPolicy,
+                                        VerificationService)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _monitor(clock, **policy_kw):
+    policy_kw.setdefault("min_volume", 8)
+    return TenantSloMonitor(policy=TenantSloPolicy(**policy_kw),
+                            provider=MetricsProvider(), clock=clock)
+
+
+def _burn(monitor, tenant, clock, n=16):
+    """Trip the tenant's fast-burn: 100% failures over both windows."""
+    for _ in range(n):
+        monitor.record(tenant, False)
+        clock.advance(0.01)
+
+
+def _svc(monitor, **cfg_kw):
+    cfg = ServeConfig(buckets=(4, 8), max_wait_s=0.001, **cfg_kw)
+    return VerificationService(StubZK(), config=cfg, tenant_slo=monitor)
+
+
+def test_hot_tenant_sheds_victims_admitted():
+    clock = _Clock()
+    monitor = _monitor(clock)
+    svc = _svc(monitor)
+    _burn(monitor, "hot", clock)
+    assert monitor.shedding("hot")
+
+    async def run():
+        await svc.start(prewarm=False)
+        hot = await svc.submit_range(True, None, tenant="hot")
+        victim = await svc.submit_range(True, None, tenant="victim")
+        await svc.stop()
+        return hot, victim
+
+    hot, victim = asyncio.run(run())
+    assert hot.status == STATUS_SHED_TENANT_SLO and hot.accepted is None
+    assert victim.status == STATUS_OK and victim.accepted is True
+    summ = svc.tenant_status()
+    assert summ["enabled"] and summ["shed_policy_enabled"]
+    assert summ["tenants"]["hot"]["sheds"] == 1
+    # shed rows are counted in the stable per-tenant family
+    sheds = [v for (n, lbl), v in GLOBAL.snapshot().items()
+             if n == "serve_tenant_sheds_total" and ("tms_id", "hot") in lbl]
+    assert sheds and sheds[0] >= 1
+
+
+def test_whole_frame_sheds_for_the_hot_tenant_only():
+    clock = _Clock()
+    monitor = _monitor(clock)
+    svc = _svc(monitor)
+    _burn(monitor, "hot", clock)
+
+    async def run():
+        await svc.start(prewarm=False)
+        hot = await svc.submit_batch("range", [(True, None)] * 4,
+                                     tenant="hot")
+        victim = await svc.submit_batch("range", [(True, None)] * 4,
+                                        tenant="victim")
+        await svc.stop()
+        return hot, victim
+
+    hot, victim = asyncio.run(run())
+    assert all(r.status == STATUS_SHED_TENANT_SLO for r in hot)
+    assert all(r.status == STATUS_OK and r.accepted for r in victim)
+    assert svc.tenant_status()["tenants"]["hot"]["sheds"] == 4
+
+
+def test_shed_does_not_self_sustain_and_recovery_unsheds():
+    clock = _Clock()
+    monitor = _monitor(clock)
+    svc = _svc(monitor)
+    _burn(monitor, "hot", clock)
+
+    async def run():
+        await svc.start(prewarm=False)
+        shed = await svc.submit_range(True, None, tenant="hot")
+        # sheds must not feed the window: burn stays where the real
+        # failures put it, and aging those out recovers the tenant
+        requests_before = monitor.summary()["tenants"]["hot"]["requests"]
+        clock.advance(400.0)
+        monitor.record("hot", True, 0.01)
+        assert not monitor.shedding("hot")
+        served = await svc.submit_range(True, None, tenant="hot")
+        await svc.stop()
+        return shed, requests_before, served
+
+    shed, requests_before, served = asyncio.run(run())
+    assert shed.status == STATUS_SHED_TENANT_SLO
+    assert requests_before == 16, "a shed must not count as a window event"
+    assert served.status == STATUS_OK and served.accepted is True
+
+
+def test_no_tenant_shed_env_disables_the_policy(monkeypatch):
+    monkeypatch.setenv("FTS_NO_TENANT_SHED", "1")
+    clock = _Clock()
+    monitor = _monitor(clock)
+    svc = _svc(monitor)                   # policy reads env at construction
+    _burn(monitor, "hot", clock)
+    assert monitor.shedding("hot"), "the monitor still observes and trips"
+    assert not svc.admission.tenant_shed.enabled
+
+    async def run():
+        await svc.start(prewarm=False)
+        res = await svc.submit_range(True, None, tenant="hot")
+        await svc.stop()
+        return res
+
+    res = asyncio.run(run())
+    assert res.status == STATUS_OK, "disabled policy must not shed"
+    assert svc.tenant_status()["shed_policy_enabled"] is False
+
+
+def test_shed_policy_without_monitor_never_sheds():
+    policy = TenantShedPolicy(None, enabled=True)
+    assert not policy.should_shed("anyone")
+
+
+def test_eviction_drops_serve_tenant_series():
+    clock = _Clock()
+    monitor = _monitor(clock, max_tenants=2)
+    svc = _svc(monitor)
+
+    async def run():
+        await svc.start(prewarm=False)
+        for t in ("evict-a", "evict-b", "evict-c"):
+            res = await svc.submit_range(True, None, tenant=t)
+            assert res.ok
+        await svc.stop()
+
+    asyncio.run(run())
+    assert monitor.evictions >= 1
+    assert "evict-a" not in monitor.tenants()
+    # the service's on_evict hook dropped the serve-layer series too
+    leaked = [(n, lbl) for (n, lbl) in GLOBAL.snapshot()
+              if n.startswith("serve_tenant_")
+              and ("tms_id", "evict-a") in lbl]
+    assert not leaked, f"evicted tenant left serve series behind: {leaked}"
+    live = [(n, lbl) for (n, lbl) in GLOBAL.snapshot()
+            if n == "serve_tenant_e2e_seconds"
+            and ("tms_id", "evict-c") in lbl]
+    assert live, "resident tenants keep their series"
