@@ -446,6 +446,32 @@ def _bench_serve():
                  f"mean fill {sum(fill) / len(fill):.2f})"),
         "vs_baseline": round(value / TARGET_BASELINE, 4),
     }))
+    # distributed-tracing overhead, measured and bounded: the per-traced-
+    # request cost is one span open/close plus one context inject+extract
+    # round-trip (the v3 wire path). Amortized over trace_every sampling
+    # it must stay a vanishing fraction of the measured p50 — asserted,
+    # not eyeballed, so a tracing-hot-path regression fails the bench.
+    from fabric_token_sdk_tpu.obs import Tracer
+    from fabric_token_sdk_tpu.obs.tracing import extract_wire_context
+    probe = Tracer()  # private: keeps the run's span buffers untouched
+    iters = 2000
+    t_tr = time.perf_counter()
+    for _ in range(iters):
+        with probe.span("bench.trace_probe") as psp:
+            extract_wire_context(psp.context().to_bytes())
+    trace_cost_s = (time.perf_counter() - t_tr) / iters
+    every = max(1, cfg.trace_every or 1)
+    overhead_ratio = (trace_cost_s / every) / max(p50, 1e-9)
+    assert overhead_ratio < 0.05, (
+        f"tracing overhead {overhead_ratio:.4f} of p50 latency — the "
+        "span/context hot path regressed")
+    print(json.dumps({
+        "metric": f"serve_trace_overhead_ratio_{BIT_LENGTH}bit",
+        "value": round(overhead_ratio, 6),
+        "unit": (f"fraction of p50 request latency spent on tracing "
+                 f"({trace_cost_s * 1e6:.1f}us/traced request, "
+                 f"sampled 1/{every}; bound < 0.05 asserted)"),
+    }))
 
 
 def _bench_frontdoor():

@@ -23,7 +23,9 @@ Layer map vs the reference SDK:
 from .metrics import (Counter, Gauge, Histogram, MetricsProvider, GLOBAL,
                       escape_help_text, escape_label_value,
                       sanitize_label_name, sanitize_metric_name)
-from .tracing import Span, Tracer, TRACER
+from .tracing import (CONTEXT_WIRE_SIZE, Span, SpanContext,
+                      SpanSpoolExporter, Tracer, TRACER, assemble_traces,
+                      extract_wire_context, read_span_spool)
 from .pipeline import BatchRecord, PhaseTimer, PipelineRecorder, RECORDS
 from .export import spans_to_chrome_trace, write_chrome_trace
 from .report import bench_snapshot, write_bench_report
@@ -43,6 +45,8 @@ __all__ = [
     "sanitize_metric_name", "sanitize_label_name", "escape_label_value",
     "escape_help_text",
     "Span", "Tracer", "TRACER",
+    "SpanContext", "SpanSpoolExporter", "CONTEXT_WIRE_SIZE",
+    "extract_wire_context", "read_span_spool", "assemble_traces",
     "BatchRecord", "PhaseTimer", "PipelineRecorder", "RECORDS",
     "spans_to_chrome_trace", "write_chrome_trace",
     "bench_snapshot", "write_bench_report",

@@ -327,3 +327,20 @@ class FleetAggregator:
                 for node in sorted(docs)},
             "last_collect": last,
         }
+
+    # ------------------------------------------------------------- traces
+    def span_records(self) -> list[dict]:
+        """Every span record published to the spool by fleet members
+        (``*.spans.jsonl``, written by ``SpanSpoolExporter``)."""
+        from .tracing import read_span_spool
+
+        return read_span_spool(self.spool_dir)
+
+    def traces(self) -> dict[str, list[dict]]:
+        """Fleet-wide traces: spool span records from every node grouped
+        by trace_id — the cross-process view the federated ``/tracez``
+        serves (one trace spans the client's ``rpc.call``, the sidecar's
+        ``rpc.serve`` and its ``serve.request``)."""
+        from .tracing import assemble_traces
+
+        return assemble_traces(self.span_records())

@@ -249,8 +249,13 @@ class Journal:
         try:
             from .tracing import TRACER
 
+            # node identity on the artifact AND on every active span:
+            # once snapshots from several processes land in one incident
+            # directory, each span must say which process it belongs to
+            snapshot["node"] = TRACER.node
             snapshot["active_spans"] = [
-                {"name": sp.name, "span_id": sp.span_id,
+                {"name": sp.name, "node": TRACER.node,
+                 "span_id": sp.span_id,
                  "trace_id": sp.trace_id, "parent_id": sp.parent_id,
                  "age_s": round(time.perf_counter() - sp.start, 6),
                  "attributes": dict(sp.attributes)}

@@ -103,17 +103,42 @@ class Histogram:
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _samples: deque = field(
         default_factory=lambda: deque(maxlen=_SAMPLE_KEEP))
+    #: OpenMetrics-style exemplars: at most ONE slot per bucket (the
+    #: most recent exemplar-bearing observation that fell in it), so
+    #: storage is bounded by the bucket count no matter the traffic.
+    #: Kept out of ``prometheus_text`` — the fleet merge parser speaks
+    #: plain exposition; exemplars travel via ``exemplar_snapshot()``.
+    _exemplars: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if self.counts is None:
             self.counts = [0] * (len(self.buckets) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
+        """Record one observation; ``exemplar`` optionally attaches
+        trace identity (e.g. ``{"trace_id": "4f2a..."}``) to the bucket
+        the value lands in, overwriting that bucket's previous slot."""
         with self._lock:
-            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            idx = bisect.bisect_left(self.buckets, value)
+            self.counts[idx] += 1
             self.total += value
             self.n += 1
             self._samples.append(value)
+            if exemplar:
+                self._exemplars[idx] = {"value": value,
+                                        "labels": dict(exemplar)}
+
+    def exemplar_snapshot(self) -> dict:
+        """Copy of the per-bucket exemplar slots, keyed by upper bound
+        (``+Inf`` for the overflow bucket)."""
+        with self._lock:
+            slots = dict(self._exemplars)
+        out = {}
+        for idx, ex in slots.items():
+            bound = (self.buckets[idx] if idx < len(self.buckets)
+                     else float("inf"))
+            out[bound] = ex
+        return out
 
     @property
     def mean(self) -> float:
@@ -232,6 +257,25 @@ class MetricsProvider:
             self._gauges.clear()
             self._histograms.clear()
             self._help.clear()
+
+    def exemplars(self, name: str | None = None) -> list[dict]:
+        """Exemplar slots across registered histograms (optionally one
+        family): ``{"family", "labels", "bucket_le", "value",
+        "exemplar"}`` per slot. This is the scrape surface for trace
+        exemplars — they are deliberately NOT rendered into
+        ``prometheus_text`` (the fleet merge parser treats unknown
+        sample syntax as a document-level conflict)."""
+        with self._lock:
+            hists = [(fam, labels, h)
+                     for (fam, labels), h in self._histograms.items()
+                     if name is None or fam == name]
+        out = []
+        for fam, labels, h in hists:
+            for bound, ex in sorted(h.exemplar_snapshot().items()):
+                out.append({"family": fam, "labels": dict(labels),
+                            "bucket_le": bound, "value": ex["value"],
+                            "exemplar": ex["labels"]})
+        return out
 
     # ------------------------------------------------------------- scraping
     def snapshot(self) -> dict:

@@ -177,6 +177,32 @@ class TelemetryServer:
         host = self.config.host
         return f"http://{host}:{self.port}"
 
+    def trace_summary(self) -> dict:
+        """Fleet-wide traces keyed by trace_id (hex): the federator's
+        spool-assembled cross-node view when one is attached, else the
+        local tracer's completed roots grouped the same way. Never
+        raises — /statusz and incident snapshots embed this."""
+        try:
+            if self._federator is not None \
+                    and hasattr(self._federator, "traces"):
+                return self._federator.traces()
+            from .tracing import assemble_traces
+            records = []
+            for root in self.tracer.root_snapshot():
+                for sp in root.walk():
+                    records.append({
+                        "node": self.tracer.node, "name": sp.name,
+                        "trace_id": f"{sp.trace_id:016x}",
+                        "span_id": f"{sp.span_id:016x}",
+                        "parent_id": (f"{sp.parent_id:016x}"
+                                      if sp.parent_id else None),
+                        "duration": sp.duration,
+                        "wall_end": 0.0,
+                    })
+            return assemble_traces(records)
+        except Exception as exc:  # a scrape must never crash
+            return {"error": repr(exc)}
+
     # -------------------------------------------------------- rendering
     @staticmethod
     def _run_checks(checks: dict) -> dict[str, str]:
@@ -253,6 +279,12 @@ class TelemetryServer:
                     json.dumps(doc, default=str).encode())
         if path == "/tracez":
             doc = spans_to_chrome_trace(self.tracer.root_snapshot())
+            doc["node"] = self.tracer.node
+            # cross-node assembly: with a federator attached, every
+            # fleet member's spool-exported spans are grouped by
+            # trace_id so one request's rpc.call / rpc.serve /
+            # serve.request spans read as a single distributed trace
+            doc["traces"] = self.trace_summary()
             return 200, "application/json", json.dumps(doc).encode()
         if path == "/":
             body = ("fabric_token_sdk_tpu telemetry\n"
@@ -322,6 +354,10 @@ def serve_telemetry(service, config: TelemetryConfig | None = None,
         server.add_status_source("wal", wal.summary)
     if rpc_server is not None and hasattr(rpc_server, "status"):
         server.add_status_source("rpc", rpc_server.status)
+    # cross-node trace assembly rides /statusz (and, mirrored below,
+    # incident snapshots) so an incident artifact carries the traces
+    # that were in flight, not just this node's spans
+    server.add_status_source("traces", server.trace_summary)
     # incident snapshots embed the same operational views /statusz serves
     for name, fn in server._status.items():
         if name != "journal":

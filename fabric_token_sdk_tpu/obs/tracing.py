@@ -8,9 +8,25 @@ events; nesting is tracked with a contextvar so layers that never see
 each other (node -> chaincode -> validator -> batch verifier) still
 produce one connected tree per request.
 
-Exporters: Chrome/Perfetto trace-event JSON (obs/export.py) and optional
-JAX profiler coupling — with ``profile_dir`` set each ROOT span wraps the
-work in jax.profiler.start_trace/stop_trace so xprof captures the device
+Cross-process propagation (Dapper-style): :class:`SpanContext` is the
+compact identity of one span — ``(trace_id, span_id, sampled)`` — with
+a fixed 17-byte wire encoding (``>QQB``) carried in RPC frames and pipe
+messages. A span created with ``remote_parent=ctx`` joins the CALLER's
+trace: it inherits ``ctx.trace_id`` and parents under ``ctx.span_id``
+even though the parent Span object lives in another process. Span and
+trace ids are seeded from ``os.urandom`` per process so two processes
+can never mint the same trace id. :func:`extract_wire_context` is the
+tolerant decode half: poisoned or missing context bytes NEVER raise —
+they count under ``trace_drops_total{reason}`` and return ``None``, so
+a bad trace header can never fail a frame.
+
+Exporters: Chrome/Perfetto trace-event JSON (obs/export.py), the
+spool-based :class:`SpanSpoolExporter` (the tracing twin of
+``obs.aggregate.SpoolPublisher``: each process appends its finished
+spans to ``<spool>/<node>.spans.jsonl`` so a parent can assemble
+fleet-wide traces), and optional JAX profiler coupling — with
+``profile_dir`` set each ROOT span wraps the work in
+jax.profiler.start_trace/stop_trace so xprof captures the device
 timeline (SURVEY.md §5), and with ``annotate_device=True`` every span
 also enters a jax.profiler.TraceAnnotation so host spans line up with
 device ops in the xprof view.
@@ -20,20 +36,114 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import json
+import os
+import struct
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from .metrics import GLOBAL, MetricsProvider, sanitize_metric_name
 
+#: Family metadata for the cross-process trace plane (stable inventory;
+#: HELP-linted via scripts/check_metric_help.py like every other block).
+_TRACE_FAMILIES = {
+    "trace_spans_total":
+        "Finished spans accepted by the span spool exporter, by node.",
+    "trace_drops_total":
+        "Spans or trace contexts dropped, by reason: buffer (export "
+        "ring full), unsampled (span's trace not sampled), spool_io "
+        "(exporter publish failed), invalid_context (poisoned wire "
+        "context bytes ignored), missing (frame carried no context).",
+    "span_exemplars_total":
+        "Trace exemplars attached to latency histograms, by family.",
+}
+
+#: Wire layout of one SpanContext: trace_id u64 | span_id u64 | sampled
+#: u8 — 17 bytes, big-endian, version-free (the RPC layer negotiates).
+_CTX_STRUCT = struct.Struct(">QQB")
+CONTEXT_WIRE_SIZE = _CTX_STRUCT.size
+
+# Span/trace ids must be unique ACROSS processes (fleet trace assembly
+# keys on trace_id), so the per-process counter rides on a random epoch:
+# 40 random bits shifted past a 24-bit counter space keeps ids monotonic
+# in-process and collision-free (w.h.p.) between processes, while
+# staying under 2**64 for the wire encoding.
+_ID_EPOCH = int.from_bytes(os.urandom(5), "big") << 24
 _ids = itertools.count(1)
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
     "fts_current_span", default=None)
 
 
 def _next_id() -> int:
-    return next(_ids)
+    return _ID_EPOCH + next(_ids)
+
+
+def _default_node() -> str:
+    """Node identity stamped into exports/snapshots: ``FTS_NODE`` when
+    the deployment names its processes, else pid-derived."""
+    return os.environ.get("FTS_NODE") or f"pid{os.getpid()}"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Compact cross-process span identity (trace_id, span_id, sampled).
+
+    The inject half of Dapper-style propagation: a client serializes
+    the context of its open ``rpc.call`` span into a frame, the server
+    extracts it and opens its ``rpc.serve`` span with
+    ``remote_parent=ctx`` — one trace id across the process hop."""
+
+    trace_id: int
+    span_id: int
+    sampled: bool = True
+
+    def to_bytes(self) -> bytes:
+        """17-byte wire form (``>QQB``)."""
+        return _CTX_STRUCT.pack(self.trace_id & 0xFFFFFFFFFFFFFFFF,
+                                self.span_id & 0xFFFFFFFFFFFFFFFF,
+                                1 if self.sampled else 0)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SpanContext":
+        """Strict decode; raises ``ValueError`` on truncated bytes or a
+        zero trace id (use :func:`extract_wire_context` on wire input —
+        it counts and returns None instead of raising)."""
+        if not isinstance(data, (bytes, bytearray, memoryview)) \
+                or len(data) != CONTEXT_WIRE_SIZE:
+            raise ValueError(
+                f"trace context must be {CONTEXT_WIRE_SIZE} bytes, got "
+                f"{type(data).__name__} of length "
+                f"{len(data) if hasattr(data, '__len__') else '?'}")
+        trace_id, span_id, sampled = _CTX_STRUCT.unpack(bytes(data))
+        if trace_id == 0 or span_id == 0:
+            raise ValueError("zero trace/span id")
+        return cls(trace_id=trace_id, span_id=span_id,
+                   sampled=bool(sampled))
+
+
+def extract_wire_context(data,
+                         provider: MetricsProvider | None = None
+                         ) -> SpanContext | None:
+    """Tolerant wire decode: the server-side extract half.
+
+    ``None`` input (a v1/v2 peer that sent no context) counts under
+    ``trace_drops_total{reason="missing"}``; poisoned bytes (truncated,
+    wrong type, zero trace id) count under ``reason="invalid_context"``.
+    Either way the caller gets ``None`` and serves the frame — missing
+    or poisoned context is NEVER a frame error."""
+    provider = provider or GLOBAL
+    if data is None:
+        provider.counter("trace_drops_total", reason="missing").add()
+        return None
+    try:
+        return SpanContext.from_bytes(data)
+    except ValueError:
+        provider.counter("trace_drops_total",
+                         reason="invalid_context").add()
+        return None
 
 
 @dataclass
@@ -48,6 +158,13 @@ class Span:
     children: list = field(default_factory=list)
     links: list = field(default_factory=list)
     duration: float | None = None
+    sampled: bool = True
+
+    def context(self) -> SpanContext:
+        """This span's cross-process identity — inject it into an
+        outbound frame so the callee can parent under it remotely."""
+        return SpanContext(trace_id=self.trace_id, span_id=self.span_id,
+                           sampled=self.sampled)
 
     def add_event(self, name: str, **attributes) -> None:
         """tracing span AddEvent (audit/auditor.go:143-171 pattern)."""
@@ -87,26 +204,58 @@ class Tracer:
 
     def __init__(self, provider: MetricsProvider | None = None,
                  profile_dir: str | None = None, keep_spans: int = 256,
-                 annotate_device: bool = False):
+                 annotate_device: bool = False, node: str | None = None):
         self.provider = provider or GLOBAL
+        for fam, help_text in _TRACE_FAMILIES.items():
+            self.provider.describe(fam, help_text)
         self.profile_dir = profile_dir
         self.annotate_device = annotate_device
+        self.node = node or _default_node()
         self.finished: list[Span] = []
         self.roots: list[Span] = []
         self._active: dict[int, Span] = {}
         self._keep = keep_spans
         self._lock = threading.Lock()
+        self._finish_hooks: list = []
+
+    def add_finish_hook(self, fn) -> None:
+        """Register ``fn(span)`` to run on every span completion — the
+        exporter attachment point. Hooks must not raise (a broken
+        exporter must not fail the traced work); exceptions are
+        swallowed."""
+        with self._lock:
+            self._finish_hooks.append(fn)
+
+    def remove_finish_hook(self, fn) -> None:
+        with self._lock:
+            try:
+                self._finish_hooks.remove(fn)
+            except ValueError:
+                pass
 
     def _make_span(self, name: str, parent: Span | None,
-                   attributes: dict, start: float | None = None) -> Span:
+                   attributes: dict, start: float | None = None,
+                   remote_parent: SpanContext | None = None) -> Span:
+        if parent is not None:
+            trace_id, parent_id, sampled = (
+                parent.trace_id, parent.span_id, parent.sampled)
+        elif remote_parent is not None:
+            # join the caller's trace across the process hop: same
+            # trace id, parented under a span that lives elsewhere
+            trace_id, parent_id, sampled = (
+                remote_parent.trace_id, remote_parent.span_id,
+                remote_parent.sampled)
+        else:
+            trace_id, parent_id, sampled = _next_id(), None, True
         sp = Span(name=name,
                   start=time.perf_counter() if start is None else start,
                   span_id=_next_id(),
-                  trace_id=(parent.trace_id if parent is not None
-                            else _next_id()),
-                  parent_id=(parent.span_id if parent is not None
-                             else None),
-                  attributes=dict(attributes))
+                  trace_id=trace_id,
+                  parent_id=parent_id,
+                  attributes=dict(attributes),
+                  sampled=sampled)
+        if remote_parent is not None and parent is None:
+            sp.attributes.setdefault("remote_parent", True)
         if parent is not None:
             parent.children.append(sp)
         with self._lock:
@@ -124,19 +273,32 @@ class Tracer:
             self.finished.append(sp)
             if len(self.finished) > self._keep:
                 self.finished.pop(0)
-            if sp.parent_id is None:
+            # a remotely-parented span is a local root (its parent span
+            # object lives in another process), so it belongs in roots
+            # for the Chrome exporter and /tracez
+            if sp.parent_id is None or sp.attributes.get("remote_parent"):
                 self.roots.append(sp)
                 if len(self.roots) > self._keep:
                     self.roots.pop(0)
+            hooks = list(self._finish_hooks)
+        for hook in hooks:
+            try:
+                hook(sp)
+            except Exception:
+                pass
 
     def start_span(self, name: str, parent: Span | None = None,
+                   remote_parent: SpanContext | None = None,
                    **attributes) -> Span:
         """Explicitly-parented span for flows a ``with`` block cannot
         scope: a serve request whose lifetime spans admission -> queue ->
         dispatch -> verdict across coroutines and executor threads (the
         contextvar does not propagate through ``run_in_executor``). Pair
-        with :meth:`end_span`; ``parent=None`` starts a new trace."""
-        return self._make_span(name, parent, attributes)
+        with :meth:`end_span`; ``parent=None`` starts a new trace, and
+        ``remote_parent=ctx`` joins the trace of a caller in another
+        process."""
+        return self._make_span(name, parent, attributes,
+                               remote_parent=remote_parent)
 
     def end_span(self, span: Span) -> None:
         """Finish a span obtained from :meth:`start_span`. Idempotent so
@@ -156,10 +318,12 @@ class Tracer:
         return sp
 
     @contextmanager
-    def span(self, name: str, parent: Span | None = None, **attributes):
+    def span(self, name: str, parent: Span | None = None,
+             remote_parent: SpanContext | None = None, **attributes):
         if parent is None:
             parent = _CURRENT.get()
-        sp = self._make_span(name, parent, attributes)
+        sp = self._make_span(name, parent, attributes,
+                             remote_parent=remote_parent)
         token = _CURRENT.set(sp)
         profiling = False
         annotation = None
@@ -223,6 +387,198 @@ class Tracer:
             self.finished.clear()
             self.roots.clear()
             self._active.clear()
+
+
+class SpanSpoolExporter:
+    """Publish finished spans to ``<spool_dir>/<node>.spans.jsonl`` —
+    the tracing twin of :class:`obs.aggregate.SpoolPublisher`.
+
+    Each process in the fleet (parent, sidecars) attaches one exporter
+    to its tracer; a finish hook copies completed spans into a BOUNDED
+    ring (overflow counts ``trace_drops_total{reason="buffer"}``,
+    unsampled spans count ``reason="unsampled"`` — no unbounded growth
+    under ``trace_every=1`` storms). ``publish()`` atomically rewrites
+    the node's spool file (tmp + rename, same torn-read discipline as
+    the metrics spool) with one JSON record per span carrying the node
+    stamp, ids, timing, and attributes; ``assemble_traces`` on the
+    reading side groups records from every node by trace_id.
+
+    Wall-clock anchoring: span ``start`` is perf_counter (process-
+    relative), so each record also carries ``wall_end`` (time.time() at
+    finish) and ``duration`` — enough to order spans across processes
+    to NTP accuracy without trusting perf_counter epochs to align.
+    """
+
+    def __init__(self, spool_dir, node: str | None = None,
+                 tracer: Tracer | None = None,
+                 provider: MetricsProvider | None = None,
+                 keep_spans: int = 2048, interval_s: float = 2.0):
+        import pathlib
+
+        self.spool_dir = pathlib.Path(spool_dir)
+        self.spool_dir.mkdir(parents=True, exist_ok=True)
+        self.tracer = tracer or TRACER
+        self.node = node or self.tracer.node
+        self.provider = provider or self.tracer.provider
+        self.interval_s = interval_s
+        self.path = self.spool_dir / f"{self.node}.spans.jsonl"
+        self._buf: deque = deque(maxlen=keep_spans)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._attached = False
+
+    # -- collection ----------------------------------------------------
+    def on_finish(self, sp: Span) -> None:
+        """Finish hook: copy one completed span into the export ring."""
+        if not sp.sampled:
+            self.provider.counter("trace_drops_total",
+                                  reason="unsampled").add()
+            return
+        rec = {
+            "node": self.node,
+            "name": sp.name,
+            "trace_id": f"{sp.trace_id:016x}",
+            "span_id": f"{sp.span_id:016x}",
+            "parent_id": (f"{sp.parent_id:016x}"
+                          if sp.parent_id else None),
+            "duration": sp.duration,
+            "wall_end": time.time(),
+            "attributes": {k: v for k, v in sp.attributes.items()
+                           if isinstance(v, (str, int, float, bool,
+                                             type(None)))},
+        }
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                # deque drops the oldest on append; surface that
+                self.provider.counter("trace_drops_total",
+                                      reason="buffer").add()
+            self._buf.append(rec)
+        self.provider.counter("trace_spans_total",
+                              node=self.node).add()
+
+    def attach(self) -> "SpanSpoolExporter":
+        if not self._attached:
+            self.tracer.add_finish_hook(self.on_finish)
+            self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if self._attached:
+            self.tracer.remove_finish_hook(self.on_finish)
+            self._attached = False
+
+    # -- publication ---------------------------------------------------
+    def publish(self) -> int:
+        """Atomically rewrite this node's span spool file from the
+        current ring; returns the number of records written. IO errors
+        count ``trace_drops_total{reason="spool_io"}`` and are
+        swallowed — a full disk must not fail the traced work."""
+        with self._lock:
+            records = list(self._buf)
+        tmp = self.path.with_suffix(".tmp")
+        try:
+            with tmp.open("w") as fh:
+                for rec in records:
+                    fh.write(json.dumps(rec) + "\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            self.provider.counter("trace_drops_total",
+                                  reason="spool_io").add()
+            return 0
+        return len(records)
+
+    def start(self) -> "SpanSpoolExporter":
+        """Attach the finish hook and publish on a daemon-thread
+        cadence (mirrors ``SpoolPublisher.start``)."""
+        self.attach()
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"span-spool-{self.node}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.publish()
+
+    def stop(self, final_publish: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.detach()
+        if final_publish:
+            self.publish()
+
+
+def read_span_spool(spool_dir) -> list[dict]:
+    """Read every ``*.spans.jsonl`` file under ``spool_dir`` into a
+    flat record list. Torn/garbage lines are skipped (atomic rename
+    makes them rare; a crashed writer must not poison the fleet
+    view)."""
+    import pathlib
+
+    records: list[dict] = []
+    spool = pathlib.Path(spool_dir)
+    if not spool.is_dir():
+        return records
+    for path in sorted(spool.glob("*.spans.jsonl")):
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("trace_id"):
+                records.append(rec)
+    return records
+
+
+def assemble_traces(records: list[dict]) -> dict[str, list[dict]]:
+    """Group span records (from any number of nodes) by trace_id —
+    the fleet-wide trace view. Within each trace, spans are ordered
+    parent-before-child where the parent is present, then by wall_end;
+    each trace's list therefore reads as the request's path through
+    the fleet (client ``rpc.call`` -> sidecar ``rpc.serve`` ->
+    ``serve.request``)."""
+    by_trace: dict[str, list[dict]] = {}
+    for rec in records:
+        by_trace.setdefault(rec["trace_id"], []).append(rec)
+    for spans in by_trace.values():
+        by_id = {sp.get("span_id"): sp for sp in spans
+                 if sp.get("span_id")}
+        # depths are precomputed — list.sort() swaps the list contents
+        # out while it runs, so a key function must not read ``spans``
+        depths: dict[int, int] = {}
+        for i, sp in enumerate(spans):
+            depth, seen, cur = 0, set(), sp
+            while True:
+                sid = cur.get("span_id")
+                if sid is not None:
+                    if sid in seen:
+                        break  # cycle in poisoned records: stop here
+                    seen.add(sid)
+                parent = cur.get("parent_id")
+                nxt = by_id.get(parent) if parent is not None else None
+                if nxt is None:
+                    break
+                depth += 1
+                cur = nxt
+            depths[i] = depth
+        order = sorted(range(len(spans)),
+                       key=lambda i: (depths[i],
+                                      spans[i].get("wall_end") or 0))
+        spans[:] = [spans[i] for i in order]
+    return by_trace
 
 
 #: Process-global default tracer: the one the verification pipeline

@@ -66,6 +66,7 @@ from dataclasses import dataclass
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
 from ..obs.journal import JOURNAL
+from ..obs.tracing import CONTEXT_WIRE_SIZE, extract_wire_context
 from .columnar import ColumnarError, decode_submit_batch, materialize_rows
 from .config import LANE_BULK, LANES
 from .request import STATUS_OK
@@ -96,8 +97,18 @@ FRAME_NAMES = {
 #: everything else stays a pickled dict.
 RAW_PAYLOAD_TYPES = frozenset({SUBMIT_BATCH})
 
-#: Protocol version advertised in WELCOME: 2 adds SUBMIT_BATCH.
-RPC_VERSION = 2
+#: Protocol version advertised in WELCOME: 2 adds SUBMIT_BATCH, 3 adds
+#: wire-propagated trace context (SpanContext in SUBMIT/RESULT bodies
+#: under key ``"tc"``; a 17-byte prefix on SUBMIT_BATCH payloads when
+#: the FLAG_TRACE_CONTEXT header flag is set). v1/v2 peers stay wire
+#: compatible: they never set the flag or the key, and a server never
+#: requires either — missing context is counted, never an error.
+RPC_VERSION = 3
+
+#: Header flag bit: the payload begins with a 17-byte trace context
+#: (only meaningful on RAW_PAYLOAD_TYPES frames; pickled bodies carry
+#: context in-dict under ``"tc"`` instead).
+FLAG_TRACE_CONTEXT = 0x1
 
 DEFAULT_MAX_FRAME = 32 * 1024 * 1024
 
@@ -173,14 +184,17 @@ def _describe(provider) -> None:
 
 # --------------------------------------------------------------- codec
 def encode_raw_frame(ftype: int, payload: bytes,
-                     max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
+                     max_frame_bytes: int = DEFAULT_MAX_FRAME,
+                     flags: int = 0) -> bytes:
     """Serialize one frame around an already-encoded payload (the
-    columnar SUBMIT_BATCH path: bytes in, bytes out, no pickle)."""
+    columnar SUBMIT_BATCH path: bytes in, bytes out, no pickle).
+    ``flags`` lands in the header flags field (FLAG_TRACE_CONTEXT when
+    a trace-context prefix was prepended to ``payload``)."""
     if len(payload) > max_frame_bytes:
         raise FrameError("oversize",
                          f"{len(payload)}B payload > {max_frame_bytes}B cap")
     crc = zlib.crc32(payload) & 0xFFFFFFFF
-    return _HEADER.pack(MAGIC, ftype, 0, len(payload), crc) + payload
+    return _HEADER.pack(MAGIC, ftype, flags, len(payload), crc) + payload
 
 
 def encode_frame(ftype: int, body: dict,
@@ -193,14 +207,33 @@ def encode_frame(ftype: int, body: dict,
 
 def decode_header(header: bytes,
                   max_frame_bytes: int = DEFAULT_MAX_FRAME):
-    """Validate a 12-byte header -> (ftype, length, crc)."""
-    magic, ftype, _flags, length, crc = _HEADER.unpack(header)
+    """Validate a 12-byte header -> (ftype, length, crc, flags)."""
+    magic, ftype, flags, length, crc = _HEADER.unpack(header)
     if magic != MAGIC:
         raise FrameError("bad_magic", f"0x{magic:02x}")
     if length > max_frame_bytes:
         raise FrameError("oversize",
                          f"{length}B header length > {max_frame_bytes}B cap")
-    return ftype, length, crc
+    return ftype, length, crc, flags
+
+
+def split_trace_prefix(payload: bytes, flags: int, provider=None):
+    """Strip the optional trace-context prefix off a raw payload.
+
+    Returns ``(ctx_or_None, remaining_payload)``. Without the flag the
+    payload passes through untouched (no drop counted — a v1/v2 peer's
+    frame simply has no context slot). With the flag set but fewer
+    than 17 bytes available, the bytes are counted as an invalid
+    context and the payload passes through untouched — a poisoned
+    prefix never fails the frame."""
+    if not flags & FLAG_TRACE_CONTEXT:
+        return None, payload
+    if len(payload) < CONTEXT_WIRE_SIZE:
+        ctx = extract_wire_context(bytes(payload), provider)
+        return ctx, payload
+    ctx = extract_wire_context(bytes(payload[:CONTEXT_WIRE_SIZE]),
+                               provider)
+    return ctx, payload[CONTEXT_WIRE_SIZE:]
 
 
 def check_payload_crc(payload: bytes, crc: int) -> bytes:
@@ -236,7 +269,8 @@ async def read_frame(reader: asyncio.StreamReader, *,
                      max_frame_bytes: int = DEFAULT_MAX_FRAME,
                      header_timeout_s: float | None = None,
                      body_timeout_s: float = 30.0):
-    """Read one frame; ``None`` on clean EOF at a frame boundary.
+    """Read one frame -> ``(ftype, body, flags)``; ``None`` on clean
+    EOF at a frame boundary.
 
     ``header_timeout_s`` bounds the idle wait for a new frame
     (``asyncio.TimeoutError`` escapes so the caller can use it as a
@@ -252,7 +286,7 @@ async def read_frame(reader: asyncio.StreamReader, *,
             return None  # clean EOF between frames
         raise FrameError("torn",
                          f"EOF after {len(exc.partial)}B of header") from exc
-    ftype, length, crc = decode_header(header, max_frame_bytes)
+    ftype, length, crc, flags = decode_header(header, max_frame_bytes)
     try:
         payload = await asyncio.wait_for(
             reader.readexactly(length), body_timeout_s)
@@ -264,7 +298,7 @@ async def read_frame(reader: asyncio.StreamReader, *,
         raise FrameError(
             "slow_frame",
             f"payload stalled past {body_timeout_s}s deadline") from exc
-    return ftype, _frame_body(ftype, payload, crc)
+    return ftype, _frame_body(ftype, payload, crc), flags
 
 
 # ----------------------------------------------------- sync codec (client)
@@ -275,9 +309,10 @@ def send_frame_sock(sock, ftype: int, body: dict,
 
 
 def send_raw_frame_sock(sock, ftype: int, payload: bytes,
-                        max_frame_bytes: int = DEFAULT_MAX_FRAME) -> None:
+                        max_frame_bytes: int = DEFAULT_MAX_FRAME,
+                        flags: int = 0) -> None:
     """Blocking raw-payload frame send (columnar SUBMIT_BATCH)."""
-    sock.sendall(encode_raw_frame(ftype, payload, max_frame_bytes))
+    sock.sendall(encode_raw_frame(ftype, payload, max_frame_bytes, flags))
 
 
 def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
@@ -313,7 +348,8 @@ def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
 
 def recv_frame_sock(sock, *, max_frame_bytes: int = DEFAULT_MAX_FRAME,
                     body_timeout_s: float = 30.0):
-    """Blocking frame read; ``None`` on clean EOF at a frame boundary.
+    """Blocking frame read -> ``(ftype, body, flags)``; ``None`` on
+    clean EOF at a frame boundary.
 
     Idle waits between frames raise ``TimeoutError`` (the socket's
     ``settimeout`` tick) so the caller can poll a stop flag; once the
@@ -327,11 +363,11 @@ def recv_frame_sock(sock, *, max_frame_bytes: int = DEFAULT_MAX_FRAME,
     rest = recv_exact_sock(sock, HEADER_SIZE - 1, deadline=deadline)
     if len(rest) != HEADER_SIZE - 1:
         raise FrameError("torn", "EOF mid-header")
-    ftype, length, crc = decode_header(first + rest, max_frame_bytes)
+    ftype, length, crc, flags = decode_header(first + rest, max_frame_bytes)
     payload = recv_exact_sock(sock, length, deadline=deadline)
     if len(payload) != length:
         raise FrameError("torn", "EOF mid-payload")
-    return ftype, _frame_body(ftype, payload, crc)
+    return ftype, _frame_body(ftype, payload, crc), flags
 
 
 # -------------------------------------------------------------- server
@@ -533,10 +569,12 @@ class RpcServer:
                 "credits": conn.credits,
                 "max_frame": cfg.max_frame_bytes,
                 # version negotiation: v2 peers may send columnar
-                # SUBMIT_BATCH frames; v1 clients ignore both keys and
-                # keep speaking per-request SUBMITs unchanged
+                # SUBMIT_BATCH frames, v3 peers may attach trace
+                # context; v1/v2 clients ignore the extra keys and keep
+                # speaking their protocol unchanged
                 "v": RPC_VERSION,
                 "batch": True,
+                "trace": True,
             })
             if self._draining and not conn.goaway_sent:
                 conn.goaway_sent = True
@@ -578,7 +616,7 @@ class RpcServer:
                 return
             if frame is None:
                 return  # client closed cleanly
-            ftype, body = frame
+            ftype, body, flags = frame
             self._count_frame("recv", ftype)
             if ftype == PING:
                 await conn.send(PONG, {"t": body.get("t", 0.0),
@@ -588,6 +626,9 @@ class RpcServer:
             elif ftype == SUBMIT:
                 self._accept_submit(conn, body)
             elif ftype == SUBMIT_BATCH:
+                # trace context rides as a flagged 17-byte prefix on the
+                # raw payload (a poisoned prefix is counted + ignored)
+                ctx, body = split_trace_prefix(body, flags, self.provider)
                 try:
                     batch = self._decode_batch(conn, body)
                 except FrameError as exc:
@@ -597,7 +638,7 @@ class RpcServer:
                     JOURNAL.record("rpc_frame_error", kind=exc.kind,
                                    tms_id=conn.tms_id, detail=str(exc))
                     return
-                self._accept_submit_batch(conn, batch)
+                self._accept_submit_batch(conn, batch, ctx)
             else:
                 self._frame_error("protocol")
 
@@ -623,7 +664,7 @@ class RpcServer:
                               tms=conn.tms_id).add(batch.nbytes)
         return batch
 
-    def _accept_submit_batch(self, conn: _Conn, batch) -> None:
+    def _accept_submit_batch(self, conn: _Conn, batch, ctx=None) -> None:
         """Credit accounting in rows — one columnar frame spends exactly
         what its row count would cost as N legacy SUBMITs, so the
         backpressure semantics are unchanged."""
@@ -632,12 +673,16 @@ class RpcServer:
             self._frame_error("credit_violation")
         conn.credits = max(0, conn.credits - rows)
         self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
-        task = asyncio.ensure_future(self._serve_submit_batch(conn, batch))
+        task = asyncio.ensure_future(
+            self._serve_submit_batch(conn, batch, ctx))
         conn.inflight.add(task)
         task.add_done_callback(conn.inflight.discard)
 
-    async def _serve_submit_batch(self, conn: _Conn, batch) -> None:
+    async def _serve_submit_batch(self, conn: _Conn, batch,
+                                  ctx=None) -> None:
         reply: dict = {"req_id": batch.req_id_base, "status": RPC_OK}
+        if ctx is not None:
+            reply["tc"] = ctx.to_bytes()  # echo for client correlation
         deadline_s = batch.deadline - time.time()
         if deadline_s <= 0:
             self.provider.counter("rpc_deadline_expired_total").add()
@@ -653,14 +698,17 @@ class RpcServer:
                                   kind="range", lane=batch.lane).add()
             try:
                 with self.tracer.span("rpc.serve_batch", rows=batch.n_rows,
-                                      fmt=batch.fmt_name, lane=batch.lane):
+                                      fmt=batch.fmt_name, lane=batch.lane,
+                                      remote_parent=ctx) as ssp:
                     proofs, coms = materialize_rows(batch)
                     offs = batch.deadline_offsets_s
                     results = await self.service.submit_batch(
                         "range", list(zip(proofs, coms)),
                         deadline_s=deadline_s,
                         deadline_offsets_s=offs if offs.any() else None,
-                        lane=batch.lane, tenant=conn.tms_id)
+                        lane=batch.lane, tenant=conn.tms_id,
+                        trace_ctx=ssp.context() if ctx is not None
+                        else None)
                 reply["statuses"] = [r.status for r in results]
                 reply["verdicts"] = [r.accepted for r in results]
                 reply["served_by"] = sorted(
@@ -690,7 +738,13 @@ class RpcServer:
         kind = body.get("kind", "range")
         lane = body.get("lane", LANE_BULK)
         tms_id = str(body.get("tms_id", conn.tms_id))
+        # caller's trace context, if any: v1/v2 peers never send "tc"
+        # (counted as reason=missing), v3 peers send 17 context bytes;
+        # a poisoned value is counted + ignored — never a frame error
+        ctx = extract_wire_context(body.get("tc"), self.provider)
         reply: dict = {"req_id": req_id, "status": RPC_OK}
+        if ctx is not None:
+            reply["tc"] = ctx.to_bytes()  # echo for client correlation
         deadline = body.get("deadline")
         deadline_s = None
         if deadline is not None:
@@ -709,7 +763,7 @@ class RpcServer:
                                   kind=kind, lane=lane).add()
             try:
                 await self._verify_into(reply, kind, lane, deadline_s, body,
-                                        tenant=tms_id)
+                                        tenant=tms_id, ctx=ctx)
             except Exception as exc:  # service-level failure -> typed error
                 reply["status"] = RPC_ERROR
                 reply["error"] = str(exc)
@@ -722,14 +776,16 @@ class RpcServer:
 
     async def _verify_into(self, reply: dict, kind: str, lane: str,
                            deadline_s: float | None, body: dict,
-                           tenant: str = "default") -> None:
+                           tenant: str = "default", ctx=None) -> None:
         svc = self.service
-        with self.tracer.span("rpc.serve", kind=kind, lane=lane):
+        with self.tracer.span("rpc.serve", kind=kind, lane=lane,
+                              remote_parent=ctx) as ssp:
+            tc = ssp.context() if ctx is not None else None
             if kind == "range":
                 proofs, coms = body["payload"]
                 results = await asyncio.gather(*[
                     svc.submit_range(p, c, deadline_s=deadline_s, lane=lane,
-                                     tenant=tenant)
+                                     tenant=tenant, trace_ctx=tc)
                     for p, c in zip(proofs, coms)])
                 reply["statuses"] = [r.status for r in results]
                 reply["verdicts"] = [r.accepted for r in results]
@@ -741,11 +797,12 @@ class RpcServer:
                     asyncio.gather(*[
                         svc.submit_transfer(pr, ins, outs,
                                             deadline_s=deadline_s, lane=lane,
-                                            tenant=tenant)
+                                            tenant=tenant, trace_ctx=tc)
                         for pr, ins, outs in transfers]),
                     asyncio.gather(*[
                         svc.submit_issue(pr, outs, deadline_s=deadline_s,
-                                         lane=lane, tenant=tenant)
+                                         lane=lane, tenant=tenant,
+                                         trace_ctx=tc)
                         for pr, outs in issues]))
                 reply["statuses"] = ([r.status for r in t_res],
                                      [r.status for r in i_res])

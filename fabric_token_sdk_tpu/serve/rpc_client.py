@@ -59,10 +59,11 @@ from ..resilience import RetryPolicy
 from .columnar import (FMT_OPAQUE, FMT_RANGE, encode_submit_batch,
                        opaque_cells, range_cells)
 from .config import LANE_BULK, LANE_INTERACTIVE
-from .rpc import (CREDIT, DEFAULT_MAX_FRAME, FRAME_NAMES, GOAWAY, HELLO,
-                  PING, PONG, RESULT, RPC_OK, RPC_VERSION, SUBMIT,
-                  SUBMIT_BATCH, WELCOME, FrameError, _describe,
-                  recv_frame_sock, send_frame_sock, send_raw_frame_sock)
+from .rpc import (CREDIT, DEFAULT_MAX_FRAME, FLAG_TRACE_CONTEXT,
+                  FRAME_NAMES, GOAWAY, HELLO, PING, PONG, RESULT, RPC_OK,
+                  RPC_VERSION, SUBMIT, SUBMIT_BATCH, WELCOME, FrameError,
+                  _describe, recv_frame_sock, send_frame_sock,
+                  send_raw_frame_sock)
 from .worker import _REMOTE_TRANSIENT_NAMES, WorkerUnavailable
 
 
@@ -126,6 +127,7 @@ class RpcClient:
         #: WELCOME capabilities of the current connection.
         self.server_version = 1
         self.server_batch = False
+        self.server_trace = False
         self.provider = provider or _METRICS
         self.tracer = tracer or _TRACER
         _describe(self.provider)
@@ -188,10 +190,12 @@ class RpcClient:
             raise
         welcome = frame[1]
         t1 = time.time()
-        # capability negotiation: a v1 server omits both keys and the
-        # client keeps the legacy per-request SUBMIT path
+        # capability negotiation: a v1 server omits these keys and the
+        # client keeps the legacy per-request SUBMIT path; only a v3
+        # server (``trace: true``) receives trace-context bytes
         self.server_version = int(welcome.get("v", 1))
         self.server_batch = bool(welcome.get("batch", False))
+        self.server_trace = bool(welcome.get("trace", False))
         self.rtt_s = max(0.0, t1 - t0)
         self.clock_offset_s = welcome.get("t_srv", t1) - (
             t0 + self.rtt_s / 2.0)
@@ -267,7 +271,7 @@ class RpcClient:
             if frame is None:
                 self._conn_lost(gen, "server closed connection")
                 return
-            ftype, body = frame
+            ftype, body, _flags = frame
             self._count_frame("recv", ftype)
             if ftype == RESULT:
                 with self._cv:
@@ -357,10 +361,17 @@ class RpcClient:
             dead = self._dead
         if sock is None or dead:
             raise WorkerUnavailable("rpc connection lost before send")
+        # columnar payloads are raw bytes, so the trace context rides as
+        # a flagged 17-byte prefix instead of a dict key
+        flags = 0
+        sp = self.tracer.current()
+        if sp is not None and self.server_trace:
+            payload = sp.context().to_bytes() + payload
+            flags = FLAG_TRACE_CONTEXT
         try:
             with self._send_lock:
                 send_raw_frame_sock(sock, SUBMIT_BATCH, payload,
-                                    self.max_frame_bytes)
+                                    self.max_frame_bytes, flags)
         except (OSError, ConnectionError, FrameError) as exc:
             self._conn_lost(self._gen, repr(exc))
             raise WorkerUnavailable(f"rpc send failed: {exc!r}") from exc
@@ -372,17 +383,29 @@ class RpcClient:
         self.provider.counter("rpc_batch_bytes_total", role="client",
                               tms=self.tms_id).add(len(payload))
 
+    def _observe_call(self, kind: str, seconds: float, span=None) -> None:
+        """Observe ``rpc_call_seconds`` with the call span's trace id
+        attached as an exemplar, so a slow bucket resolves to a concrete
+        fleet trace (``span_exemplars_total`` counts the attachments)."""
+        exemplar = None
+        if span is not None and span.sampled:
+            exemplar = {"trace_id": f"{span.trace_id:016x}"}
+            self.provider.counter("span_exemplars_total",
+                                  family="rpc_call_seconds").add()
+        self.provider.histogram("rpc_call_seconds", kind=kind).observe(
+            seconds, exemplar=exemplar)
+
     def _call(self, kind: str, payload, rows: int, *,
               lane: str = LANE_BULK, deadline_s: float | None = None):
         budget = deadline_s if deadline_s is not None else self.call_timeout_s
         t_start = time.perf_counter()
-        with self.tracer.span("rpc.call", kind=kind, rows=rows, lane=lane):
+        with self.tracer.span("rpc.call", kind=kind, rows=rows,
+                              lane=lane) as sp:
             try:
                 return self._call_once(kind, payload, rows, lane, budget)
             finally:
-                self.provider.histogram(
-                    "rpc_call_seconds", kind=kind).observe(
-                        time.perf_counter() - t_start)
+                self._observe_call(kind, time.perf_counter() - t_start,
+                                   span=sp)
 
     def _call_once(self, kind, payload, rows, lane, budget):
         self._ensure_conn()
@@ -394,6 +417,12 @@ class RpcClient:
                 "tms_id": self.tms_id, "rows": rows,
                 "deadline": self._wire_deadline(budget),
                 "payload": payload}
+        # inject the open rpc.call span's context so the sidecar's
+        # rpc.serve / serve.request spans join this trace (v3 servers
+        # only; older servers never see the key)
+        sp = self.tracer.current()
+        if sp is not None and self.server_trace:
+            body["tc"] = sp.context().to_bytes()
         hedge_id = None
         with self._cv:
             self._pending[req_id] = slot
@@ -473,15 +502,14 @@ class RpcClient:
                   else self.call_timeout_s)
         t_start = time.perf_counter()
         with self.tracer.span("rpc.call", kind="range_batch", rows=n,
-                              lane=lane):
+                              lane=lane) as sp:
             try:
                 return self._call_batch_once(
                     proofs, coms, n, lane, budget, bits, flags,
                     deadline_off_us, fmt)
             finally:
-                self.provider.histogram(
-                    "rpc_call_seconds", kind="range_batch").observe(
-                        time.perf_counter() - t_start)
+                self._observe_call("range_batch",
+                                   time.perf_counter() - t_start, span=sp)
 
     def _call_batch_once(self, proofs, coms, n, lane, budget, bits,
                          flags, deadline_off_us, fmt):

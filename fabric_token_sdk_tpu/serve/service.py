@@ -411,27 +411,31 @@ class VerificationService:
     # ------------------------------------------------------------- submit
     async def submit_range(self, proof, commitment, *, deadline_s=None,
                            lane: str = LANE_BULK,
-                           tenant: str = "default") -> VerifyResult:
+                           tenant: str = "default",
+                           trace_ctx=None) -> VerifyResult:
         """Verify one range proof against its commitment."""
         return await self._submit(KIND_RANGE, (proof, commitment),
-                                  deadline_s, lane, tenant)
+                                  deadline_s, lane, tenant, trace_ctx)
 
     async def submit_transfer(self, proof_raw, inputs, outputs, *,
                               deadline_s=None, lane: str = LANE_BULK,
-                              tenant: str = "default") -> VerifyResult:
+                              tenant: str = "default",
+                              trace_ctx=None) -> VerifyResult:
         """Verify one transfer action (serialized proof + token vectors)."""
         return await self._submit(KIND_TRANSFER, (proof_raw, inputs, outputs),
-                                  deadline_s, lane, tenant)
+                                  deadline_s, lane, tenant, trace_ctx)
 
     async def submit_issue(self, proof_raw, outputs, *, deadline_s=None,
                            lane: str = LANE_BULK,
-                           tenant: str = "default") -> VerifyResult:
+                           tenant: str = "default",
+                           trace_ctx=None) -> VerifyResult:
         """Verify one issue action (serialized proof + output tokens)."""
         return await self._submit(KIND_ISSUE, (proof_raw, outputs),
-                                  deadline_s, lane, tenant)
+                                  deadline_s, lane, tenant, trace_ctx)
 
     async def _submit(self, kind, payload, deadline_s, lane,
-                      tenant: str = "default") -> VerifyResult:
+                      tenant: str = "default",
+                      trace_ctx=None) -> VerifyResult:
         if not self._running:
             raise RuntimeError("VerificationService is not started")
         now = time.perf_counter()
@@ -441,22 +445,35 @@ class VerificationService:
                             deadline=now + deadline_s, enqueue_t=now,
                             future=asyncio.get_running_loop().create_future(),
                             tenant=tenant)
-        if self.config.trace_every \
+        # a caller-propagated trace context (``trace_ctx``, from the RPC
+        # front door) always gets a serve.request span joined to the
+        # caller's trace — the caller made the sampling decision; the
+        # local trace_every sampler only governs untraced entry points
+        if trace_ctx is not None:
+            req.span = _TRACER.start_span(
+                "serve.request", remote_parent=trace_ctx, kind=kind,
+                lane=lane, req_id=req.req_id,
+                deadline_s=round(deadline_s, 6), tenant=tenant)
+        elif self.config.trace_every \
                 and req.req_id % self.config.trace_every == 0:
             req.span = _TRACER.start_span(
                 "serve.request", kind=kind, lane=lane, req_id=req.req_id,
                 deadline_s=round(deadline_s, 6), tenant=tenant)
+        trace_id = (f"{req.span.trace_id:016x}"
+                    if req.span is not None else None)
         shed = self.admission.admit(req, self.scheduler.lane_depth(lane))
         if shed is not None:
             result = VerifyResult(status=shed)
             JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
-                           req_id=req.req_id, status=shed, tenant=tenant)
+                           req_id=req.req_id, status=shed, tenant=tenant,
+                           trace_id=trace_id)
             self._record_shed_slo(tenant, shed, rows=1)
             self._finish_request_span(req, result)
             return result
         JOURNAL.record(EVENT_REQUEST_ADMITTED, req_kind=kind, lane=lane,
                        req_id=req.req_id,
-                       depth=self.scheduler.lane_depth(lane))
+                       depth=self.scheduler.lane_depth(lane),
+                       trace_id=trace_id)
         if self.wal is not None:
             # durability point: once this line is flushed the request
             # survives a SIGKILL — a successor service replays it
@@ -472,7 +489,8 @@ class VerificationService:
 
     async def submit_batch(self, kind, payloads, *, deadline_s=None,
                            deadline_offsets_s=None, lane: str = LANE_BULK,
-                           tenant: str = "default") -> list[VerifyResult]:
+                           tenant: str = "default",
+                           trace_ctx=None) -> list[VerifyResult]:
         """Admit one columnar frame of ``len(payloads)`` rows at once.
 
         The front-door fast path for SUBMIT_BATCH frames: the whole
@@ -501,6 +519,8 @@ class VerificationService:
                               for i in range(n)]
         else:
             row_deadline_s = [base] * n
+        trace_id = (f"{trace_ctx.trace_id:016x}"
+                    if trace_ctx is not None else None)
         # triage on the frame's LATEST row: if even that one cannot be
         # served in time, the whole frame is a deterministic miss
         shed = self.admission.admit_batch(
@@ -508,12 +528,14 @@ class VerificationService:
             now + max(row_deadline_s), tenant=tenant)
         if shed is not None:
             JOURNAL.record(EVENT_REQUEST_SHED, req_kind=kind, lane=lane,
-                           rows=n, tenant=tenant, status=shed)
+                           rows=n, tenant=tenant, status=shed,
+                           trace_id=trace_id)
             self._record_shed_slo(tenant, shed, rows=n)
             return [VerifyResult(status=shed) for _ in range(n)]
         JOURNAL.record(EVENT_BATCH_ADMITTED, req_kind=kind, lane=lane,
                        rows=n, tenant=tenant,
-                       depth=self.scheduler.lane_depth(lane))
+                       depth=self.scheduler.lane_depth(lane),
+                       trace_id=trace_id)
         wal_id = None
         if self.wal is not None:
             # durability point for the WHOLE frame: one flushed line
@@ -652,10 +674,12 @@ class VerificationService:
                 bspan.add_link(req.span, role="member")
                 req.span.add_link(bspan, role="batch")
         JOURNAL.record(EVENT_BATCH_FORMED, group=group, rows=len(batch),
-                       bucket=bucket, span_id=bspan.span_id)
+                       bucket=bucket, span_id=bspan.span_id,
+                       trace_id=f"{bspan.trace_id:016x}")
         JOURNAL.record(EVENT_DISPATCH_START, group=group,
                        rows=len(batch), bucket=bucket, lane=lane.index,
-                       span_id=bspan.span_id)
+                       span_id=bspan.span_id,
+                       trace_id=f"{bspan.trace_id:016x}")
         outcome = "error"
         try:
             verdicts, served_by = await self._dispatch_resilient(
@@ -670,7 +694,8 @@ class VerificationService:
         finally:
             JOURNAL.record(EVENT_DISPATCH_END, group=group,
                            rows=len(batch), span_id=bspan.span_id,
-                           outcome=outcome)
+                           outcome=outcome,
+                           trace_id=f"{bspan.trace_id:016x}")
             _TRACER.end_span(bspan)
             PROFILER.record_memory_watermark()
 
@@ -713,7 +738,8 @@ class VerificationService:
             JOURNAL.record(
                 EVENT_FALLBACK, group=group, rows=len(batch),
                 why=(f"{type(last_exc).__name__}" if last_exc is not None
-                     else f"breaker {self._breaker.state}"))
+                     else f"breaker {self._breaker.state}"),
+                trace_id=f"{bspan.trace_id:016x}")
             with _TRACER.span("resil.fallback", parent=bspan, group=group,
                               rows=len(batch)):
                 verdicts = await asyncio.get_running_loop().run_in_executor(
@@ -779,9 +805,17 @@ class VerificationService:
             if miss:
                 _METRICS.counter("serve_deadline_miss_total",
                                  where="served").add()
+            exemplar = None
+            if req.span is not None:
+                # bounded exemplar slot: the traced request's id rides
+                # on the bucket its wait time lands in
+                exemplar = {"trace_id": f"{req.span.trace_id:016x}"}
+                _METRICS.counter("span_exemplars_total",
+                                 family="serve_wait_seconds").add()
             _METRICS.histogram(
                 "serve_wait_seconds",
-                lane=req.lane).observe(dispatch_t - req.enqueue_t)
+                lane=req.lane).observe(dispatch_t - req.enqueue_t,
+                                       exemplar=exemplar)
             if self.tenant_slo is not None:
                 # tenant-bounded: only recorded while a TenantSloMonitor
                 # is attached; its max_tenants LRU eviction removes these
@@ -842,11 +876,18 @@ class VerificationService:
         if self.tenant_slo is not None:
             self.tenant_slo.record(req.tenant, ok,
                                    result.total_s if ok else None)
+            exemplar = None
+            if req.span is not None:
+                exemplar = {"trace_id": f"{req.span.trace_id:016x}"}
+                _METRICS.counter(
+                    "span_exemplars_total",
+                    family="serve_tenant_e2e_seconds").add()
             # tenant-bounded: recorded only with a TenantSloMonitor
             # attached; evicted via _evict_tenant_series
             _METRICS.histogram(
                 "serve_tenant_e2e_seconds",
-                tms_id=req.tenant).observe(result.total_s)
+                tms_id=req.tenant).observe(result.total_s,
+                                           exemplar=exemplar)
         if self.wal is not None and req.wal_id is not None:
             open_rows = self._wal_batch_open.get(req.wal_id)
             if open_rows is None:

@@ -59,7 +59,8 @@ def sidecar_main(factory, host: str, port: int, *,
                  rpc: RpcConfig | None = None,
                  tenant_quantum: int = 8,
                  tenant_weights: tuple = (),
-                 beat_interval_s: float = 0.25) -> None:
+                 beat_interval_s: float = 0.25,
+                 obs_spool_dir=None, node: str | None = None) -> None:
     """Child entry point (spawn context: every arg must pickle).
 
     Builds the ZK backend from ``factory``, stands up a WAL-backed
@@ -67,6 +68,12 @@ def sidecar_main(factory, host: str, port: int, *,
     killed predecessor), prewarms, then serves TCP until SIGTERM/SIGINT
     — at which point it drains: GOAWAY to every client, in-flight
     frames finish, service drains, WAL closes.
+
+    With ``obs_spool_dir`` set the child joins the fleet observability
+    plane: its metrics publish via ``SpoolPublisher`` and its finished
+    spans via ``SpanSpoolExporter`` under the ``node`` identity
+    (default ``sidecar-<pid>``), so a parent ``FleetAggregator`` /
+    federated ``/tracez`` can assemble cross-process traces.
     """
     from .service import VerificationService  # deferred: heavy import
 
@@ -96,6 +103,19 @@ def sidecar_main(factory, host: str, port: int, *,
     service = VerificationService(zk, config, resilience=resilience,
                                   wal=wal)
     rpc_config = replace(rpc or RpcConfig(), host=host, port=port)
+    publisher = None
+    span_exporter = None
+    if obs_spool_dir is not None:
+        from ..obs import GLOBAL, TRACER
+        from ..obs.aggregate import SpoolPublisher
+        from ..obs.tracing import SpanSpoolExporter
+
+        node_id = node or f"sidecar-{os.getpid()}"
+        TRACER.node = node_id  # stamp snapshots/incidents with identity
+        publisher = SpoolPublisher(obs_spool_dir, node_id,
+                                   GLOBAL).start()
+        span_exporter = SpanSpoolExporter(obs_spool_dir, node=node_id,
+                                          tracer=TRACER).start()
 
     async def _amain():
         loop = asyncio.get_running_loop()
@@ -118,6 +138,10 @@ def sidecar_main(factory, host: str, port: int, *,
         asyncio.run(_amain())
     finally:
         stop_beats.set()
+        if span_exporter is not None:
+            span_exporter.stop(final_publish=True)
+        if publisher is not None:
+            publisher.stop(final_publish=True)
         if wal is not None:
             wal.close()
         hb.close()
@@ -138,7 +162,8 @@ class RpcSidecar:
                  default_deadline_s: float = 30.0, resilience=None,
                  rpc: RpcConfig | None = None,
                  tenant_quantum: int = 8, tenant_weights: tuple = (),
-                 name: str = "rpc-sidecar", mp_context: str = "spawn"):
+                 name: str = "rpc-sidecar", mp_context: str = "spawn",
+                 obs_spool_dir=None, node: str | None = None):
         self.factory = factory
         self.host = host
         self.port = port if port is not None else pick_free_port(host)
@@ -155,6 +180,8 @@ class RpcSidecar:
         self.tenant_quantum = tenant_quantum
         self.tenant_weights = tuple(tenant_weights)
         self.name = name
+        self.obs_spool_dir = obs_spool_dir
+        self.node = node
         self._ctx = mp.get_context(mp_context)
         self._proc = None
 
@@ -177,6 +204,8 @@ class RpcSidecar:
                 "rpc": self.rpc,
                 "tenant_quantum": self.tenant_quantum,
                 "tenant_weights": self.tenant_weights,
+                "obs_spool_dir": self.obs_spool_dir,
+                "node": self.node,
             },
             name=self.name, daemon=True)
         proc.start()

@@ -39,7 +39,9 @@ import time
 import numpy as np
 
 from ..obs import GLOBAL as _METRICS
+from ..obs import TRACER as _TRACER
 from ..obs.heartbeat import Heartbeat, read_last
+from ..obs.tracing import extract_wire_context
 from ..resilience.retry import TransientError
 
 #: Hard cap on an unconfigured reply wait — "no call timeout" must
@@ -115,13 +117,27 @@ def worker_main(conn, factory, heartbeat_path=None, prewarm_buckets=(),
             if op == "ping":
                 conn.send(("ok", os.getpid()))
             elif op == "range":
-                _, proofs, coms = msg
-                verdicts = np.asarray(zk._range.verify(proofs, coms),
-                                      dtype=bool)
+                # trailing optional element: caller's trace context
+                # bytes (absent from old parents — both directions stay
+                # pickle-compatible); poisoned bytes are counted and
+                # ignored, never an error
+                _, proofs, coms, *rest = msg
+                ctx = (extract_wire_context(rest[0])
+                       if rest and rest[0] is not None else None)
+                with _TRACER.span("rpc.serve", remote_parent=ctx,
+                                  kind="range", transport="pipe",
+                                  rows=len(proofs)):
+                    verdicts = np.asarray(
+                        zk._range.verify(proofs, coms), dtype=bool)
                 conn.send(("ok", verdicts))
             elif op == "block":
-                _, transfers, issues = msg
-                t_ok, i_ok = zk.verify_block(transfers, issues)
+                _, transfers, issues, *rest = msg
+                ctx = (extract_wire_context(rest[0])
+                       if rest and rest[0] is not None else None)
+                with _TRACER.span("rpc.serve", remote_parent=ctx,
+                                  kind="block", transport="pipe",
+                                  rows=len(transfers) + len(issues)):
+                    t_ok, i_ok = zk.verify_block(transfers, issues)
                 conn.send(("ok", (np.asarray(t_ok, dtype=bool),
                                   np.asarray(i_ok, dtype=bool))))
             else:
@@ -284,6 +300,14 @@ class WorkerClient:
         if conn is None or proc is None or not proc.is_alive():
             raise WorkerUnavailable(
                 f"{self.name}: worker process is not running")
+        if op in ("range", "block"):
+            # inject the current span's context as a trailing element so
+            # the child's rpc.serve span joins this trace across the
+            # pipe hop (the child unpacks it via *rest, so a parent
+            # that omits it stays compatible)
+            sp = _TRACER.current()
+            if sp is not None:
+                args = (*args, sp.context().to_bytes())
         t_lock = time.perf_counter()
         with self._io_lock:
             _METRICS.histogram(

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Lint: serve-side spans in frame handlers must join the caller's trace.
+
+The cross-process tracing contract (serve/rpc.py v3) is only useful if
+every server-side span created while handling an RPC frame passes the
+extracted wire context as ``remote_parent=``. A handler that opens
+``tracer.span("rpc.serve", ...)`` WITHOUT the kwarg silently forks a
+fresh trace — the fleet assembly then shows the client's ``rpc.call``
+and the server's work as two unrelated traces, which is exactly the
+regression this lint exists to catch (it passes tests: nothing crashes,
+the trace is just disconnected).
+
+Contract enforced by AST scan:
+
+  - ``serve/rpc.py`` and ``serve/worker.py``: EVERY span creation
+    (``.span(...)`` / ``.start_span(...)``) whose name literal is
+    ``rpc.serve`` or ``rpc.serve_batch`` must carry a
+    ``remote_parent=`` keyword.
+  - ``serve/service.py``: at least one ``serve.request`` creation site
+    must carry ``remote_parent=`` (the trace_ctx-driven branch; the
+    locally-sampled branch legitimately starts its own trace).
+
+Runnable standalone (``python scripts/check_trace_parent.py`` — exits 1
+with the offender list) and imported by tests/test_trace_guard.py as a
+tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SERVE = REPO / "fabric_token_sdk_tpu" / "serve"
+
+#: files whose rpc.serve/rpc.serve_batch spans must ALL be remote-parented
+_STRICT_FILES = ("rpc.py", "worker.py")
+_STRICT_NAMES = ("rpc.serve", "rpc.serve_batch")
+
+
+def _span_calls(tree: ast.AST):
+    """Yield ``(span_name, lineno, has_remote_parent)`` for every
+    ``<obj>.span("name", ...)`` / ``<obj>.start_span("name", ...)``
+    call with a string-literal first argument."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("span", "start_span")):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        has_rp = any(kw.arg == "remote_parent" for kw in node.keywords)
+        yield node.args[0].value, node.lineno, has_rp
+
+
+def find_offenders() -> list[str]:
+    """Human-readable offender list (empty when the contract holds)."""
+    offenders: list[str] = []
+    for fname in _STRICT_FILES:
+        path = SERVE / fname
+        tree = ast.parse(path.read_text())
+        for name, lineno, has_rp in _span_calls(tree):
+            if name in _STRICT_NAMES and not has_rp:
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{lineno}: span "
+                    f"'{name}' created without remote_parent=")
+    svc = SERVE / "service.py"
+    svc_calls = [c for c in _span_calls(ast.parse(svc.read_text()))
+                 if c[0] == "serve.request"]
+    if not svc_calls:
+        offenders.append(f"{svc.relative_to(REPO)}: no 'serve.request' "
+                         "span creation found")
+    elif not any(has_rp for _, _, has_rp in svc_calls):
+        offenders.append(
+            f"{svc.relative_to(REPO)}: no 'serve.request' creation "
+            "site passes remote_parent= (trace_ctx branch missing)")
+    return offenders
+
+
+def main() -> int:
+    offenders = find_offenders()
+    if not offenders:
+        print("check_trace_parent: every serve-side frame-handler span "
+              "joins the caller's trace")
+        return 0
+    print("serve-side spans that fork a fresh trace instead of joining "
+          "the caller's (pass remote_parent=ctx):")
+    for line in offenders:
+        print(f"  {line}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

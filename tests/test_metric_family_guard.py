@@ -164,6 +164,10 @@ STABLE_FAMILIES = (
     "fleet_nodes",
     "fleet_samples",
     "fleet_tenants",
+    # obs/ distributed tracing (cross-process trace plane)
+    "span_exemplars_total",
+    "trace_drops_total",
+    "trace_spans_total",
     # prover/ device proof synthesis + harness corpus
     "prover_chunks_total",
     "prover_corpus_proofs_total",
@@ -247,7 +251,8 @@ def test_tenant_labelled_registrations_carry_bounded_tag():
                                     "txgen_", "resil_", "telemetry_",
                                     "slo_", "profile_", "journal_",
                                     "hb_", "fleet_", "wal_", "crash_",
-                                    "rpc_", "mesh_", "lane_", "prover_"])
+                                    "rpc_", "mesh_", "lane_", "prover_",
+                                    "trace_", "span_"])
 def test_every_stable_prefix_is_covered(prefix):
     # the inventory above must not silently drop a whole subsystem
     assert any(f.startswith(prefix) for f in STABLE_FAMILIES), prefix

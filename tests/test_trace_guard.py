@@ -1,0 +1,49 @@
+"""Tier-1 wrapper around scripts/check_trace_parent.py: every
+serve-side span created while handling an RPC frame (``rpc.serve`` /
+``rpc.serve_batch`` in serve/rpc.py and serve/worker.py, and the
+trace_ctx-driven ``serve.request`` in serve/service.py) must join the
+caller's trace via ``remote_parent=``.
+
+A handler that drops the kwarg does not fail any behavioral test — the
+frame still serves — it just forks a disconnected trace, which only
+shows up when someone stares at a broken /tracez during an incident.
+This test makes the propagation contract part of the suite.
+"""
+
+import importlib.util
+import pathlib
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+           / "check_trace_parent.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_trace_parent",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_frame_handler_spans_join_the_callers_trace():
+    mod = _load()
+    offenders = mod.find_offenders()
+    assert not offenders, (
+        "serve-side frame-handler spans must pass remote_parent=ctx "
+        f"(extracted wire context) so traces join across the hop: "
+        f"{offenders}")
+
+
+def test_linter_sees_the_handler_span_sites():
+    """Guard the guard: the scan must actually find the rpc.serve and
+    serve.request creation sites, or a rename would turn the lint into
+    a silent no-op."""
+    import ast
+    mod = _load()
+    names = set()
+    for fname in ("rpc.py", "worker.py", "service.py"):
+        tree = ast.parse((mod.SERVE / fname).read_text())
+        names.update(n for n, _, _ in mod._span_calls(tree))
+    assert "rpc.serve" in names
+    assert "rpc.serve_batch" in names
+    assert "serve.request" in names

@@ -1,0 +1,403 @@
+"""Cross-process distributed tracing (obs/tracing.py + serve/rpc.py v3).
+
+Covers the whole propagation contract:
+
+  - :class:`SpanContext` wire codec round-trip and strict rejection of
+    poisoned bytes; :func:`extract_wire_context` tolerance (counted
+    drops, never an exception).
+  - ``split_trace_prefix`` on raw SUBMIT_BATCH payloads (flag absent /
+    flag with poisoned prefix / flag with valid prefix).
+  - ``remote_parent=`` joining: a span opened with a caller's context
+    inherits the caller's trace id and lands in the tracer's roots.
+  - End-to-end over loopback TCP (crypto-free :class:`StubZK`): the
+    client's ``rpc.call``, the server's ``rpc.serve`` and the service's
+    ``serve.request`` spans share ONE trace id, and the
+    ``rpc_call_seconds`` exemplar resolves to it.
+  - Poisoned/missing context adversity: truncated bytes, zero ids, a
+    v2 peer sending no context — every frame is SERVED, the drop is a
+    counted ``trace_drops_total{reason}`` increment, and there is never
+    a frame error.
+  - :class:`SpanSpoolExporter` bounded buffer + drop accounting +
+    torn-spool tolerance, and ``assemble_traces`` fleet grouping.
+  - The two-process acceptance path: client -> supervised TCP sidecar
+    with a shared obs spool; the federated ``/tracez`` serves one
+    assembled trace spanning both processes.
+"""
+
+import struct
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.obs import GLOBAL, TRACER
+from fabric_token_sdk_tpu.obs.tracing import (CONTEXT_WIRE_SIZE,
+                                              SpanContext,
+                                              SpanSpoolExporter, Tracer,
+                                              assemble_traces,
+                                              extract_wire_context,
+                                              read_span_spool)
+from fabric_token_sdk_tpu.serve.rpc import (FLAG_TRACE_CONTEXT, RESULT,
+                                            SUBMIT, SUBMIT_BATCH,
+                                            split_trace_prefix)
+
+from test_rpc import (_Harness, _await_count, _batch_payload, _client,
+                      _count, _handshake)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    GLOBAL.reset()
+    TRACER.clear()
+    yield
+    TRACER.clear()
+
+
+# ------------------------------------------------------------ wire codec
+def test_span_context_roundtrip():
+    ctx = SpanContext(trace_id=0xDEADBEEFCAFE, span_id=42, sampled=True)
+    data = ctx.to_bytes()
+    assert len(data) == CONTEXT_WIRE_SIZE == 17
+    back = SpanContext.from_bytes(data)
+    assert back == ctx
+    # the sampled bit survives both ways
+    off = SpanContext(trace_id=7, span_id=9, sampled=False)
+    assert SpanContext.from_bytes(off.to_bytes()).sampled is False
+
+
+@pytest.mark.parametrize("poison", [
+    b"",                                     # empty
+    b"abc",                                  # truncated
+    b"\x00" * 17,                            # zero trace AND span id
+    struct.pack(">QQB", 0, 5, 1),            # zero trace id
+    struct.pack(">QQB", 5, 0, 1),            # zero span id
+    b"\xff" * 18,                            # too long
+    "not-bytes",                             # wrong type entirely
+])
+def test_strict_decode_rejects_poison(poison):
+    with pytest.raises(ValueError):
+        SpanContext.from_bytes(poison)
+
+
+def test_extract_counts_drops_and_never_raises():
+    assert extract_wire_context(None, GLOBAL) is None
+    assert _count("trace_drops_total", reason="missing") == 1
+    assert extract_wire_context(b"short", GLOBAL) is None
+    assert extract_wire_context(b"\x00" * 17, GLOBAL) is None
+    assert _count("trace_drops_total", reason="invalid_context") == 2
+    # a valid context still decodes through the tolerant path
+    ctx = extract_wire_context(SpanContext(3, 4).to_bytes(), GLOBAL)
+    assert ctx == SpanContext(3, 4, sampled=True)
+
+
+def test_split_trace_prefix():
+    payload = b"columnar-bytes-here"
+    # no flag: pass-through, and NOT counted as a drop (v1/v2 frame)
+    ctx, rest = split_trace_prefix(payload, 0, GLOBAL)
+    assert ctx is None and rest == payload
+    assert _count("trace_drops_total") == 0
+    # flag + valid prefix: context off, payload intact
+    wire = SpanContext(11, 22).to_bytes() + payload
+    ctx, rest = split_trace_prefix(wire, FLAG_TRACE_CONTEXT, GLOBAL)
+    assert ctx == SpanContext(11, 22) and rest == payload
+    # flag + short payload: counted, payload untouched
+    ctx, rest = split_trace_prefix(b"tiny", FLAG_TRACE_CONTEXT, GLOBAL)
+    assert ctx is None and rest == b"tiny"
+    assert _count("trace_drops_total", reason="invalid_context") == 1
+
+
+# -------------------------------------------------------- remote parent
+def test_remote_parent_joins_callers_trace():
+    tracer = Tracer(provider=GLOBAL)
+    with tracer.span("rpc.call") as caller:
+        ctx = caller.context()
+    with tracer.span("rpc.serve", remote_parent=ctx) as served:
+        assert served.trace_id == ctx.trace_id
+        assert served.parent_id == ctx.span_id
+        assert served.attributes.get("remote_parent") is True
+    # the remote child is a LOCAL root: its parent object lives in
+    # another process, so /tracez must still export it
+    assert any(sp.name == "rpc.serve" for sp in tracer.root_snapshot())
+    # a LOCAL parent always wins over remote_parent
+    with tracer.span("outer") as outer:
+        with tracer.span("inner", remote_parent=ctx) as inner:
+            assert inner.trace_id == outer.trace_id != ctx.trace_id
+
+
+def test_unsampled_context_propagates_sampled_bit():
+    tracer = Tracer(provider=GLOBAL)
+    ctx = SpanContext(trace_id=5, span_id=6, sampled=False)
+    with tracer.span("rpc.serve", remote_parent=ctx) as sp:
+        assert sp.sampled is False
+
+
+def test_ids_are_epoch_offset_for_cross_process_uniqueness():
+    from fabric_token_sdk_tpu.obs import tracing as t
+    ids = {t._next_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i > t._ID_EPOCH and i < 2 ** 64 for i in ids)
+
+
+# ------------------------------------------- end-to-end over loopback TCP
+def _spans_named(name, minimum=1, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        found = [sp for sp in TRACER.finished if sp.name == name]
+        if len(found) >= minimum:
+            return found
+        time.sleep(0.01)
+    raise AssertionError(f"no {minimum} finished {name!r} span(s); have "
+                         f"{[sp.name for sp in TRACER.finished]}")
+
+
+def test_rpc_call_serve_request_share_one_trace():
+    """The tentpole invariant, in-process: one submit produces client
+    ``rpc.call``, server ``rpc.serve`` and service ``serve.request``
+    spans under a single trace id, with the ``rpc_call_seconds``
+    exemplar resolving to it."""
+    with _Harness() as h:
+        cli = _client(h.address, tms_id="traced")
+        try:
+            out = cli.submit_range([True, False], [None, None])
+            assert out.tolist() == [True, False]
+            assert cli.server_trace is True
+        finally:
+            cli.close()
+        (call,) = _spans_named("rpc.call")
+        (serve,) = _spans_named("rpc.serve")
+        requests = _spans_named("serve.request", minimum=2)
+        assert serve.trace_id == call.trace_id
+        assert serve.parent_id == call.span_id
+        for req_span in requests:
+            assert req_span.trace_id == call.trace_id
+            assert req_span.parent_id == serve.span_id
+        # exemplar: the latency histogram resolves to the fleet trace
+        exemplars = [e for e in GLOBAL.exemplars()
+                     if e["family"] == "rpc_call_seconds"]
+        assert exemplars, GLOBAL.exemplars()
+        assert exemplars[0]["exemplar"]["trace_id"] \
+            == f"{call.trace_id:016x}"
+        assert _count("span_exemplars_total",
+                      family="rpc_call_seconds") >= 1
+        # server-side wait histogram carries the same trace's exemplar
+        waits = [e for e in GLOBAL.exemplars()
+                 if e["family"] == "serve_wait_seconds"]
+        assert waits and waits[0]["exemplar"]["trace_id"] \
+            == f"{call.trace_id:016x}"
+        assert _count("rpc_frame_errors_total") == 0
+
+
+def test_batch_frame_joins_trace_via_flagged_prefix():
+    with _Harness() as h:
+        cli = _client(h.address, tms_id="bt")
+        try:
+            out = cli.submit_range_batch([True, False, True], [None] * 3)
+            assert out.tolist() == [True, False, True]
+        finally:
+            cli.close()
+        (call,) = _spans_named("rpc.call")
+        (serve_b,) = _spans_named("rpc.serve_batch")
+        assert serve_b.trace_id == call.trace_id
+        assert serve_b.parent_id == call.span_id
+        assert _count("rpc_frame_errors_total") == 0
+
+
+# ------------------------------------------------- poisoned context frames
+def _submit_and_get_result(sock, body):
+    from fabric_token_sdk_tpu.serve.rpc import (recv_frame_sock,
+                                                send_frame_sock)
+    send_frame_sock(sock, SUBMIT, body)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        try:
+            frame = recv_frame_sock(sock, body_timeout_s=5.0)
+        except TimeoutError:
+            continue
+        assert frame is not None
+        if frame[0] == RESULT:
+            return frame[1]
+    raise AssertionError("no RESULT frame")
+
+
+@pytest.mark.parametrize("reason,tc", [
+    ("invalid_context", b"abc"),           # truncated context bytes
+    ("invalid_context", b"\x00" * 17),     # zero trace id
+    ("missing", None),                     # v2 peer: no context at all
+])
+def test_poisoned_or_missing_context_is_served_and_counted(reason, tc):
+    """THE adversity contract: bad trace context never fails a frame —
+    the rows verify, the drop is counted, the connection lives."""
+    with _Harness() as h:
+        sock = _handshake(h.address, tms="poison")
+        try:
+            body = {"req_id": 1, "kind": "range", "lane": "bulk",
+                    "rows": 2, "deadline": time.time() + 30.0,
+                    "payload": ([True, False], [None, None])}
+            if tc is not None:
+                body["tc"] = tc
+            reply = _submit_and_get_result(sock, body)
+        finally:
+            sock.close()
+        assert reply["status"] == "ok"
+        assert reply["verdicts"] == [True, False]
+        assert "tc" not in reply  # nothing valid to echo
+        _await_count("trace_drops_total", reason=reason)
+        assert _count("rpc_frame_errors_total") == 0
+
+
+def test_poisoned_batch_prefix_is_served_and_counted():
+    """SUBMIT_BATCH with FLAG_TRACE_CONTEXT but an all-zero (invalid)
+    17-byte prefix: the prefix is stripped + counted, the batch decodes
+    and serves normally."""
+    from fabric_token_sdk_tpu.serve.rpc import (recv_frame_sock,
+                                                send_raw_frame_sock)
+    with _Harness() as h:
+        sock = _handshake(h.address, tms="bpoison")
+        try:
+            payload = b"\x00" * CONTEXT_WIRE_SIZE + _batch_payload()
+            send_raw_frame_sock(sock, SUBMIT_BATCH, payload,
+                                flags=FLAG_TRACE_CONTEXT)
+            deadline = time.monotonic() + 10.0
+            reply = None
+            while time.monotonic() < deadline:
+                try:
+                    frame = recv_frame_sock(sock, body_timeout_s=5.0)
+                except TimeoutError:
+                    continue
+                assert frame is not None
+                if frame[0] == RESULT:
+                    reply = frame[1]
+                    break
+        finally:
+            sock.close()
+        assert reply is not None and reply["status"] == "ok"
+        assert reply["verdicts"] == [True, False]
+        _await_count("trace_drops_total", reason="invalid_context")
+        assert _count("rpc_frame_errors_total") == 0
+
+
+# ------------------------------------------------------- spool exporter
+def test_exporter_bounded_buffer_counts_drops(tmp_path):
+    tracer = Tracer(provider=GLOBAL)
+    exp = SpanSpoolExporter(tmp_path, node="n0", tracer=tracer,
+                            provider=GLOBAL, keep_spans=4)
+    exp.attach()
+    try:
+        for i in range(10):
+            with tracer.span("storm", i=i):
+                pass
+    finally:
+        exp.detach()
+    # ring kept the newest 4; the 6 evictions are counted
+    assert _count("trace_drops_total", reason="buffer") == 6
+    assert _count("trace_spans_total", node="n0") == 10
+    assert exp.publish() == 4
+    records = read_span_spool(tmp_path)
+    assert len(records) == 4
+    assert {r["node"] for r in records} == {"n0"}
+    assert [r["attributes"]["i"] for r in records] == [6, 7, 8, 9]
+
+
+def test_exporter_drops_unsampled_spans(tmp_path):
+    tracer = Tracer(provider=GLOBAL)
+    exp = SpanSpoolExporter(tmp_path, node="n1", tracer=tracer,
+                            provider=GLOBAL)
+    exp.attach()
+    try:
+        ctx = SpanContext(trace_id=9, span_id=8, sampled=False)
+        with tracer.span("quiet", remote_parent=ctx):
+            pass
+    finally:
+        exp.detach()
+    assert _count("trace_drops_total", reason="unsampled") == 1
+    assert exp.publish() == 0
+
+
+def test_spool_reader_skips_torn_lines(tmp_path):
+    good = ('{"node": "n", "name": "s", "trace_id": "ab", '
+            '"span_id": "cd", "parent_id": null, "duration": 0.1, '
+            '"wall_end": 1.0, "attributes": {}}')
+    (tmp_path / "n.spans.jsonl").write_text(
+        good + "\n{torn mid-write\n\nnot json at all\n")
+    (tmp_path / "other.spans.jsonl").write_text("")
+    records = read_span_spool(tmp_path)
+    assert len(records) == 1 and records[0]["trace_id"] == "ab"
+    # a missing directory is an empty fleet, not an error
+    assert read_span_spool(tmp_path / "nope") == []
+
+
+def test_assemble_traces_orders_parent_first():
+    records = [
+        {"trace_id": "t1", "span_id": "c", "parent_id": "b",
+         "name": "serve.request", "wall_end": 3.0},
+        {"trace_id": "t1", "span_id": "a", "parent_id": None,
+         "name": "rpc.call", "wall_end": 5.0},
+        {"trace_id": "t1", "span_id": "b", "parent_id": "a",
+         "name": "rpc.serve", "wall_end": 4.0},
+        {"trace_id": "t2", "span_id": "z", "parent_id": None,
+         "name": "other", "wall_end": 1.0},
+    ]
+    traces = assemble_traces(records)
+    assert set(traces) == {"t1", "t2"}
+    assert [r["name"] for r in traces["t1"]] \
+        == ["rpc.call", "rpc.serve", "serve.request"]
+
+
+# --------------------------------------------- two-process acceptance
+@pytest.mark.slow
+def test_two_process_sidecar_produces_one_federated_trace(tmp_path):
+    """The PR's acceptance path: client -> supervised TCP sidecar
+    (crypto-free StubZK), both publishing spans into one obs spool;
+    the federated /tracez shows ONE trace containing the client's
+    ``rpc.call`` and the sidecar's ``rpc.serve`` + ``serve.request``
+    spans, and the client-side exemplar resolves to that trace."""
+    from fabric_token_sdk_tpu.obs.aggregate import FleetAggregator
+    from fabric_token_sdk_tpu.obs.telemetry import TelemetryServer
+    from fabric_token_sdk_tpu.serve.sidecar import RpcSidecar
+    from fabric_token_sdk_tpu.serve.worker import stub_zk_factory
+
+    spool = tmp_path / "spool"
+    exporter = SpanSpoolExporter(spool, node="client0", tracer=TRACER,
+                                 provider=GLOBAL)
+    exporter.attach()
+    sidecar = RpcSidecar(stub_zk_factory, prewarm=False,
+                         obs_spool_dir=spool, node="sidecar0")
+    sidecar.spawn()
+    cli = _client(sidecar.address, tms_id="e2e")
+    try:
+        cli.wait_ready(timeout_s=180.0)
+        out = cli.submit_range([True, False], [None, None])
+        assert out.tolist() == [True, False]
+    finally:
+        cli.close()
+        exporter.detach()
+    exporter.publish()
+    sidecar.stop()  # SIGTERM -> drain -> final span publish
+
+    (call,) = [sp for sp in TRACER.finished if sp.name == "rpc.call"]
+    trace_hex = f"{call.trace_id:016x}"
+    records = read_span_spool(spool)
+    assert {r["node"] for r in records} >= {"client0", "sidecar0"}
+    traces = assemble_traces(records)
+    assert trace_hex in traces, sorted(traces)
+    names = {r["name"] for r in traces[trace_hex]}
+    assert {"rpc.call", "rpc.serve", "serve.request"} <= names
+    nodes = {r["node"] for r in traces[trace_hex]}
+    assert nodes == {"client0", "sidecar0"}
+
+    # federated /tracez serves the same assembly
+    telemetry = TelemetryServer()
+    telemetry.attach_federator(FleetAggregator(spool))
+    code, ctype, body = telemetry.render("/tracez")
+    assert code == 200 and ctype == "application/json"
+    import json as _json
+    doc = _json.loads(body)
+    assert "traceEvents" in doc  # chrome-trace view is still there
+    assert doc["node"] == TRACER.node
+    assert trace_hex in doc["traces"]
+    assert {r["name"] for r in doc["traces"][trace_hex]} >= {
+        "rpc.call", "rpc.serve", "serve.request"}
+
+    # the latency exemplar resolves to the SAME fleet trace
+    exemplars = [e for e in GLOBAL.exemplars()
+                 if e["family"] == "rpc_call_seconds"]
+    assert exemplars and exemplars[0]["exemplar"]["trace_id"] == trace_hex
