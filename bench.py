@@ -491,7 +491,14 @@ def _bench_frontdoor():
     ingested proofs/s per phase, bytes/proof per wire format and
     per-tenant p99, and asserts the per-client columnar speedup is
     >= BENCH_FRONTDOOR_MIN_SPEEDUP (default 5) with zero
-    rpc_frame_errors_total on the clean run."""
+    rpc_frame_errors_total on the clean run.
+
+    Phase 3 adds a noisy-neighbor arm pair through the per-tenant SLO
+    plane, and phase 4 a C10k connection storm (BENCH_C10K_CONNS
+    open-loop conns, mixed columnar-v4/legacy-v1 dialects, 37 tenants)
+    against n_loops=1 vs n_loops=4 servers — gating on zero parity
+    errors, zero lost requests, zero mid-frame closes, bounded
+    accept->WELCOME p99, and a proofs/s floor on the sharded arm."""
     import asyncio
     import pickle
     import threading
@@ -842,6 +849,235 @@ def _bench_frontdoor():
     assert p99_on <= p99_off * 1.5 + 0.05, (
         f"victim p99 regressed with the tenant shed on: "
         f"{p99_on * 1e3:.1f}ms vs {p99_off * 1e3:.1f}ms off")
+
+    # ---- phase 4: C10k — sharded accept loops under a conn storm ----
+    # BENCH_C10K_CONNS open-loop connections (default 2000, scaled to
+    # the fd budget) dial one server per arm — n_loops=1 (today's
+    # single loop) vs n_loops=4 (sharded) — each speaking either the
+    # columnar v4 dialect (SUBMIT_BATCH in, RESULT_BATCH out) or the
+    # legacy v1 pickled dialect, across 37 tenants. Gates: zero verdict
+    # parity errors, zero lost requests, zero mid-frame closes, accept
+    # ->WELCOME p99 bounded, and the sharded arm's proofs/s at least
+    # BENCH_C10K_MIN_PROOFS_PS (default: the per-client legacy floor
+    # phase 1 established — the PR 12 bar the C10k path must not lose).
+    import resource
+
+    from fabric_token_sdk_tpu.serve.columnar import (FMT_OPAQUE,
+                                                     decode_result_batch,
+                                                     encode_submit_batch,
+                                                     opaque_cells)
+    from fabric_token_sdk_tpu.serve.rpc import (CREDIT, GOAWAY, HELLO,
+                                                RESULT, RESULT_BATCH,
+                                                SUBMIT, SUBMIT_BATCH,
+                                                WELCOME, encode_frame,
+                                                encode_raw_frame,
+                                                read_frame)
+
+    conns_want = int(os.environ.get("BENCH_C10K_CONNS", "2000"))
+    accept_p99_bar = float(
+        os.environ.get("BENCH_C10K_ACCEPT_P99_S", "5.0"))
+    min_pps = float(
+        os.environ.get("BENCH_C10K_MIN_PROOFS_PS", str(per_legacy)))
+    c10k_rows = 16
+    batch_p = [i % 3 != 0 for i in range(c10k_rows)]
+
+    # every conn is 1 client fd + 1 server fd in this process; raise
+    # the soft NOFILE limit toward the hard one, then scale the storm
+    # to whatever budget we actually got
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want_fds = 3 * conns_want + 512
+    if soft < want_fds:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want_fds, hard), hard))
+        except (ValueError, OSError):
+            pass
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    n_conns = min(conns_want, max(64, (soft - 512) // 3))
+    if n_conns < conns_want:
+        print(f"frontdoor bench: C10k scaled to {n_conns} conns "
+              f"(RLIMIT_NOFILE soft={soft})", file=sys.stderr)
+
+    def _c10k_arm(n_loops):
+        # capacity covers the whole storm arriving at once: every conn
+        # submits up to c10k_rows rows before any verdict drains
+        ccfg = ServeConfig(buckets=(16, 256), max_wait_s=0.002,
+                           default_deadline_s=120.0,
+                           queue_capacity=max(16384,
+                                              2 * c10k_rows * n_conns),
+                           max_tenants=64)
+        csvc = VerificationService(StubZK(), config=ccfg)
+        cloop = asyncio.new_event_loop()
+        cthread = threading.Thread(target=cloop.run_forever,
+                                   name="c10k-loop", daemon=True)
+        cthread.start()
+
+        def crun(coro, timeout=300.0):
+            return asyncio.run_coroutine_threadsafe(
+                coro, cloop).result(timeout)
+
+        async def _cboot():
+            await csvc.start(prewarm=False)
+            s = RpcServer(csvc, RpcConfig(n_loops=n_loops,
+                                          conn_credits=4 * c10k_rows))
+            return s, await s.start()
+
+        cserver, caddr = crun(_cboot())
+        errs_before = _fam("rpc_frame_errors_total")
+        accept_lat: list[float] = []
+        stats = {"served": 0, "parity": 0, "lost": 0}
+
+        sub_batch = encode_raw_frame(SUBMIT_BATCH, encode_submit_batch(
+            fmt=FMT_OPAQUE, lane=LANE_BULK, req_id_base=11,
+            deadline=time.time() + 3600.0,
+            proof_cells=opaque_cells(batch_p)))
+        legacy_p = [True, False]
+
+        async def one_conn(i):
+            """One open-loop connection: dial, submit once in its wire
+            dialect, await the verdicts + the credit replenish, close
+            cleanly. Returns (accept_s, rows, parity_ok)."""
+            use_batch = i % 2 == 0
+            tms = f"c10k-{i % 37}"
+            t0 = time.perf_counter()
+            reader, writer = await asyncio.open_connection(*caddr)
+            try:
+                hello = {"tms_id": tms, "t": time.time()}
+                if use_batch:
+                    hello["v"] = 4
+                writer.write(encode_frame(HELLO, hello))
+                await writer.drain()
+                frame = await read_frame(reader, header_timeout_s=60.0,
+                                         body_timeout_s=60.0)
+                if frame is None or frame[0] != WELCOME:
+                    return None, 0, False
+                accept_s = time.perf_counter() - t0
+                if use_batch:
+                    writer.write(sub_batch)
+                    expect = batch_p
+                else:
+                    writer.write(encode_frame(SUBMIT, {
+                        "req_id": 11, "kind": "range",
+                        "rows": len(legacy_p), "tms_id": tms,
+                        "payload": (legacy_p, [None] * len(legacy_p))}))
+                    expect = legacy_p
+                await writer.drain()
+                verdicts, got_credit = None, False
+                while verdicts is None or not got_credit:
+                    frame = await asyncio.wait_for(
+                        read_frame(reader, header_timeout_s=120.0,
+                                   body_timeout_s=120.0), 120.0)
+                    if frame is None:
+                        return accept_s, 0, False
+                    ftype, body, _flags = frame
+                    if ftype == RESULT_BATCH:
+                        rb = decode_result_batch(body)
+                        verdicts = [rb.verdict_value(j)
+                                    for j in range(rb.n_rows)]
+                    elif ftype == RESULT:
+                        verdicts = body.get("verdicts")
+                    elif ftype == CREDIT:
+                        got_credit = verdicts is not None
+                    elif ftype == GOAWAY:
+                        return accept_s, 0, False
+                ok = verdicts == expect
+                return accept_s, len(verdicts), ok
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+
+        async def storm():
+            done = await asyncio.gather(
+                *[one_conn(i) for i in range(n_conns)],
+                return_exceptions=True)
+            for out in done:
+                if isinstance(out, BaseException):
+                    stats["lost"] += 1
+                    continue
+                accept_s, served, ok = out
+                if accept_s is not None:
+                    accept_lat.append(accept_s)
+                if served == 0:
+                    stats["lost"] += 1
+                    continue
+                stats["served"] += served
+                if not ok:
+                    stats["parity"] += 1
+
+        storm_loop = asyncio.new_event_loop()
+        t0 = time.perf_counter()
+        try:
+            storm_loop.run_until_complete(storm())
+        finally:
+            storm_loop.close()
+        wall = time.perf_counter() - t0
+
+        sstat = cserver.status()
+        shard_conns = {k: v["conns"] for k, v in sstat["loops"].items()}
+
+        async def _cdown():
+            await cserver.stop(drain=True)
+            await csvc.stop(drain=True)
+
+        crun(_cdown())
+        cloop.call_soon_threadsafe(cloop.stop)
+        cthread.join(timeout=10.0)
+        cloop.close()
+        lat = sorted(accept_lat) or [0.0]
+        return {
+            "n_loops": n_loops,
+            "conns": n_conns,
+            "proofs_per_sec": stats["served"] / wall,
+            "accept_p99_s": lat[min(len(lat) - 1,
+                                    int(0.99 * len(lat)))],
+            "parity_errors": stats["parity"],
+            "lost": stats["lost"],
+            "midframe_closes": cserver.midframe_closes,
+            "ownership_violations": cserver.ownership_violations,
+            "frame_errors": _fam("rpc_frame_errors_total") - errs_before,
+            "loops_used": sum(1 for v in shard_conns.values() if v > 0
+                              or n_loops == 1),
+        }
+
+    print(f"frontdoor bench: phase 4 — C10k storm, {n_conns} conns, "
+          f"n_loops=1 vs n_loops=4", file=sys.stderr)
+    arm1 = _c10k_arm(1)
+    arm4 = _c10k_arm(4)
+
+    print(json.dumps({
+        "metric": f"frontdoor_c10k_proofs_per_sec_{BIT_LENGTH}bit",
+        "value": round(arm4["proofs_per_sec"], 2),
+        "unit": (f"proofs/s served, {n_conns} mixed batch/legacy conns "
+                 f"(n_loops=4; n_loops=1 arm "
+                 f"{arm1['proofs_per_sec']:.0f}/s; accept p99 "
+                 f"{arm4['accept_p99_s'] * 1e3:.1f}ms vs "
+                 f"{arm1['accept_p99_s'] * 1e3:.1f}ms; parity "
+                 f"{arm4['parity_errors']}/{arm1['parity_errors']}; "
+                 f"lost {arm4['lost']}/{arm1['lost']}; midframe "
+                 f"{arm4['midframe_closes']}/{arm1['midframe_closes']})"),
+    }))
+    for arm in (arm1, arm4):
+        nl = arm["n_loops"]
+        assert arm["parity_errors"] == 0, \
+            f"n_loops={nl}: {arm['parity_errors']} verdict parity errors"
+        assert arm["lost"] == 0, \
+            f"n_loops={nl}: {arm['lost']} lost requests"
+        assert arm["midframe_closes"] == 0, \
+            f"n_loops={nl}: {arm['midframe_closes']} mid-frame closes"
+        assert arm["ownership_violations"] == 0, \
+            f"n_loops={nl}: cross-loop writes detected"
+        assert arm["frame_errors"] == 0, \
+            f"n_loops={nl}: {arm['frame_errors']} frame errors"
+        assert arm["accept_p99_s"] <= accept_p99_bar, (
+            f"n_loops={nl}: accept->WELCOME p99 "
+            f"{arm['accept_p99_s']:.3f}s above the "
+            f"{accept_p99_bar:.1f}s bar")
+    assert arm4["proofs_per_sec"] >= min_pps, (
+        f"C10k sharded arm {arm4['proofs_per_sec']:.0f} proofs/s below "
+        f"the {min_pps:.0f}/s bar")
 
 
 def _bench_prove():

@@ -11,8 +11,9 @@ verdicts bit-identically to the unbatched path. See README "Serving".
 
 from .admission import AdmissionController, TenantShedPolicy
 from .columnar import (FMT_OPAQUE, FMT_RANGE, ColumnarBatch, ColumnarError,
-                       decode_submit_batch, encode_submit_batch,
-                       materialize_rows)
+                       ResultBatch, decode_result_batch,
+                       decode_submit_batch, encode_result_batch,
+                       encode_submit_batch, materialize_rows)
 from .config import LANE_BULK, LANE_INTERACTIVE, LANES, ServeConfig
 from .prewarm import PrewarmManager
 from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
@@ -21,7 +22,7 @@ from .request import (ACTION_KINDS, KIND_ISSUE, KIND_RANGE, KIND_TRANSFER,
                       STATUS_SHED_DEADLINE, STATUS_SHED_QUEUE_FULL,
                       STATUS_SHED_TENANT_SLO, STATUS_SHUTDOWN,
                       VerifyRequest, VerifyResult)
-from .rpc import FrameError, RpcConfig, RpcServer
+from .rpc import FrameError, RpcConfig, RpcServer, ScratchPool
 from .rpc_client import BatchSubmitBuffer, RpcClient
 from .scheduler import GROUPS, BucketScheduler
 from .service import VerificationService
@@ -47,6 +48,7 @@ __all__ = [
     "LANE_INTERACTIVE",
     "LANES",
     "PrewarmManager",
+    "ResultBatch",
     "RpcClient",
     "RpcConfig",
     "RpcServer",
@@ -61,6 +63,7 @@ __all__ = [
     "STATUS_SHED_QUEUE_FULL",
     "STATUS_SHED_TENANT_SLO",
     "STATUS_SHUTDOWN",
+    "ScratchPool",
     "StubZK",
     "TenantShedPolicy",
     "VerificationService",
@@ -71,7 +74,9 @@ __all__ = [
     "WorkerClient",
     "WorkerUnavailable",
     "WriteAheadLog",
+    "decode_result_batch",
     "decode_submit_batch",
+    "encode_result_batch",
     "encode_submit_batch",
     "materialize_rows",
     "pick_free_port",

@@ -38,6 +38,16 @@ Protocol:
       one WAL append, one journal event). Credits are spent in rows,
       same as N legacy SUBMITs. Capability is advertised in WELCOME
       (``v=2, batch=True``); v1 clients never see the type.
+  RESULT_BATCH(columnar payload)   the egress mirror (protocol v4):
+      verdicts completing on one connection coalesce into one
+      CRC-framed columnar frame — req_id/row_idx/status/verdict/
+      served_by columns plus an optional per-row trace column — with
+      zero per-row pickling and ONE batched drain wakeup per cycle
+      instead of a doorbell per result. Sent only to peers whose HELLO
+      carried ``v >= 4``; v1–v3 peers keep per-row pickled RESULT
+      frames, and non-OK replies (expired/goaway/error) and block
+      verdicts stay pickled for every peer (error strings stay
+      expressive, fallback stays trivially correct).
   CREDIT{grant}    credit-based flow control: each connection holds a
       row budget; SUBMIT rows consume it, the server replenishes from
       admission headroom (``queue_capacity`` minus the deepest lane),
@@ -49,6 +59,23 @@ Protocol:
       (asserted by per-connection frame accounting).
   ERROR{...}       protocol-level rejection.
 
+Loop sharding (``RpcConfig.n_loops``): the server runs its own accept
+loop(s) over manually-bound listen sockets. ``n_loops=1`` (default)
+keeps everything on the service's event loop — today's behavior
+exactly. ``n_loops>=2`` starts worker event loops (threads), each
+owning its accepted connections end-to-end (read, decode, write):
+either every shard holds its own SO_REUSEPORT listen socket (the
+kernel load-balances accepts), or — where SO_REUSEPORT is unavailable
+— one acceptor hands accepted sockets to shards round-robin. The
+shared ``VerificationService`` stays on its own loop; shard loops
+reach it through a thread-safe submit handoff
+(``run_coroutine_threadsafe`` + ``wrap_future``), one cross-loop
+completion per *frame*, and results are written back only by the
+connection's owning loop (asserted by an ownership counter). fd
+exhaustion in an accept loop backs off with jitter and counts
+``rpc_accept_shed_total{reason="emfile"}`` instead of tearing the
+acceptor down.
+
 Every read is under an explicit deadline (``asyncio.wait_for``) — a
 hung read with no deadline is how rc=124-with-no-diagnosis comes back
 (enforced by ``scripts/check_socket_timeouts.py``).
@@ -57,8 +84,12 @@ hung read with no deadline is how rc=124-with-no-diagnosis comes back
 from __future__ import annotations
 
 import asyncio
+import errno
 import pickle
+import random
+import socket
 import struct
+import threading
 import time
 import zlib
 from dataclasses import dataclass
@@ -67,7 +98,8 @@ from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
 from ..obs.journal import JOURNAL
 from ..obs.tracing import CONTEXT_WIRE_SIZE, extract_wire_context
-from .columnar import ColumnarError, decode_submit_batch, materialize_rows
+from .columnar import (ColumnarError, decode_submit_batch,
+                       encode_result_batch, materialize_rows)
 from .config import LANE_BULK, LANES
 from .request import STATUS_OK
 
@@ -86,24 +118,29 @@ PONG = 7
 GOAWAY = 8
 ERROR = 9
 SUBMIT_BATCH = 10
+RESULT_BATCH = 11
 
 FRAME_NAMES = {
     HELLO: "hello", WELCOME: "welcome", SUBMIT: "submit", RESULT: "result",
     CREDIT: "credit", PING: "ping", PONG: "pong", GOAWAY: "goaway",
     ERROR: "error", SUBMIT_BATCH: "submit_batch",
+    RESULT_BATCH: "result_batch",
 }
 
 #: Frame types whose payload is raw bytes (CRC-checked, never pickled);
 #: everything else stays a pickled dict.
-RAW_PAYLOAD_TYPES = frozenset({SUBMIT_BATCH})
+RAW_PAYLOAD_TYPES = frozenset({SUBMIT_BATCH, RESULT_BATCH})
 
 #: Protocol version advertised in WELCOME: 2 adds SUBMIT_BATCH, 3 adds
 #: wire-propagated trace context (SpanContext in SUBMIT/RESULT bodies
 #: under key ``"tc"``; a 17-byte prefix on SUBMIT_BATCH payloads when
-#: the FLAG_TRACE_CONTEXT header flag is set). v1/v2 peers stay wire
-#: compatible: they never set the flag or the key, and a server never
-#: requires either — missing context is counted, never an error.
-RPC_VERSION = 3
+#: the FLAG_TRACE_CONTEXT header flag is set), 4 adds columnar
+#: RESULT_BATCH egress — the server coalesces OK range verdicts into
+#: columnar frames for peers whose HELLO carried ``v >= 4``. v1–v3
+#: peers stay wire compatible: the server answers them with per-row
+#: pickled RESULT frames, and a server never requires any of the newer
+#: capabilities — missing context/version is counted, never an error.
+RPC_VERSION = 4
 
 #: Header flag bit: the payload begins with a 17-byte trace context
 #: (only meaningful on RAW_PAYLOAD_TYPES frames; pickled bodies carry
@@ -166,6 +203,28 @@ _RPC_FAMILIES = {
     "rpc_tenant_deficit":
         "Deficit-round-robin credit currently held by a tenant's "
         "admission queue (rows it may drain before rotating).",
+    # ---- C10k front door (loop sharding + columnar egress) ----
+    "rpc_loops":
+        "Serving event loops (accept/IO shards) the RPC server runs.",
+    "rpc_conns":
+        "RPC connections currently owned by one serving loop, by loop "
+        "index.",
+    "rpc_wakeups_total":
+        "Coalesced egress drain wakeups: one per drain cycle, however "
+        "many completed verdicts the cycle flushes (the doorbell-per-"
+        "result this replaces would count once per row).",
+    "rpc_result_batch_frames_total":
+        "Columnar RESULT_BATCH frames moved, by role (server/client).",
+    "rpc_result_batch_rows_total":
+        "Verdict rows carried by columnar RESULT_BATCH frames, by "
+        "role.",
+    "rpc_result_batch_bytes_total":
+        "Payload bytes carried by columnar RESULT_BATCH frames, by "
+        "role.",
+    "rpc_accept_shed_total":
+        "Accept-loop sheds by reason: emfile (fd exhaustion — the "
+        "acceptor backs off with jitter instead of spinning or dying), "
+        "error (other transient accept failures).",
 }
 
 
@@ -180,6 +239,65 @@ class FrameError(Exception):
 def _describe(provider) -> None:
     for fam, help_text in _RPC_FAMILIES.items():
         provider.describe(fam, help_text)
+
+
+class ScratchPool:
+    """Thread-safe, size-classed pool of mutable scratch bytearrays.
+
+    Steady-state serving reads and encodes thousands of frames per
+    second; allocating a fresh bytearray per frame is pure allocator
+    churn. ``acquire(n)`` returns a bytearray of at least ``n`` bytes
+    (rounded up to a power-of-two size class, floor 4 KiB);
+    ``release`` returns it for reuse, keeping at most ``max_per_class``
+    buffers per class so a burst of giant frames cannot pin memory
+    forever. Buffers are *scratch*: contents are undefined on acquire,
+    and callers must copy out (``bytes(view)``) anything that outlives
+    the release — frame payloads handed to zero-copy decoders are
+    immutable ``bytes`` for exactly this reason.
+    """
+
+    _MIN_CLASS = 4096
+
+    def __init__(self, max_per_class: int = 32,
+                 max_class_bytes: int = DEFAULT_MAX_FRAME):
+        self._lock = threading.Lock()
+        self._classes: dict[int, list[bytearray]] = {}
+        self._max_per_class = max_per_class
+        self._max_class_bytes = max_class_bytes
+        self.hits = 0
+        self.misses = 0
+
+    def _class_of(self, n: int) -> int:
+        size = max(self._MIN_CLASS, 1 << max(0, (n - 1).bit_length()))
+        return size
+
+    def acquire(self, n: int) -> bytearray:
+        size = self._class_of(n)
+        if size > self._max_class_bytes:
+            # beyond the pooled range: plain allocation, never cached
+            self.misses += 1
+            return bytearray(size)
+        with self._lock:
+            bucket = self._classes.get(size)
+            if bucket:
+                self.hits += 1
+                return bucket.pop()
+            self.misses += 1
+        return bytearray(size)
+
+    def release(self, buf: bytearray) -> None:
+        size = len(buf)
+        if size != self._class_of(size) or size > self._max_class_bytes:
+            return  # not one of ours (or oversize): let the GC have it
+        with self._lock:
+            bucket = self._classes.setdefault(size, [])
+            if len(bucket) < self._max_per_class:
+                bucket.append(buf)
+
+
+#: Process-wide scratch pool shared by the sync recv path and the
+#: server's RESULT_BATCH encode staging.
+_SCRATCH = ScratchPool()
 
 
 # --------------------------------------------------------------- codec
@@ -323,27 +441,32 @@ def recv_exact_sock(sock, n: int, *, deadline: float | None = None) -> bytes:
     ``FrameError("slow_frame")`` when the deadline passes mid-buffer.
     The socket must carry a finite ``settimeout`` so each recv ticks.
     """
-    buf = bytearray(n)
+    buf = _SCRATCH.acquire(n)
     view = memoryview(buf)
-    got = 0
-    while got < n:
-        if deadline is not None and time.monotonic() >= deadline:
-            raise FrameError("slow_frame",
-                             f"{got}/{n}B before deadline")
-        try:
-            # recv_into the preallocated buffer: no per-chunk bytes
-            # objects, which matters at columnar batch-frame sizes
-            k = sock.recv_into(view[got:])  # io-deadline: settimeout tick
-        except TimeoutError:
-            if not got and deadline is None:
-                raise  # idle tick between frames: caller's checkpoint
-            continue
-        if not k:
-            if not got:
-                return b""
-            raise FrameError("torn", f"EOF after {got}/{n}B")
-        got += k
-    return bytes(buf)
+    try:
+        got = 0
+        while got < n:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise FrameError("slow_frame",
+                                 f"{got}/{n}B before deadline")
+            try:
+                # recv_into pooled scratch: no per-chunk bytes objects
+                # and no per-frame bytearray churn, which matters at
+                # columnar batch-frame sizes
+                k = sock.recv_into(view[got:n])  # io-deadline: settimeout tick
+            except TimeoutError:
+                if not got and deadline is None:
+                    raise  # idle tick between frames: caller's checkpoint
+                continue
+            if not k:
+                if not got:
+                    return b""
+                raise FrameError("torn", f"EOF after {got}/{n}B")
+            got += k
+        return bytes(view[:n])
+    finally:
+        view.release()
+        _SCRATCH.release(buf)
 
 
 def recv_frame_sock(sock, *, max_frame_bytes: int = DEFAULT_MAX_FRAME,
@@ -384,17 +507,57 @@ class RpcConfig:
     write_timeout_s: float = 30.0      # drain() cap per frame
     conn_credits: int = 1024           # per-connection row-budget ceiling
     drain_timeout_s: float = 30.0      # stop(): cap on finishing in-flight
+    n_loops: int = 1                   # accept/IO event loops (threads);
+    #                                    1 = serve on the service's loop,
+    #                                    today's behavior exactly
+    accept_backoff_s: float = 0.05     # EMFILE: initial jittered backoff
+    accept_backoff_cap_s: float = 1.0  # EMFILE: backoff ceiling
+
+
+#: Accept-loop errnos that mean fd/buffer exhaustion — shed + back off
+#: with jitter; anything else transient counts as reason="error".
+_FD_PRESSURE_ERRNOS = frozenset(
+    getattr(errno, name) for name in
+    ("EMFILE", "ENFILE", "ENOBUFS", "ENOMEM") if hasattr(errno, name))
+
+
+class _LoopShard:
+    """One serving event loop. Shard 0 runs on the loop ``start()`` was
+    awaited on (the service's loop — so ``n_loops=1`` reproduces the
+    single-loop server exactly); higher shards each run their own loop
+    on a daemon thread and own their accepted connections end-to-end
+    (read, decode, write)."""
+
+    def __init__(self, index: int, loop, thread=None):
+        self.index = index
+        self.loop = loop
+        self.thread = thread           # None for shard 0
+        self.accept_task = None        # Task or concurrent Future
+        self.listen_sock = None        # None for handoff-fed shards
+        self.n_conns = 0               # guarded by server._conns_lock
 
 
 class _Conn:
-    """Per-connection state: credits, write lock, frame accounting."""
+    """Per-connection state: credits, write lock, frame accounting.
 
-    def __init__(self, server: "RpcServer", reader, writer, cid: int):
+    A connection is owned end-to-end by exactly ONE event loop
+    (``self.loop``, the shard it was accepted onto); every write must
+    run on that loop — ``send``/``send_raw`` assert it by bumping the
+    server's ``ownership_violations`` counter on a mismatch.
+    ``_egress`` / ``_drain_scheduled`` implement coalesced RESULT_BATCH
+    egress and are touched only from the owning loop (no lock needed).
+    """
+
+    def __init__(self, server: "RpcServer", reader, writer, cid: int,
+                 loop, shard_index: int = 0):
         self.server = server
         self.reader = reader
         self.writer = writer
         self.cid = cid
+        self.loop = loop
+        self.shard_index = shard_index
         self.tms_id = "unknown"
+        self.peer_version = 1          # from HELLO "v"; absent = v1
         self.credits = 0               # server-side view of client budget
         self.write_lock = asyncio.Lock()
         self.frames_started = 0        # writes begun (header bytes queued)
@@ -402,10 +565,20 @@ class _Conn:
         self.inflight: set[asyncio.Task] = set()
         self.goaway_sent = False
         self.closing = False
+        self._egress: list = []        # queued verdict rows awaiting drain
+        self._drain_scheduled = False  # one drain task (= wakeup) at a time
 
-    async def send(self, ftype: int, body: dict) -> None:
+    def _check_owner(self) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:           # not on any loop at all
+            running = None
+        if running is not self.loop:
+            self.server.ownership_violations += 1
+
+    async def _send_bytes(self, ftype: int, buf: bytes) -> None:
         cfg = self.server.config
-        buf = encode_frame(ftype, body, cfg.max_frame_bytes)
+        self._check_owner()
         async with self.write_lock:
             if self.closing:
                 raise ConnectionResetError("connection closing")
@@ -415,14 +588,29 @@ class _Conn:
             self.frames_done += 1
         self.server._count_frame("sent", ftype)
 
+    async def send(self, ftype: int, body: dict) -> None:
+        await self._send_bytes(
+            ftype, encode_frame(ftype, body, self.server.config.max_frame_bytes))
+
+    async def send_raw(self, ftype: int, payload: bytes,
+                       flags: int = 0) -> None:
+        await self._send_bytes(
+            ftype, encode_raw_frame(ftype, payload,
+                                    self.server.config.max_frame_bytes, flags))
+
 
 class RpcServer:
     """Streaming TCP front door over a running ``VerificationService``.
 
-    Single event loop, shared with the service's dispatch loop. Start
-    the service first, then ``await server.start()``; ``stop()`` is a
-    draining stop: GOAWAY to every connection, in-flight frames finish,
-    no connection is closed mid-frame (``frames_clean`` asserts it).
+    Start the service first, then ``await server.start()`` on the
+    service's loop. With ``n_loops=1`` everything runs on that loop —
+    the single-loop server, unchanged. With ``n_loops>=2`` the server
+    starts worker event loops (threads), each owning its accepted
+    connections end-to-end; submits reach the shared service through a
+    thread-safe handoff (one cross-loop completion per frame).
+    ``stop()`` is a draining stop across every shard: GOAWAY to every
+    connection on its owning loop, in-flight frames finish, no
+    connection is closed mid-frame (``frames_clean`` asserts it).
     """
 
     def __init__(self, service, config: RpcConfig | None = None, *,
@@ -432,47 +620,214 @@ class RpcServer:
         self.provider = provider or _METRICS
         self.tracer = tracer or _TRACER
         _describe(self.provider)
-        self._server: asyncio.base_events.Server | None = None
         self._conns: dict[int, _Conn] = {}
+        self._conns_lock = threading.Lock()
         self._next_cid = 0
         self._draining = False
+        self._stopped = False
         self.midframe_closes = 0
+        self.ownership_violations = 0  # writes attempted off-owner-loop
         self.address: tuple[str, int] | None = None
+        self._shards: list[_LoopShard] = []
+        self._service_loop = None
+        self._handoff = False          # single acceptor feeding all shards
+        self._rr = 0                   # handoff round-robin cursor
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(
-            self._handle, self.config.host, self.config.port,
-            reuse_address=True)
-        sockname = self._server.sockets[0].getsockname()
+        cfg = self.config
+        self._draining = False
+        self._stopped = False
+        loop = asyncio.get_running_loop()
+        # submits must run where the service's queues/tasks live; the
+        # service records its loop at start(), and start() here is
+        # documented to run on that same loop (shard 0 reuses it)
+        self._service_loop = getattr(self.service, "loop", None) or loop
+        n = max(1, int(cfg.n_loops))
+        self._shards = [_LoopShard(0, loop)]
+        for i in range(1, n):
+            shard_loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=shard_loop.run_forever,
+                name=f"rpc-loop-{i}", daemon=True)
+            thread.start()
+            self._shards.append(_LoopShard(i, shard_loop, thread))
+        socks = self._bind_sockets(n)
+        if len(socks) == n:
+            # one SO_REUSEPORT listen socket per shard: the kernel
+            # load-balances accepts, no cross-loop handoff at all
+            for shard, lsock in zip(self._shards, socks):
+                shard.listen_sock = lsock
+                shard.accept_task = self._spawn_accept(shard, lsock)
+        else:
+            # SO_REUSEPORT unavailable: shard 0 accepts on the single
+            # socket and hands sockets to shards round-robin
+            self._handoff = n > 1
+            self._shards[0].listen_sock = socks[0]
+            self._shards[0].accept_task = self._spawn_accept(
+                self._shards[0], socks[0])
+        sockname = socks[0].getsockname()
         self.address = (sockname[0], sockname[1])
-        JOURNAL.record("rpc_listen", addr=f"{sockname[0]}:{sockname[1]}")
+        self._pretouch_metrics()
+        JOURNAL.record("rpc_listen", addr=f"{sockname[0]}:{sockname[1]}",
+                       loops=n, handoff=self._handoff)
         return self.address
 
+    def _bind_sockets(self, n: int) -> list:
+        """Bind the listen socket(s): ``n`` SO_REUSEPORT sockets on one
+        port when the platform allows it, else one plain socket (the
+        caller falls back to handoff accepts)."""
+        cfg = self.config
+
+        def mk(port: int, reuse_port: bool):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                if reuse_port:
+                    sock.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                sock.bind((cfg.host, port))
+                sock.listen(4096)
+                sock.setblocking(False)
+            except OSError:
+                sock.close()
+                raise
+            return sock
+
+        if n <= 1 or not hasattr(socket, "SO_REUSEPORT"):
+            return [mk(cfg.port, False)]
+        try:
+            first = mk(cfg.port, True)
+        except OSError:
+            return [mk(cfg.port, False)]
+        port = first.getsockname()[1]
+        socks = [first]
+        try:
+            for _ in range(1, n):
+                socks.append(mk(port, True))
+        except OSError:
+            for sock in socks[1:]:
+                sock.close()
+            return [first]
+        return socks
+
+    def _spawn_accept(self, shard: _LoopShard, lsock):
+        """Start the accept loop as a Task on the shard's own loop (so
+        stop() can cancel it there and await its unwind)."""
+        if shard.loop is asyncio.get_running_loop():
+            return asyncio.ensure_future(self._accept_loop(shard, lsock))
+
+        async def _mk():
+            return asyncio.ensure_future(self._accept_loop(shard, lsock))
+
+        # brief block: one call_soon round-trip on a just-started loop
+        return asyncio.run_coroutine_threadsafe(
+            _mk(), shard.loop).result(5.0)
+
+    def _pretouch_metrics(self) -> None:
+        """Instantiate the C10k families at zero so ``prometheus_text``
+        exports them (with HELP) before the first event."""
+        self.provider.gauge("rpc_loops").set(len(self._shards))
+        for shard in self._shards:
+            self.provider.gauge("rpc_conns", loop=str(shard.index)).set(0)
+        self.provider.counter("rpc_wakeups_total").add(0)
+        for reason in ("emfile", "error"):
+            self.provider.counter(
+                "rpc_accept_shed_total", reason=reason).add(0)
+        for fam in ("rpc_result_batch_frames_total",
+                    "rpc_result_batch_rows_total",
+                    "rpc_result_batch_bytes_total"):
+            self.provider.counter(fam, role="server").add(0)
+
     async def stop(self, drain: bool = True) -> None:
-        """Draining stop: GOAWAY, finish in-flight, close clean."""
+        """Draining stop across every loop shard. Idempotent — a second
+        stop (e.g. a supervisor racing a test harness teardown) must not
+        trip over already-closed shard loops."""
+        if self._stopped:
+            return
+        self._stopped = True
         self._draining = True
-        if self._server is not None:
-            self._server.close()
-        conns = list(self._conns.values())
-        for conn in conns:
-            if not conn.goaway_sent:
-                conn.goaway_sent = True
+        here = asyncio.get_running_loop()
+
+        async def _reap(task):
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+        reaps = []
+        for shard in self._shards:
+            task, shard.accept_task = shard.accept_task, None
+            if task is None:
+                continue
+            if shard.loop is here:
+                task.cancel()
+                reaps.append(asyncio.ensure_future(_reap(task)))
+            else:
+                shard.loop.call_soon_threadsafe(task.cancel)
+                reaps.append(asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(
+                        _reap(task), shard.loop)))
+        if reaps:
+            await asyncio.wait(reaps, timeout=5.0)
+        for shard in self._shards:
+            if shard.listen_sock is not None:
                 try:
-                    await conn.send(GOAWAY, {"reason": "draining"})
-                    self.provider.counter(
-                        "rpc_goaways_total", role="server").add()
-                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    shard.listen_sock.close()
+                except OSError:
                     pass
-        if drain:
-            pending = [t for c in conns for t in list(c.inflight)]
-            if pending:
-                await asyncio.wait(
-                    pending, timeout=self.config.drain_timeout_s)
+                shard.listen_sock = None
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        here = asyncio.get_running_loop()
+        waits = []
         for conn in conns:
-            await self._close_conn(conn)
-        if self._server is not None:
-            await self._server.wait_closed()
+            if conn.loop is here:
+                waits.append(asyncio.ensure_future(
+                    self._finish_conn(conn, drain)))
+            else:
+                waits.append(asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(
+                        self._finish_conn(conn, drain), conn.loop)))
+        if waits:
+            await asyncio.wait(
+                waits, timeout=self.config.drain_timeout_s + 10.0)
+        for shard in self._shards:
+            if shard.thread is None:
+                continue
+            shard.loop.call_soon_threadsafe(shard.loop.stop)
+            shard.thread.join(5.0)
+            if not shard.thread.is_alive():
+                shard.loop.close()
+
+    async def _finish_conn(self, conn: _Conn, drain: bool) -> None:
+        """Drain one connection — runs on the connection's owning loop."""
+        if not conn.goaway_sent and not conn.closing:
+            conn.goaway_sent = True
+            try:
+                await conn.send(GOAWAY, {"reason": "draining"})
+                self.provider.counter(
+                    "rpc_goaways_total", role="server").add()
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+        if drain:
+            await self._await_inflight(conn, self.config.drain_timeout_s)
+        await self._close_conn(conn)
+
+    @staticmethod
+    async def _await_inflight(conn: _Conn, timeout_s: float) -> None:
+        """Wait until the connection's inflight set drains, re-snapshotting
+        as completing tasks spawn follow-on work — a finishing SUBMIT
+        queues egress rows and schedules a coalesced drain task, so a
+        one-shot wait on a stale snapshot would close the connection
+        while that drain task is mid-write."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while conn.inflight:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return
+            await asyncio.wait(list(conn.inflight), timeout=remaining)
 
     @property
     def frames_clean(self) -> bool:
@@ -481,21 +836,95 @@ class RpcServer:
 
     def status(self) -> dict:
         """``/statusz`` payload: connections, credits, accounting."""
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            loops = {
+                str(s.index): {
+                    "conns": s.n_conns,
+                    "alive": (s.thread.is_alive()
+                              if s.thread is not None else True),
+                    "accepting": s.listen_sock is not None,
+                }
+                for s in self._shards
+            }
         return {
             "address": list(self.address) if self.address else None,
             "draining": self._draining,
+            "loops": loops,
+            "handoff": self._handoff,
+            "ownership_violations": self.ownership_violations,
             "connections": {
                 str(c.cid): {
                     "tms_id": c.tms_id,
+                    "loop": c.shard_index,
+                    "v": c.peer_version,
                     "credits": c.credits,
                     "inflight": len(c.inflight),
                     "frames_started": c.frames_started,
                     "frames_done": c.frames_done,
                 }
-                for c in self._conns.values()
+                for c in conns
             },
             "midframe_closes": self.midframe_closes,
         }
+
+    # ------------------------------------------------------------- accept
+    async def _accept(self, loop, lsock):
+        """Accept one connection (seam for fd-exhaustion fault tests)."""
+        return await loop.sock_accept(lsock)  # io-deadline: cancelled by stop()
+
+    async def _accept_loop(self, shard: _LoopShard, lsock) -> None:
+        """Accept until cancelled. fd exhaustion (EMFILE and friends)
+        backs off with jitter and counts a shed instead of spinning the
+        acceptor hot or tearing it down."""
+        cfg = self.config
+        backoff = cfg.accept_backoff_s
+        while not self._draining:
+            try:
+                sock, _addr = await self._accept(shard.loop, lsock)
+            except asyncio.CancelledError:
+                raise
+            except OSError as exc:
+                if self._draining:
+                    return
+                reason = ("emfile" if exc.errno in _FD_PRESSURE_ERRNOS
+                          else "error")
+                self.provider.counter(
+                    "rpc_accept_shed_total", reason=reason).add()
+                JOURNAL.record("rpc_accept_shed", reason=reason,
+                               loop=shard.index, detail=str(exc))
+                await asyncio.sleep(
+                    random.uniform(backoff / 2, backoff))
+                backoff = min(backoff * 2, cfg.accept_backoff_cap_s)
+                continue
+            backoff = cfg.accept_backoff_s
+            target = self._pick_shard(shard)
+            if target.loop is shard.loop:
+                asyncio.ensure_future(self._adopt(target, sock))
+            else:
+                asyncio.run_coroutine_threadsafe(
+                    self._adopt(target, sock), target.loop)
+
+    def _pick_shard(self, shard: _LoopShard) -> _LoopShard:
+        """Owning shard for a just-accepted socket: the accepting shard
+        itself (SO_REUSEPORT mode) or round-robin (handoff mode)."""
+        if not self._handoff or len(self._shards) == 1:
+            return shard
+        self._rr += 1
+        return self._shards[self._rr % len(self._shards)]
+
+    async def _adopt(self, shard: _LoopShard, sock) -> None:
+        """Wrap an accepted socket into streams on the owning shard's
+        loop and serve it there end-to-end."""
+        try:
+            reader, writer = await asyncio.open_connection(sock=sock)
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        await self._handle(reader, writer, shard)
 
     # ------------------------------------------------------------- metrics
     def _count_frame(self, direction: str, ftype: int) -> None:
@@ -533,11 +962,16 @@ class RpcServer:
 
     # ---------------------------------------------------------- connection
     async def _handle(self, reader: asyncio.StreamReader,
-                      writer: asyncio.StreamWriter) -> None:
+                      writer: asyncio.StreamWriter,
+                      shard: _LoopShard | None = None) -> None:
         cfg = self.config
-        cid = self._next_cid
-        self._next_cid += 1
-        conn = _Conn(self, reader, writer, cid)
+        if shard is None:
+            shard = self._shards[0] if self._shards else _LoopShard(
+                0, asyncio.get_running_loop())
+        with self._conns_lock:
+            cid = self._next_cid
+            self._next_cid += 1
+        conn = _Conn(self, reader, writer, cid, shard.loop, shard.index)
         try:
             frame = await read_frame(
                 reader, max_frame_bytes=cfg.max_frame_bytes,
@@ -555,11 +989,20 @@ class RpcServer:
             return
         hello = frame[1]
         conn.tms_id = str(hello.get("tms_id", "default"))
+        try:
+            conn.peer_version = int(hello.get("v", 1))
+        except (TypeError, ValueError):
+            conn.peer_version = 1
         conn.credits = self._credit_target()
-        self._conns[cid] = conn
+        with self._conns_lock:
+            self._conns[cid] = conn
+            shard.n_conns += 1
+            n_active = len(self._conns)
         self.provider.counter("rpc_connections_total",
                               tms=conn.tms_id).add()
-        self.provider.gauge("rpc_connections_active").set(len(self._conns))
+        self.provider.gauge("rpc_connections_active").set(n_active)
+        self.provider.gauge(
+            "rpc_conns", loop=str(shard.index)).set(shard.n_conns)
         self.provider.gauge("rpc_credits", tms=conn.tms_id).set(conn.credits)
         self._count_frame("recv", HELLO)
         try:
@@ -570,7 +1013,8 @@ class RpcServer:
                 "max_frame": cfg.max_frame_bytes,
                 # version negotiation: v2 peers may send columnar
                 # SUBMIT_BATCH frames, v3 peers may attach trace
-                # context; v1/v2 clients ignore the extra keys and keep
+                # context, v4 peers receive columnar RESULT_BATCH
+                # egress; older clients ignore the extra keys and keep
                 # speaking their protocol unchanged
                 "v": RPC_VERSION,
                 "batch": True,
@@ -585,13 +1029,15 @@ class RpcServer:
         except (ConnectionError, OSError, asyncio.TimeoutError):
             pass
         finally:
-            if conn.inflight:
-                await asyncio.wait(list(conn.inflight),
-                                   timeout=cfg.drain_timeout_s)
+            await self._await_inflight(conn, cfg.drain_timeout_s)
             await self._close_conn(conn)
-            self._conns.pop(cid, None)
+            with self._conns_lock:
+                if self._conns.pop(cid, None) is not None:
+                    shard.n_conns -= 1
+                n_active = len(self._conns)
+            self.provider.gauge("rpc_connections_active").set(n_active)
             self.provider.gauge(
-                "rpc_connections_active").set(len(self._conns))
+                "rpc_conns", loop=str(shard.index)).set(shard.n_conns)
 
     async def _read_loop(self, conn: _Conn) -> None:
         cfg = self.config
@@ -678,6 +1124,113 @@ class RpcServer:
         conn.inflight.add(task)
         task.add_done_callback(conn.inflight.discard)
 
+    # ------------------------------------------------- service handoff
+    async def _service_call(self, coro):
+        """Await a service-submit coroutine on the service's loop.
+
+        On the service loop (n_loops=1, or shard 0) this is a plain
+        await; from a worker shard it is ONE thread-safe cross-loop
+        round trip — the per-row fan-out happens inside the service
+        loop, so a whole frame costs one handoff, not one per row.
+        """
+        try:
+            here = asyncio.get_running_loop()
+        except RuntimeError:
+            here = None
+        if self._service_loop is None or here is self._service_loop:
+            return await coro
+        return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            coro, self._service_loop))
+
+    async def _gather(self, coros):
+        """Gather service-submit coroutines via one cross-loop handoff."""
+
+        async def _run():
+            return await asyncio.gather(*coros)
+
+        return await self._service_call(_run())
+
+    # ---------------------------------------------------- coalesced egress
+    def _batch_eligible(self, conn: _Conn, req_id) -> bool:
+        """Columnar RESULT_BATCH egress applies to v4+ peers and u64
+        req_ids; everything else keeps the legacy pickled RESULT."""
+        return (conn.peer_version >= 4 and not conn.closing
+                and isinstance(req_id, int) and 0 <= req_id < (1 << 64))
+
+    @staticmethod
+    def _result_rows(req_id: int, results, ctx) -> list:
+        """Per-row egress tuples from a request's VerifyResults."""
+        tc = ctx.to_bytes() if ctx is not None else None
+        return [(req_id, i, r.status, r.accepted, r.served_by or "", tc)
+                for i, r in enumerate(results)]
+
+    def _queue_result_rows(self, conn: _Conn, rows) -> None:
+        """Queue verdict rows for coalesced egress — runs on the
+        connection's owning loop. At most ONE drain task (= one wakeup)
+        is scheduled per cycle; completions landing while a drain is
+        pending ride the same wakeup (``rpc_wakeups_total`` counts
+        cycles, where a doorbell-per-result design would count rows).
+        """
+        conn._egress.extend(rows)
+        if conn._drain_scheduled or conn.closing or not conn._egress:
+            return
+        conn._drain_scheduled = True
+        self.provider.counter("rpc_wakeups_total").add()
+        task = asyncio.ensure_future(self._drain_egress(conn))
+        conn.inflight.add(task)
+        task.add_done_callback(conn.inflight.discard)
+
+    async def _drain_egress(self, conn: _Conn) -> None:
+        """Flush queued verdict rows as columnar RESULT_BATCH frames:
+        one frame + one credit replenish per drain cycle, zero per-row
+        pickling, pooled encode scratch."""
+        try:
+            while conn._egress and not conn.closing:
+                rows, conn._egress = conn._egress, []
+                try:
+                    payload, _traced = encode_result_batch(
+                        rows, pool=_SCRATCH)
+                except ColumnarError:
+                    # pathological string vocabulary (>=256 unique
+                    # status/served_by strings in one cycle): fall back
+                    # to legacy per-request RESULT frames, stay correct
+                    for reply in self._legacy_replies(rows):
+                        await conn.send(RESULT, reply)
+                else:
+                    await conn.send_raw(RESULT_BATCH, payload)
+                    self.provider.counter("rpc_result_batch_frames_total",
+                                          role="server").add()
+                    self.provider.counter("rpc_result_batch_rows_total",
+                                          role="server").add(len(rows))
+                    self.provider.counter("rpc_result_batch_bytes_total",
+                                          role="server").add(len(payload))
+                await self._replenish(conn)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            conn._egress.clear()  # peer gone; its redial will resubmit
+        finally:
+            conn._drain_scheduled = False
+
+    @staticmethod
+    def _legacy_replies(rows):
+        """Regroup egress tuples into per-request legacy RESULT bodies
+        (the encode-fallback path). Rows are queued whole-request, so
+        grouping by req_id reconstructs each complete reply."""
+        grouped: dict = {}
+        for req_id, row_idx, status, verdict, served, tc in rows:
+            grouped.setdefault(req_id, []).append(
+                (row_idx, status, verdict, served, tc))
+        for req_id, rws in grouped.items():
+            rws.sort(key=lambda r: r[0])
+            reply = {"req_id": req_id, "status": RPC_OK,
+                     "statuses": [r[1] for r in rws],
+                     "verdicts": [r[2] for r in rws],
+                     "served_by": sorted({r[3] for r in rws if r[3]})}
+            tc = next((r[4] for r in rws if r[4]), None)
+            if tc is not None:
+                reply["tc"] = tc
+            yield reply
+
+    # ------------------------------------------------------------- serving
     async def _serve_submit_batch(self, conn: _Conn, batch,
                                   ctx=None) -> None:
         reply: dict = {"req_id": batch.req_id_base, "status": RPC_OK}
@@ -702,13 +1255,21 @@ class RpcServer:
                                       remote_parent=ctx) as ssp:
                     proofs, coms = materialize_rows(batch)
                     offs = batch.deadline_offsets_s
-                    results = await self.service.submit_batch(
-                        "range", list(zip(proofs, coms)),
-                        deadline_s=deadline_s,
-                        deadline_offsets_s=offs if offs.any() else None,
-                        lane=batch.lane, tenant=conn.tms_id,
-                        trace_ctx=ssp.context() if ctx is not None
-                        else None)
+                    results = await self._service_call(
+                        self.service.submit_batch(
+                            "range", list(zip(proofs, coms)),
+                            deadline_s=deadline_s,
+                            deadline_offsets_s=offs if offs.any() else None,
+                            lane=batch.lane, tenant=conn.tms_id,
+                            trace_ctx=ssp.context() if ctx is not None
+                            else None))
+                if self._batch_eligible(conn, batch.req_id_base):
+                    # columnar egress: verdict rows coalesce with any
+                    # other completions on this connection; the drain
+                    # cycle replenishes credits
+                    self._queue_result_rows(conn, self._result_rows(
+                        batch.req_id_base, results, ctx))
+                    return
                 reply["statuses"] = [r.status for r in results]
                 reply["verdicts"] = [r.accepted for r in results]
                 reply["served_by"] = sorted(
@@ -762,8 +1323,11 @@ class RpcServer:
             self.provider.counter("rpc_requests_total", tms=tms_id,
                                   kind=kind, lane=lane).add()
             try:
-                await self._verify_into(reply, kind, lane, deadline_s, body,
-                                        tenant=tms_id, ctx=ctx)
+                queued = await self._verify_into(
+                    reply, kind, lane, deadline_s, body,
+                    tenant=tms_id, ctx=ctx, conn=conn)
+                if queued:
+                    return  # verdicts ride RESULT_BATCH; drain replenishes
             except Exception as exc:  # service-level failure -> typed error
                 reply["status"] = RPC_ERROR
                 reply["error"] = str(exc)
@@ -776,34 +1340,49 @@ class RpcServer:
 
     async def _verify_into(self, reply: dict, kind: str, lane: str,
                            deadline_s: float | None, body: dict,
-                           tenant: str = "default", ctx=None) -> None:
+                           tenant: str = "default", ctx=None,
+                           conn: _Conn | None = None) -> bool:
+        """Run the verdicts for one SUBMIT into ``reply``; returns True
+        when the rows were queued for columnar RESULT_BATCH egress
+        instead (flat range verdicts on a v4+ peer — block replies keep
+        their nested tuple shape and stay pickled for every peer)."""
         svc = self.service
         with self.tracer.span("rpc.serve", kind=kind, lane=lane,
                               remote_parent=ctx) as ssp:
             tc = ssp.context() if ctx is not None else None
             if kind == "range":
                 proofs, coms = body["payload"]
-                results = await asyncio.gather(*[
+                results = await self._gather([
                     svc.submit_range(p, c, deadline_s=deadline_s, lane=lane,
                                      tenant=tenant, trace_ctx=tc)
                     for p, c in zip(proofs, coms)])
+                req_id = reply.get("req_id")
+                if conn is not None and self._batch_eligible(conn, req_id):
+                    self._queue_result_rows(conn, self._result_rows(
+                        req_id, results, ctx))
+                    return True
                 reply["statuses"] = [r.status for r in results]
                 reply["verdicts"] = [r.accepted for r in results]
                 reply["served_by"] = sorted(
                     {r.served_by for r in results if r.served_by})
             elif kind == "block":
+
+                async def _run_block(transfers, issues):
+                    return await asyncio.gather(
+                        asyncio.gather(*[
+                            svc.submit_transfer(
+                                pr, ins, outs, deadline_s=deadline_s,
+                                lane=lane, tenant=tenant, trace_ctx=tc)
+                            for pr, ins, outs in transfers]),
+                        asyncio.gather(*[
+                            svc.submit_issue(
+                                pr, outs, deadline_s=deadline_s, lane=lane,
+                                tenant=tenant, trace_ctx=tc)
+                            for pr, outs in issues]))
+
                 transfers, issues = body["payload"]
-                t_res, i_res = await asyncio.gather(
-                    asyncio.gather(*[
-                        svc.submit_transfer(pr, ins, outs,
-                                            deadline_s=deadline_s, lane=lane,
-                                            tenant=tenant, trace_ctx=tc)
-                        for pr, ins, outs in transfers]),
-                    asyncio.gather(*[
-                        svc.submit_issue(pr, outs, deadline_s=deadline_s,
-                                         lane=lane, tenant=tenant,
-                                         trace_ctx=tc)
-                        for pr, outs in issues]))
+                t_res, i_res = await self._service_call(
+                    _run_block(transfers, issues))
                 reply["statuses"] = ([r.status for r in t_res],
                                      [r.status for r in i_res])
                 reply["verdicts"] = ([r.accepted for r in t_res],
@@ -812,15 +1391,26 @@ class RpcServer:
                     {r.served_by for r in (*t_res, *i_res) if r.served_by})
             else:
                 raise ValueError(f"unknown submit kind {kind!r}")
+        return False
 
     async def _close_conn(self, conn: _Conn) -> None:
         if conn.closing:
             return
         conn.closing = True
+        # A write may still be suspended between header and drain; give
+        # it its own timeout to finish before scoring the accounting.
+        # ``closing`` above already fences off new writes.
+        try:
+            await asyncio.wait_for(conn.write_lock.acquire(),
+                                   self.config.write_timeout_s)
+            conn.write_lock.release()
+        except asyncio.TimeoutError:
+            pass
         if conn.frames_started != conn.frames_done:
             # a write was abandoned between header and drain — the one
             # invariant the draining stop exists to prevent
-            self.midframe_closes += 1
+            with self._conns_lock:
+                self.midframe_closes += 1
             self._frame_error("midframe_close")
         try:
             conn.writer.close()

@@ -41,6 +41,14 @@ Mechanics:
     frames under row/byte/delay flush triggers. Credits account in
     rows either way, so backpressure is format-blind; a v1 server
     silently keeps the legacy per-request path (wire-compatible).
+  - **Columnar result demux**: a v4 server answers flat range verdicts
+    with columnar RESULT_BATCH frames that may interleave rows from
+    many in-flight requests. The reader thread decodes each frame once
+    (numpy views, zero per-row pickle) and accumulates rows per
+    ``req_id`` until a request's full row count arrived, then resolves
+    its slot with a legacy-shaped reply — callers cannot tell the
+    formats apart. Non-OK replies and block verdicts still arrive as
+    pickled RESULT frames from every server version.
 """
 
 from __future__ import annotations
@@ -56,14 +64,15 @@ import numpy as np
 from ..obs import GLOBAL as _METRICS
 from ..obs import TRACER as _TRACER
 from ..resilience import RetryPolicy
-from .columnar import (FMT_OPAQUE, FMT_RANGE, encode_submit_batch,
+from .columnar import (FMT_OPAQUE, FMT_RANGE, ColumnarError,
+                       decode_result_batch, encode_submit_batch,
                        opaque_cells, range_cells)
 from .config import LANE_BULK, LANE_INTERACTIVE
 from .rpc import (CREDIT, DEFAULT_MAX_FRAME, FLAG_TRACE_CONTEXT,
-                  FRAME_NAMES, GOAWAY, HELLO, PING, PONG, RESULT, RPC_OK,
-                  RPC_VERSION, SUBMIT, SUBMIT_BATCH, WELCOME, FrameError,
-                  _describe, recv_frame_sock, send_frame_sock,
-                  send_raw_frame_sock)
+                  FRAME_NAMES, GOAWAY, HELLO, PING, PONG, RESULT,
+                  RESULT_BATCH, RPC_OK, RPC_VERSION, SUBMIT, SUBMIT_BATCH,
+                  WELCOME, FrameError, _describe, recv_frame_sock,
+                  send_frame_sock, send_raw_frame_sock)
 from .worker import _REMOTE_TRANSIENT_NAMES, WorkerUnavailable
 
 
@@ -80,6 +89,49 @@ class _Slot:
         if self.reply is None:
             self.reply = body
         self.event.set()
+
+
+class _BatchAcc:
+    """Accumulates RESULT_BATCH rows for one req_id.
+
+    A request's verdict rows may arrive split across several frames
+    (the server coalesces per drain cycle, not per request); rows for
+    OTHER requests may share each frame. ``absorb`` returns True once
+    all ``n`` distinct rows landed; duplicate rows (hedged sends) are
+    idempotent — the status cell doubles as the fill marker, since row
+    statuses are never None while verdicts legitimately are."""
+
+    __slots__ = ("n", "statuses", "verdicts", "served", "got", "tc")
+
+    def __init__(self, n: int):
+        self.n = n
+        self.statuses: list = [None] * n
+        self.verdicts: list = [None] * n
+        self.served: set = set()
+        self.got = 0
+        self.tc = None
+
+    def absorb(self, row_idx: int, status: str, verdict, served: str,
+               tc) -> bool:
+        if 0 <= row_idx < self.n and self.statuses[row_idx] is None:
+            self.statuses[row_idx] = status
+            self.verdicts[row_idx] = verdict
+            if served:
+                self.served.add(served)
+            if tc is not None and self.tc is None:
+                self.tc = tc
+            self.got += 1
+        return self.got >= self.n
+
+    def reply(self, req_id: int) -> dict:
+        """Legacy-shaped reply dict — ``_classify`` can't tell it from
+        a pickled RESULT body."""
+        body = {"req_id": req_id, "status": RPC_OK,
+                "statuses": self.statuses, "verdicts": self.verdicts,
+                "served_by": sorted(self.served)}
+        if self.tc is not None:
+            body["tc"] = self.tc
+        return body
 
 
 class _RpcRange:
@@ -139,6 +191,11 @@ class RpcClient:
         self._send_lock = threading.Lock()   # frame writes are atomic
         self._cv = threading.Condition()     # credits + pending + liveness
         self._pending: dict[int, _Slot] = {}
+        # RESULT_BATCH demux (v4 servers): expected row count per
+        # req_id, registered at submit; row accumulators, created on
+        # first row — both guarded by _cv alongside _pending
+        self._expected_rows: dict[int, int] = {}
+        self._accs: dict[int, _BatchAcc] = {}
         self._pong_waiters: list[threading.Event] = []
         self._req_ids = itertools.count(1)
         self._sock = None
@@ -250,6 +307,8 @@ class RpcClient:
                 return  # a newer dial already superseded this conn
             self._dead = True
             pending, self._pending = self._pending, {}
+            self._expected_rows.clear()
+            self._accs.clear()
             self._cv.notify_all()
         for slot in pending.values():
             slot.resolve({"status": "transport", "error": why})
@@ -278,6 +337,10 @@ class RpcClient:
                     slot = self._pending.pop(body.get("req_id"), None)
                 if slot is not None:
                     slot.resolve(body)
+            elif ftype == RESULT_BATCH:
+                if not self._absorb_result_batch(body):
+                    self._conn_lost(gen, "undecodable RESULT_BATCH")
+                    return
             elif ftype == CREDIT:
                 with self._cv:
                     self._credits += int(body.get("grant", 0))
@@ -299,6 +362,49 @@ class RpcClient:
                     waiters, self._pong_waiters = self._pong_waiters, []
                 for ev in waiters:
                     ev.set()
+
+    def _absorb_result_batch(self, payload: bytes) -> bool:
+        """Demux one columnar RESULT_BATCH frame into pending slots.
+
+        One decode per frame — every column is a numpy view, zero
+        per-row pickle. Rows whose req_id is unknown (stale generation,
+        already-resolved hedge twin) are dropped silently, same as an
+        unknown-req_id RESULT. Returns False only on an undecodable
+        frame, which poisons the connection like a torn pickled frame.
+        """
+        try:
+            batch = decode_result_batch(payload)
+        except ColumnarError as exc:
+            self.provider.counter(
+                "rpc_frame_errors_total", kind=exc.kind).add()
+            return False
+        self.provider.counter("rpc_result_batch_frames_total",
+                              role="client").add()
+        self.provider.counter("rpc_result_batch_rows_total",
+                              role="client").add(batch.n_rows)
+        self.provider.counter("rpc_result_batch_bytes_total",
+                              role="client").add(batch.nbytes)
+        done = []
+        with self._cv:
+            for i in range(batch.n_rows):
+                req_id = int(batch.req_id[i])
+                acc = self._accs.get(req_id)
+                if acc is None:
+                    n = self._expected_rows.get(req_id)
+                    if n is None:
+                        continue
+                    acc = self._accs[req_id] = _BatchAcc(n)
+                if acc.absorb(int(batch.row_idx[i]), batch.status(i),
+                              batch.verdict_value(i), batch.served(i),
+                              batch.trace_cell(i)):
+                    self._accs.pop(req_id, None)
+                    self._expected_rows.pop(req_id, None)
+                    slot = self._pending.pop(req_id, None)
+                    if slot is not None:
+                        done.append((slot, acc.reply(req_id)))
+        for slot, reply in done:
+            slot.resolve(reply)
+        return True
 
     def _count_frame(self, direction: str, ftype: int) -> None:
         self.provider.counter(
@@ -424,8 +530,14 @@ class RpcClient:
         if sp is not None and self.server_trace:
             body["tc"] = sp.context().to_bytes()
         hedge_id = None
+        # flat range verdicts may come back columnar from a v4 server:
+        # pre-register the expected row count so the reader can tell
+        # when the request's rows are complete
+        demux = kind == "range" and self.server_version >= 4
         with self._cv:
             self._pending[req_id] = slot
+            if demux:
+                self._expected_rows[req_id] = rows
         try:
             self._send_submit(body)
             hedge = (self.hedge_after_s is not None
@@ -438,6 +550,8 @@ class RpcClient:
                     hedge_id = next(self._req_ids)
                     with self._cv:
                         self._pending[hedge_id] = slot
+                        if demux:
+                            self._expected_rows[hedge_id] = rows
                     self.provider.counter("rpc_hedges_total").add()
                     self._send_submit(dict(body, req_id=hedge_id))
             remaining = deadline_mono - time.monotonic()
@@ -446,9 +560,11 @@ class RpcClient:
                     f"rpc {kind} call timed out after {budget:.3f}s")
         finally:
             with self._cv:
-                self._pending.pop(req_id, None)
-                if hedge_id is not None:
-                    self._pending.pop(hedge_id, None)
+                for rid in (req_id, hedge_id):
+                    if rid is not None:
+                        self._pending.pop(rid, None)
+                        self._expected_rows.pop(rid, None)
+                        self._accs.pop(rid, None)
         return self._classify(kind, slot.reply)
 
     def _classify(self, kind: str, reply: dict):
@@ -536,6 +652,8 @@ class RpcClient:
             flags=flags, deadline_off_us=deadline_off_us)
         with self._cv:
             self._pending[req_id] = slot
+            if self.server_version >= 4:
+                self._expected_rows[req_id] = n
         try:
             self._send_batch(payload, n)
             remaining = deadline_mono - time.monotonic()
@@ -545,6 +663,8 @@ class RpcClient:
         finally:
             with self._cv:
                 self._pending.pop(req_id, None)
+                self._expected_rows.pop(req_id, None)
+                self._accs.pop(req_id, None)
         return self._classify("range", slot.reply)
 
     # ------------------------------------------------------- zk duck-type

@@ -227,6 +227,9 @@ class VerificationService:
         self._task: asyncio.Task | None = None
         self._wake: asyncio.Event | None = None
         self._running = False
+        #: The event loop the service started on — submits must run
+        #: here; the RPC server's loop shards hand off to it.
+        self.loop: asyncio.AbstractEventLoop | None = None
         # (group, bucket) shapes already dispatched/prewarmed — the basis
         # of the profile_compile_cache_total hit/miss classification
         self._warm_shapes: set[tuple] = set()
@@ -251,6 +254,7 @@ class VerificationService:
         if self._running:
             return self.prewarm_s or 0.0
         loop = asyncio.get_running_loop()
+        self.loop = loop
         self._wake = asyncio.Event()
         if prewarm:
             # no watchdog here: first-compile legitimately takes minutes.

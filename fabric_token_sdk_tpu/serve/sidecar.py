@@ -57,6 +57,7 @@ def sidecar_main(factory, host: str, port: int, *,
                  default_deadline_s: float = 30.0,
                  resilience=None,
                  rpc: RpcConfig | None = None,
+                 rpc_loops: int | None = None,
                  tenant_quantum: int = 8,
                  tenant_weights: tuple = (),
                  beat_interval_s: float = 0.25,
@@ -103,6 +104,10 @@ def sidecar_main(factory, host: str, port: int, *,
     service = VerificationService(zk, config, resilience=resilience,
                                   wal=wal)
     rpc_config = replace(rpc or RpcConfig(), host=host, port=port)
+    if rpc_loops is not None:
+        # loop-shard override without requiring callers to build a full
+        # RpcConfig (the C10k bench arm flips just this knob)
+        rpc_config = replace(rpc_config, n_loops=int(rpc_loops))
     publisher = None
     span_exporter = None
     if obs_spool_dir is not None:
@@ -161,6 +166,7 @@ class RpcSidecar:
                  include_block: bool = False, max_wait_s: float = 0.005,
                  default_deadline_s: float = 30.0, resilience=None,
                  rpc: RpcConfig | None = None,
+                 rpc_loops: int | None = None,
                  tenant_quantum: int = 8, tenant_weights: tuple = (),
                  name: str = "rpc-sidecar", mp_context: str = "spawn",
                  obs_spool_dir=None, node: str | None = None):
@@ -177,6 +183,7 @@ class RpcSidecar:
         self.default_deadline_s = default_deadline_s
         self.resilience = resilience
         self.rpc = rpc
+        self.rpc_loops = rpc_loops
         self.tenant_quantum = tenant_quantum
         self.tenant_weights = tuple(tenant_weights)
         self.name = name
@@ -202,6 +209,7 @@ class RpcSidecar:
                 "default_deadline_s": self.default_deadline_s,
                 "resilience": self.resilience,
                 "rpc": self.rpc,
+                "rpc_loops": self.rpc_loops,
                 "tenant_quantum": self.tenant_quantum,
                 "tenant_weights": self.tenant_weights,
                 "obs_spool_dir": self.obs_spool_dir,

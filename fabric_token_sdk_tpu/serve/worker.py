@@ -355,8 +355,15 @@ class WorkerClient:
 
 # ------------------------------------------------------- stub backend
 class _StubRange:
+    def __init__(self, verify_delay_s: float = 0.0):
+        self.verify_delay_s = verify_delay_s
+
     def verify(self, proofs, coms):
         del coms
+        if self.verify_delay_s:
+            # per-batch service time: lets C10k bench/tests pace the
+            # verify stage without a real crypto backend
+            time.sleep(self.verify_delay_s)
         return [bool(p) for p in proofs]
 
 
@@ -364,14 +371,17 @@ class StubZK:
     """Deterministic, dependency-free verifier for worker/supervisor
     tests and drills: each 'proof' is its own verdict (truthiness), so
     bit-identical replay across process kills is directly assertable.
-    ``pp`` stays None so the service does not auto-build a fallback."""
+    ``pp`` stays None so the service does not auto-build a fallback.
+    ``verify_delay_s`` adds a fixed per-batch service time, modeling a
+    busy device for connection-scaling tests."""
 
     pp = None
 
-    def __init__(self, boot_delay_s: float = 0.0):
+    def __init__(self, boot_delay_s: float = 0.0,
+                 verify_delay_s: float = 0.0):
         if boot_delay_s:
             time.sleep(boot_delay_s)
-        self._range = _StubRange()
+        self._range = _StubRange(verify_delay_s)
 
     def verify_block(self, transfers, issues):
         return ([bool(t[0]) for t in transfers],

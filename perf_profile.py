@@ -53,6 +53,13 @@ Modes (--mode):
            asserts one N-row frame costs exactly ONE admission decision
            + ONE WAL append (+ ONE resolve); reports decode ns/row for
            the columnar layout vs the legacy per-row pickled bodies.
+  egress   columnar RESULT_BATCH egress audit (crypto-free, StubZK):
+           encodes >=256 verdict rows and asserts ZERO pickle calls in
+           the columnar encode, then drives the real TCP front door at
+           protocol v4 and asserts an N-row request returns as exactly
+           ONE RESULT_BATCH frame via ONE coalesced wakeup, with the
+           per-cycle pickle cost O(1) (credit frames), never O(rows);
+           reports encode ns/row columnar vs per-row pickled replies.
 
 Output: human-readable table on stderr, one JSON document on stdout.
 --trace <path> additionally writes the span tree as Chrome trace-event
@@ -687,10 +694,10 @@ def _mode_ingest(args, tracer, records) -> dict:
         real_append = wal.append_admit_batch
         real_resolve = wal.append_resolve
 
-        def admit_batch(kind, lane, rows, lane_depth, deadline):
+        def admit_batch(kind, lane, rows, lane_depth, deadline, **kw):
             counts["admit_calls"] += 1
             counts["admit_rows"] += rows
-            return real_admit(kind, lane, rows, lane_depth, deadline)
+            return real_admit(kind, lane, rows, lane_depth, deadline, **kw)
 
         def append_admit_batch(**kw):
             counts["wal_admits"] += 1
@@ -746,11 +753,189 @@ def _mode_ingest(args, tracer, records) -> dict:
             "contract": dict(counts)}
 
 
+def _mode_egress(args, tracer, records) -> dict:
+    """Columnar RESULT_BATCH egress audit (round 15). Crypto-free.
+
+    Three artifacts:
+      1. Encode cost per row: >=256 verdict rows packed into ONE
+         columnar RESULT_BATCH payload vs the legacy per-row pickled
+         RESULT bodies — with a pickle.dumps counter proving the
+         columnar encode performs ZERO pickle calls.
+      2. The coalescing contract, asserted on the production service
+         behind the real TCP server at protocol v4: an N-row request
+         returns as exactly ONE RESULT_BATCH frame scheduled by ONE
+         wakeup, and the pickled bytes moved per cycle are O(1)
+         housekeeping (credit grants), never O(rows).
+      3. Served verdicts/s through the live front door on the columnar
+         egress path (RpcServer + RpcClient, StubZK backend).
+    """
+    import asyncio
+    import pickle
+    import threading
+
+    from fabric_token_sdk_tpu.obs import GLOBAL
+    from fabric_token_sdk_tpu.serve import (RpcClient, RpcServer,
+                                            ServeConfig, StubZK,
+                                            VerificationService,
+                                            encode_result_batch)
+    from fabric_token_sdk_tpu.serve.rpc import RPC_OK, ScratchPool
+
+    def fam_count(name, **labels):
+        total = 0
+        for (fam, lab), val in GLOBAL.snapshot().items():
+            if fam != name or any(
+                    dict(lab).get(k) != v for k, v in labels.items()):
+                continue
+            total += val["count"] if isinstance(val, dict) else val
+        return total
+
+    n = max(256, args.batch)
+    verdicts = [i % 7 != 0 for i in range(n)]
+    rows = [(1, i, "ok", verdicts[i], "device", None) for i in range(n)]
+
+    pickle_calls = {"n": 0}
+    real_dumps = pickle.dumps
+
+    def counting_dumps(*a, **kw):
+        pickle_calls["n"] += 1
+        return real_dumps(*a, **kw)
+
+    iters = max(20, args.reps)
+    pool = ScratchPool()
+    pickle.dumps = counting_dumps
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            payload, _traced = encode_result_batch(rows, pool=pool)
+        col_s = (time.perf_counter() - t0) / iters
+    finally:
+        pickle.dumps = real_dumps
+    assert pickle_calls["n"] == 0, \
+        "columnar encode touched pickle — the zero-pickle contract broke"
+
+    # the layout this replaces: one pickled reply dict per row
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        legacy = [real_dumps(
+            {"req_id": i, "status": RPC_OK, "statuses": ["ok"],
+             "verdicts": [verdicts[i]], "served_by": ["device"]},
+            protocol=pickle.HIGHEST_PROTOCOL) for i in range(n)]
+    pkl_s = (time.perf_counter() - t0) / iters
+
+    col_ns_row = 1e9 * col_s / n
+    pkl_ns_row = 1e9 * pkl_s / n
+    print(f"encode {n} rows: columnar {col_ns_row:.0f} ns/row "
+          f"({n / col_s:,.0f} rows/s) vs pickled {pkl_ns_row:.0f} ns/row "
+          f"({n / pkl_s:,.0f} rows/s) — x{pkl_s / col_s:.1f}",
+          file=sys.stderr)
+    print(f"wire cost: {len(payload) / n:.1f} B/row columnar vs "
+          f"{sum(map(len, legacy)) / n:.1f} B/row pickled",
+          file=sys.stderr)
+
+    # ---- the live front door: one frame + one wakeup per request
+    frames = max(2, args.reps)
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever,
+                              name="egress-loop", daemon=True)
+    thread.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(60.0)
+
+    cfg = ServeConfig(buckets=(max(256, n),), max_wait_s=0.002,
+                      queue_capacity=4 * n)
+    svc = VerificationService(StubZK(), cfg)
+
+    async def _boot():
+        await svc.start(prewarm=False)
+        server = RpcServer(svc)
+        return server, await server.start()
+
+    server, addr = run(_boot())
+    try:
+        cli = RpcClient(addr, tms_id="egress", call_timeout_s=60.0)
+        try:
+            # warm the connection (handshake pickles HELLO/WELCOME);
+            # the server bumps its egress counters AFTER the reply
+            # frame is on the wire, so wait for them to settle before
+            # taking the baseline
+            assert cli.submit_range_batch([True], [None]).tolist() == \
+                [True]
+            deadline = time.monotonic() + 10.0
+            while fam_count("rpc_result_batch_rows_total",
+                            role="server") < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            f0 = fam_count("rpc_result_batch_frames_total", role="server")
+            r0 = fam_count("rpc_result_batch_rows_total", role="server")
+            w0 = fam_count("rpc_wakeups_total")
+            pickle_calls["n"] = 0
+            pickle.dumps = counting_dumps
+            try:
+                t0 = time.perf_counter()
+                for _ in range(frames):
+                    out = cli.submit_range_batch(verdicts, [None] * n)
+                    assert out.tolist() == verdicts
+                wall = time.perf_counter() - t0
+            finally:
+                pickle.dumps = real_dumps
+            deadline = time.monotonic() + 10.0
+            while fam_count("rpc_result_batch_rows_total",
+                            role="server") - r0 < frames * n \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            d_frames = fam_count("rpc_result_batch_frames_total",
+                                 role="server") - f0
+            d_rows = fam_count("rpc_result_batch_rows_total",
+                               role="server") - r0
+            d_wakeups = fam_count("rpc_wakeups_total") - w0
+            dumps_per_frame = pickle_calls["n"] / frames
+        finally:
+            cli.close()
+    finally:
+        async def _down():
+            await server.stop(drain=True)
+            await svc.stop(drain=True)
+        run(_down())
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5.0)
+        loop.close()
+
+    # THE egress contract: every N-row request moved as ONE columnar
+    # frame on ONE coalesced wakeup, and the per-cycle pickled bytes
+    # are O(1) housekeeping (a credit grant), never O(rows)
+    assert d_frames == frames, (d_frames, frames)
+    assert d_rows == frames * n, (d_rows, frames * n)
+    assert d_wakeups == frames, (d_wakeups, frames)
+    assert dumps_per_frame <= 4, dumps_per_frame
+    print(f"{frames} requests x {n} rows through the TCP front door: "
+          f"{d_frames} RESULT_BATCH frames, {d_wakeups} wakeups, "
+          f"{dumps_per_frame:.1f} pickle.dumps/cycle "
+          f"({frames * n / wall:,.0f} verdicts/s served)", file=sys.stderr)
+
+    return {"rows_per_request": n, "requests": frames,
+            "wall_s": round(wall, 4),
+            "served_verdicts_per_sec": round(frames * n / wall, 2),
+            "encode": {
+                "columnar_ns_per_row": round(col_ns_row, 1),
+                "pickled_ns_per_row": round(pkl_ns_row, 1),
+                "pickled_over_columnar": round(pkl_s / col_s, 2),
+                "pickle_calls_in_columnar_encode": 0,
+                "columnar_bytes_per_row": round(len(payload) / n, 1),
+                "pickled_bytes_per_row":
+                    round(sum(map(len, legacy)) / n, 1)},
+            "contract": {"result_batch_frames": d_frames,
+                         "result_batch_rows": d_rows,
+                         "wakeups": d_wakeups,
+                         "pickle_dumps_per_cycle":
+                             round(dumps_per_frame, 2)}}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("range", "block", "barrier", "fold",
                                        "pipeline", "mesh", "prove",
-                                       "ingest"),
+                                       "ingest", "egress"),
                     default="range")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=3)
@@ -777,7 +962,8 @@ def main() -> None:
     mode = {"range": _mode_range, "block": _mode_block,
             "barrier": _mode_barrier, "fold": _mode_fold,
             "pipeline": _mode_pipeline, "mesh": _mode_mesh,
-            "prove": _mode_prove, "ingest": _mode_ingest}[args.mode]
+            "prove": _mode_prove, "ingest": _mode_ingest,
+            "egress": _mode_egress}[args.mode]
     doc = mode(args, TRACER, RECORDS)
     doc["mode"] = args.mode
     doc["batch"] = args.batch

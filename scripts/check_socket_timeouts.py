@@ -15,7 +15,8 @@ modules (``serve/``, ``resilience/``, ``obs/telemetry.py``,
      ``wait_for`` or supervisor), and dict-style lookups (``.get``
      with arguments is fine by construction).
   2. **Read waits** — calls to ``.recv`` / ``.recv_into`` /
-     ``.recv_bytes`` / ``.accept`` / ``.readexactly`` /
+     ``.recv_bytes`` / ``.accept`` / ``.sock_accept`` (the sharded
+     accept loops' manual accept path) / ``.readexactly`` /
      ``.readuntil`` / ``.readinto`` with no deadline
      source (``recv_into``/``readinto`` cover the zero-copy batch
      frame read path — filling a preallocated buffer blocks exactly
@@ -50,7 +51,8 @@ SCOPE = [
 
 SYNC_WAITS = {"poll", "wait", "join", "get"}
 READ_WAITS = {"recv", "recv_into", "recv_bytes", "recv_bytes_into",
-              "accept", "readexactly", "readuntil", "readinto"}
+              "accept", "sock_accept", "readexactly", "readuntil",
+              "readinto"}
 WAIVER = "# io-deadline:"
 
 

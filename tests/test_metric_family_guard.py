@@ -83,12 +83,14 @@ STABLE_FAMILIES = (
     "mesh_devices",
     "mesh_pad_rows_total",
     # serve/ network front door (RPC sidecar)
+    "rpc_accept_shed_total",
     "rpc_batch_bytes_total",
     "rpc_batch_frames_total",
     "rpc_batch_rows_total",
     "rpc_call_seconds",
     "rpc_connections_active",
     "rpc_connections_total",
+    "rpc_conns",
     "rpc_credit_waits_total",
     "rpc_credits",
     "rpc_deadline_expired_total",
@@ -97,9 +99,14 @@ STABLE_FAMILIES = (
     "rpc_frames_total",
     "rpc_goaways_total",
     "rpc_hedges_total",
+    "rpc_loops",
     "rpc_redials_total",
     "rpc_requests_total",
+    "rpc_result_batch_bytes_total",
+    "rpc_result_batch_frames_total",
+    "rpc_result_batch_rows_total",
     "rpc_tenant_deficit",
+    "rpc_wakeups_total",
     # serve/ pipe worker single-flight contention
     "serve_worker_lock_wait_seconds",
     # serve/ write-ahead log

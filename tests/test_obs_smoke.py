@@ -541,6 +541,14 @@ EXPECTED_RPC_FAMILIES = (
     "rpc_batch_bytes_total",
     "rpc_decode_seconds",
     "rpc_tenant_deficit",
+    # C10k front door (PR: loop sharding + columnar result egress)
+    "rpc_loops",
+    "rpc_conns",
+    "rpc_wakeups_total",
+    "rpc_result_batch_frames_total",
+    "rpc_result_batch_rows_total",
+    "rpc_result_batch_bytes_total",
+    "rpc_accept_shed_total",
 )
 
 
@@ -628,6 +636,13 @@ def test_rpc_families_export():
     assert 'fmt="columnar"' in text
     assert "serve_tenant_drains_total" in text
     assert "# TYPE rpc_decode_seconds histogram" in text
+    # the C10k families export typed + help'd even when idle (loop
+    # gauges and shed counters are pre-touched at server start); the
+    # v4 round-trips above move real RESULT_BATCH frames both ways
+    assert "# TYPE rpc_loops gauge" in text
+    assert "# TYPE rpc_conns gauge" in text
+    assert "# HELP rpc_accept_shed_total" in text
+    assert 'rpc_accept_shed_total{reason="emfile"} 0' in text
 
 
 # prover/ device proof synthesis families (PR: tpu-side prover) — stable

@@ -489,7 +489,7 @@ def test_columnar_batch_end_to_end():
             out = cli.submit_range_batch(truth, [None] * 5)
             assert isinstance(out, np.ndarray) and out.dtype == bool
             assert out.tolist() == truth
-            assert cli.server_version == 3
+            assert cli.server_version == 4
             assert cli.server_batch is True
             assert cli.server_trace is True
         finally:
